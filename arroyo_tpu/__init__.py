@@ -1,0 +1,28 @@
+"""arroyo_tpu — a TPU-native distributed stream processing framework.
+
+SQL-defined stateful pipelines with event-time windows, watermarks,
+stream-stream joins, exactly-once checkpointing and a controller state
+machine (the capability set of the Arroyo reference at /root/reference),
+re-designed for TPU: columnar batches, jit-compiled operator kernels, keyed
+window state in HBM, shuffles as XLA collectives over a device mesh."""
+
+__version__ = "0.1.0"
+
+from .types import (  # noqa: F401
+    Batch,
+    CheckpointBarrier,
+    Message,
+    TaskInfo,
+    Watermark,
+    range_for_server,
+    server_for_hash,
+)
+from .graph.logical import (  # noqa: F401
+    AggKind,
+    AggSpec,
+    Program,
+    SessionWindow,
+    SlidingWindow,
+    Stream,
+    TumblingWindow,
+)

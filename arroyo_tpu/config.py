@@ -1,0 +1,115 @@
+"""Env-first configuration, mirroring the reference's env-var config system
+(arroyo-types/src/lib.rs:78-201: TASK_SLOTS, CONTROLLER_ADDR, CHECKPOINT_URL,
+ARTIFACT_URL, ``{SERVICE}__GRPC_PORT``...).  No config files; a typed settings
+object reads the environment once, with the same defaults where the reference
+defines them."""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name)
+    return int(v) if v else default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name)
+    return float(v) if v else default
+
+
+def _env_str(name: str, default: str) -> str:
+    return os.environ.get(name) or default
+
+
+def _env_bool(name: str, default: bool) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+def grpc_port(service: str, default: int) -> int:
+    """``{SERVICE}__GRPC_PORT`` override pattern (arroyo-types lib.rs:195-201)."""
+    return _env_int(f"{service.upper()}__GRPC_PORT", default)
+
+
+@dataclass
+class Config:
+    # Worker / engine
+    task_slots: int = field(default_factory=lambda: _env_int("TASK_SLOTS", 16))
+    queue_size: int = field(default_factory=lambda: _env_int("QUEUE_SIZE", 64))
+    # Batching policy for the columnar data plane (no reference analog: the
+    # reference is per-record; these bound batch size/latency at the source).
+    target_batch_size: int = field(
+        default_factory=lambda: _env_int("BATCH_SIZE", 8192)
+    )
+    batch_linger_micros: int = field(
+        default_factory=lambda: _env_int("BATCH_LINGER_MICROS", 10_000)
+    )
+
+    # Control plane
+    controller_addr: str = field(
+        default_factory=lambda: _env_str("CONTROLLER_ADDR", "http://localhost:9190")
+    )
+    node_id: Optional[str] = field(default_factory=lambda: os.environ.get("NODE_ID"))
+    job_id: Optional[str] = field(default_factory=lambda: os.environ.get("JOB_ID"))
+    run_id: Optional[str] = field(default_factory=lambda: os.environ.get("RUN_ID"))
+
+    # Storage
+    checkpoint_url: str = field(
+        default_factory=lambda: _env_str("CHECKPOINT_URL", "file:///tmp/arroyo_tpu/checkpoints")
+    )
+    artifact_url: str = field(
+        default_factory=lambda: _env_str("ARTIFACT_URL", "file:///tmp/arroyo_tpu/artifacts")
+    )
+
+    # Supervision (job_controller/mod.rs:30-32 defaults)
+    checkpoints_to_keep: int = field(
+        default_factory=lambda: _env_int("CHECKPOINTS_TO_KEEP", 4)
+    )
+    compact_every: int = field(default_factory=lambda: _env_int("COMPACT_EVERY", 2))
+    heartbeat_interval_secs: float = field(
+        default_factory=lambda: _env_float("HEARTBEAT_INTERVAL_SECS", 5.0)
+    )
+    heartbeat_timeout_secs: float = field(
+        default_factory=lambda: _env_float("HEARTBEAT_TIMEOUT_SECS", 30.0)
+    )
+    checkpoint_interval_secs: float = field(
+        default_factory=lambda: _env_float("CHECKPOINT_INTERVAL_SECS", 10.0)
+    )
+
+    # Device execution
+    device_platform: str = field(
+        default_factory=lambda: _env_str("ARROYO_TPU_PLATFORM", "")
+    )  # '' = jax default
+    state_capacity: int = field(
+        default_factory=lambda: _env_int("STATE_CAPACITY", 1 << 17)
+    )  # per-subtask keyed-state slots (doubles on overflow)
+
+    # Telemetry
+    disable_telemetry: bool = field(
+        default_factory=lambda: _env_bool("DISABLE_TELEMETRY", True)
+    )
+
+    # Admin/metrics
+    admin_port: int = field(default_factory=lambda: _env_int("ADMIN_PORT", 9191))
+
+
+_config: Optional[Config] = None
+
+
+def config() -> Config:
+    global _config
+    if _config is None:
+        _config = Config()
+    return _config
+
+
+def reset_config() -> None:
+    """Testing hook: force re-read of the environment."""
+    global _config
+    _config = None

@@ -1,0 +1,99 @@
+"""Impulse source: rate-limited counter generator
+(/root/reference/arroyo-worker/src/connectors/impulse.rs) — the standard
+benchmark/test source.  Emits batches of {counter: u64, subtask_index: u64}
+with exactly-once resume from a global state table holding the next counter."""
+
+from __future__ import annotations
+
+import asyncio
+import time as _time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+from pydantic import BaseModel
+
+from ..config import config
+from ..engine.context import Context
+from ..engine.operator import SourceFinishType, SourceOperator
+from ..state.tables import TableDescriptor, TableType, global_table
+from ..types import Batch, StopMode, now_micros
+from .registry import ConnectorMeta, register_connector
+
+
+class ImpulseConfig(BaseModel):
+    event_rate: float = 1_000_000.0  # events/sec across the source
+    event_time_interval_micros: Optional[int] = None  # synthetic event time step
+    message_count: Optional[int] = None  # total events; None = unbounded
+    batch_size: Optional[int] = None
+
+
+class ImpulseSource(SourceOperator):
+    def __init__(self, cfg: Dict[str, Any]):
+        super().__init__("impulse")
+        self.cfg = ImpulseConfig(**cfg)
+        self.counter = 0
+
+    def tables(self) -> List[TableDescriptor]:
+        return [global_table("i", "impulse source state")]
+
+    async def run(self, ctx: Context) -> SourceFinishType:
+        state = ctx.state.get_global_keyed_state("i")
+        start = state.get(ctx.task_info.task_index)
+        if start is not None:
+            self.counter = start
+
+        par = ctx.task_info.parallelism
+        rate = self.cfg.event_rate / par
+        total = None
+        if self.cfg.message_count is not None:
+            per = self.cfg.message_count // par
+            extra = 1 if ctx.task_info.task_index < self.cfg.message_count % par else 0
+            total = per + extra
+        batch_size = self.cfg.batch_size or config().target_batch_size
+        interval = self.cfg.event_time_interval_micros
+        t0_wall = _time.monotonic()
+        emitted_since_start = 0
+        base_event_time = now_micros()
+
+        runner = getattr(ctx, "_runner", None)
+        while total is None or self.counter < total:
+            n = batch_size if total is None else min(batch_size, total - self.counter)
+            counters = np.arange(self.counter, self.counter + n, dtype=np.uint64)
+            if interval:
+                ts = base_event_time + (counters.astype(np.int64) * interval)
+            else:
+                ts = np.full(n, now_micros(), dtype=np.int64)
+            batch = Batch(ts, {
+                "counter": counters,
+                "subtask_index": np.full(n, ctx.task_info.task_index, dtype=np.uint64),
+            })
+            await ctx.collect(batch)
+            self.counter += n
+            state.insert(ctx.task_info.task_index, self.counter)
+
+            if runner is not None:
+                cm = await runner.poll_source_control()
+                if cm is not None and cm.kind == "stop":
+                    return (SourceFinishType.GRACEFUL
+                            if cm.stop_mode != StopMode.IMMEDIATE
+                            else SourceFinishType.IMMEDIATE)
+
+            emitted_since_start += n
+            if rate > 0:
+                expected = emitted_since_start / rate
+                ahead = expected - (_time.monotonic() - t0_wall)
+                if ahead > 0:
+                    await asyncio.sleep(ahead)
+                else:
+                    await asyncio.sleep(0)
+            else:
+                await asyncio.sleep(0)
+        return SourceFinishType.FINAL
+
+
+register_connector(ConnectorMeta(
+    name="impulse",
+    description="rate-limited counter source",
+    source_factory=ImpulseSource,
+    config_model=ImpulseConfig,
+))

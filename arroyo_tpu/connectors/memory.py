@@ -1,0 +1,60 @@
+"""In-memory vec source/sink for unit tests (plays the role the reference's
+test harness queues play, engine.rs:316-343)."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..engine.context import Context
+from ..engine.operator import Operator, SourceFinishType, SourceOperator
+from ..types import Batch
+from .registry import ConnectorMeta, register_connector
+
+_SINKS: Dict[str, List[Batch]] = {}
+
+
+def sink_output(name: str) -> List[Batch]:
+    return _SINKS.setdefault(name, [])
+
+
+def clear_sink(name: str) -> None:
+    _SINKS.pop(name, None)
+
+
+class MemorySource(SourceOperator):
+    """Emits a preloaded list of batches, then finishes."""
+
+    def __init__(self, cfg: Dict[str, Any]):
+        super().__init__("memory_source")
+        self.batches: List[Batch] = cfg.get("batches", [])
+
+    async def run(self, ctx: Context) -> SourceFinishType:
+        runner = getattr(ctx, "_runner", None)
+        for b in self.batches:
+            await ctx.collect(b)
+            if runner is not None:
+                cm = await runner.poll_source_control()
+                if cm is not None and cm.kind == "stop":
+                    return SourceFinishType.GRACEFUL
+            await asyncio.sleep(0)
+        return SourceFinishType.FINAL
+
+
+class MemorySink(Operator):
+    def __init__(self, cfg: Dict[str, Any]):
+        super().__init__("memory_sink")
+        self.name = cfg.get("name", "default")
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        sink_output(self.name).append(batch)
+
+
+register_connector(ConnectorMeta(
+    name="memory",
+    description="in-memory batches source/sink for tests",
+    source_factory=MemorySource,
+    sink_factory=MemorySink,
+))

@@ -1,0 +1,369 @@
+"""Nexmark event generator — faithful vectorized port of the reference's
+generator (/root/reference/arroyo-worker/src/connectors/nexmark/mod.rs:27-120,
+280-770): same proportions (person:auction:bid = 1:3:46), id spaces
+(FIRST_PERSON_ID/FIRST_AUCTION_ID = 1000), hot-key ratios (hot sellers 3/4 at
+HOT_SELLER_RATIO=100 granularity, hot auctions 1/2 at 100, hot bidders 3/4 at
+100), out-of-order event times via the (event_number * 953) % 50 shuffle, the
+price distribution 10^U(0,6)*100, bounded in-flight auctions (100) and active
+people (1000), and the same exactly-once resume state (config, event_count) in
+a global table (mod.rs:80-120).
+
+The per-event Rust loop becomes one vectorized numpy pass per batch: all id
+arithmetic is closed-form in the event index, so a whole batch of events is
+produced with ~20 array ops.  Event batches use the union-column layout
+{event_type, person_*, auction_*, bid_*} mirroring Event{person, bid, auction}
+(arroyo-types/src/lib.rs:697-732).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time as _time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+from pydantic import BaseModel
+
+from ..config import config
+from ..engine.context import Context
+from ..engine.operator import SourceFinishType, SourceOperator
+from ..state.tables import TableDescriptor, global_table
+from ..types import Batch, StopMode, now_micros
+from .registry import ConnectorMeta, register_connector
+
+# Constants (mod.rs:27-44)
+HOT_AUCTION_RATIO = 100
+HOT_BIDDER_RATIO = 100
+HOT_CHANNELS_RATIO = 2
+CHANNELS_NUMBER = 10_000
+HOT_SELLER_RATIO = 100
+PERSON_ID_LEAD = 10
+AUCTION_ID_LEAD = 10
+FIRST_AUCTION_ID = 1000
+FIRST_PERSON_ID = 1000
+FIRST_CATEGORY_ID = 10
+NUM_CATEGORIES = 5
+MIN_STRING_LENGTH = 3
+
+FIRST_NAMES = ["Peter", "Paul", "Luke", "John", "Saul", "Vicky", "Kate",
+               "Julie", "Sarah", "Deiter", "Walter"]
+LAST_NAMES = ["Shultz", "Abrams", "Spencer", "White", "Bartels", "Walton",
+              "Smith", "Jones", "Noris"]
+US_CITIES = ["Phoenix", "Los Angeles", "San Francisco", "Boise", "Portland",
+             "Bend", "Redmond", "Seattle", "Kent", "Cheyenne"]
+US_STATES = ["AZ", "CA", "ID", "OR", "WA", "WY"]
+HOT_CHANNELS = ["Google", "Facebook", "Baidu", "Apple"]
+HOT_URLS = [
+    "https://www.nexmark.com/abo/eoci/cidro/item.htm?query=1",
+    "https://www.nexmark.com/eoax/oad/cidro/item.htm?query=1",
+    "https://www.nexmark.com/abo/jack/cidro/item.htm?query=1",
+    "https://www.nexmark.com/abo/micah/cidro/item.htm?query=1",
+]
+
+EVENT_PERSON, EVENT_AUCTION, EVENT_BID = 0, 1, 2
+
+
+class NexmarkConfig(BaseModel):
+    """NexmarkConfig defaults (mod.rs:405-445)."""
+
+    event_rate: float = 100_000.0
+    runtime_secs: Optional[float] = None  # num_events = rate * runtime
+    num_events: Optional[int] = None
+    person_proportion: int = 1
+    auction_proportion: int = 3
+    bid_proportion: int = 46
+    hot_seller_ratio: int = 4  # P(hot) = 1 - 1/ratio
+    hot_auction_ratio: int = 2
+    hot_bidders_ratio: int = 4
+    num_inflight_auctions: int = 100
+    num_active_people: int = 1000
+    out_of_order_group_size: int = 50
+    generate_strings: bool = True
+    rate_limited: bool = True  # False: generate as fast as possible (bench)
+    batch_size: Optional[int] = None
+
+
+class NexmarkGenerator:
+    """Deterministic batch generator for one split (GeneratorConfig,
+    mod.rs:490-560).  All id computations are vectorized closed forms."""
+
+    def __init__(self, cfg: NexmarkConfig, base_time_micros: int,
+                 first_event_id: int, max_events: int, first_event_number: int,
+                 seed: int):
+        self.cfg = cfg
+        self.base_time = int(base_time_micros)
+        self.first_event_id = first_event_id
+        self.max_events = max_events
+        self.first_event_number = first_event_number
+        self.total_prop = (cfg.person_proportion + cfg.auction_proportion
+                           + cfg.bid_proportion)
+        # inter_event_delay covers the whole generator fleet (mod.rs:331-335):
+        # delay = 1e6 / rate * n_generators
+        self.rng = np.random.default_rng(seed)
+        self.events_so_far = 0
+
+    def set_rate(self, rate: float, n_generators: int) -> None:
+        self.inter_event_delay = max(int(1_000_000.0 / rate * n_generators), 1)
+
+    @property
+    def has_next(self) -> bool:
+        return self.events_so_far < self.max_events
+
+    # -- id arithmetic (vectorized ports of mod.rs:463-560) ----------------
+
+    def _adjusted_event_number(self, num_events: np.ndarray) -> np.ndarray:
+        n = self.cfg.out_of_order_group_size
+        en = self.first_event_number + num_events
+        base = (en // n) * n
+        offset = (en * 953) % n
+        return base + offset
+
+    def _last_base0_person_id(self, event_id: np.ndarray) -> np.ndarray:
+        pp, tp = self.cfg.person_proportion, self.total_prop
+        epoch = event_id // tp
+        offset = np.minimum(event_id % tp, pp - 1)
+        return epoch * pp + offset
+
+    def _last_base0_auction_id(self, event_id: np.ndarray) -> np.ndarray:
+        pp, ap, tp = (self.cfg.person_proportion, self.cfg.auction_proportion,
+                      self.total_prop)
+        epoch = event_id // tp
+        offset = event_id % tp
+        about_person = offset < pp
+        about_bid = offset >= pp + ap
+        adj_epoch = np.where(about_person, epoch - 1, epoch)
+        adj_offset = np.where(about_person | about_bid, ap - 1,
+                              np.clip(offset - pp, 0, ap - 1))
+        return adj_epoch * ap + adj_offset
+
+    def _next_base0_person_id(self, event_id: np.ndarray) -> np.ndarray:
+        num_people = self._last_base0_person_id(event_id)
+        active = np.minimum(num_people, self.cfg.num_active_people)
+        n = (self.rng.random(len(event_id)) * (active + PERSON_ID_LEAD)).astype(np.int64)
+        return num_people - active + n
+
+    def _next_base0_auction_id(self, event_id: np.ndarray) -> np.ndarray:
+        max_a = self._last_base0_auction_id(event_id)
+        min_a = np.maximum(max_a - self.cfg.num_inflight_auctions, 0)
+        span = max_a + 1 + AUCTION_ID_LEAD - min_a
+        return min_a + (self.rng.random(len(event_id)) * span).astype(np.int64)
+
+    def _timestamp_for(self, event_number: np.ndarray) -> np.ndarray:
+        return self.base_time + self.inter_event_delay * event_number
+
+    def _next_price(self, n: int) -> np.ndarray:
+        return (np.power(10.0, self.rng.random(n) * 6.0) * 100.0).astype(np.int64)
+
+    def _rand_strings(self, n: int, max_len: int) -> np.ndarray:
+        """Vectorized alphanumeric strings with the reference's U(3, max_len)
+        length distribution (mod.rs:404-409)."""
+        if n == 0:
+            return np.zeros(0, dtype=object)
+        lengths = self.rng.integers(MIN_STRING_LENGTH, max(max_len, MIN_STRING_LENGTH + 1), n)
+        alphabet = np.frombuffer(
+            b"ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789",
+            dtype="S1")
+        maxl = int(lengths.max())
+        chars = alphabet[self.rng.integers(0, 62, (n, maxl))]
+        flat = chars.view(f"S{maxl}").reshape(n).astype(str)
+        return np.array([s[:l] for s, l in zip(flat, lengths)], dtype=object)
+
+    # -- batch generation ---------------------------------------------------
+
+    def next_batch(self, size: int) -> Tuple[Batch, np.ndarray]:
+        """Generate the next ``size`` events; returns (batch, wallclock_event_numbers)."""
+        n = min(size, self.max_events - self.events_so_far)
+        i = np.arange(self.events_so_far, self.events_so_far + n, dtype=np.int64)
+        self.events_so_far += n
+
+        adj = self._adjusted_event_number(i)
+        event_id = self.first_event_id + adj
+        ts = self._timestamp_for(adj)  # event time (out of order)
+        rem = event_id % self.total_prop
+
+        pp, ap = self.cfg.person_proportion, self.cfg.auction_proportion
+        is_person = rem < pp
+        is_auction = (~is_person) & (rem < pp + ap)
+        is_bid = ~(is_person | is_auction)
+
+        etype = np.where(is_person, EVENT_PERSON,
+                         np.where(is_auction, EVENT_AUCTION, EVENT_BID)).astype(np.int8)
+
+        cols: Dict[str, np.ndarray] = {"event_type": etype}
+        z64 = np.zeros(n, dtype=np.int64)
+
+        # persons (next_person, mod.rs:545-587)
+        p_id = np.where(is_person,
+                        self._last_base0_person_id(event_id) + FIRST_PERSON_ID, 0)
+        cols["person_id"] = p_id.astype(np.int64)
+
+        # auctions (next_auction, mod.rs:419-462)
+        last_person = self._last_base0_person_id(event_id)
+        hot_seller = (self.rng.random(n) * self.cfg.hot_seller_ratio).astype(np.int64) > 0
+        seller = np.where(
+            hot_seller, (last_person // HOT_SELLER_RATIO) * HOT_SELLER_RATIO,
+            self._next_base0_person_id(event_id)) + FIRST_PERSON_ID
+        a_id = self._last_base0_auction_id(event_id) + FIRST_AUCTION_ID
+        category = FIRST_CATEGORY_ID + self.rng.integers(0, NUM_CATEGORIES, n)
+        initial_bid = self._next_price(n)
+        reserve = initial_bid + self._next_price(n)
+        # next_auction_length_ms (mod.rs:530-548)
+        num_events_for_auctions = (self.cfg.num_inflight_auctions * self.total_prop) // ap
+        horizon = self.inter_event_delay * num_events_for_auctions  # micros
+        horizon_ms = max(horizon // 1000, 1)
+        length_ms = 1 + np.maximum(
+            (self.rng.random(n) * (horizon_ms * 2)).astype(np.int64), 1)
+        expires = ts + length_ms * 1000
+        cols["auction_id"] = np.where(is_auction, a_id, 0).astype(np.int64)
+        cols["auction_seller"] = np.where(is_auction, seller, 0).astype(np.int64)
+        cols["auction_category"] = np.where(is_auction, category, 0).astype(np.int64)
+        cols["auction_initial_bid"] = np.where(is_auction, initial_bid, 0)
+        cols["auction_reserve"] = np.where(is_auction, reserve, 0)
+        cols["auction_expires"] = np.where(is_auction, expires, 0).astype(np.int64)
+        cols["auction_datetime"] = np.where(is_auction, ts, 0).astype(np.int64)
+
+        # bids (next_bid, mod.rs:588-631)
+        hot_auction = (self.rng.random(n) * self.cfg.hot_auction_ratio).astype(np.int64) > 0
+        bid_auction = np.where(
+            hot_auction,
+            (self._last_base0_auction_id(event_id) // HOT_AUCTION_RATIO) * HOT_AUCTION_RATIO,
+            self._next_base0_auction_id(event_id)) + FIRST_AUCTION_ID
+        hot_bidder = (self.rng.random(n) * self.cfg.hot_bidders_ratio).astype(np.int64) > 0
+        bidder = np.where(
+            hot_bidder, (last_person // HOT_BIDDER_RATIO) * HOT_BIDDER_RATIO,
+            self._next_base0_person_id(event_id)) + FIRST_PERSON_ID
+        bid_price = self._next_price(n)
+        cols["bid_auction"] = np.where(is_bid, bid_auction, 0).astype(np.int64)
+        cols["bid_bidder"] = np.where(is_bid, bidder, 0).astype(np.int64)
+        cols["bid_price"] = np.where(is_bid, bid_price, 0)
+        cols["bid_datetime"] = np.where(is_bid, ts, 0).astype(np.int64)
+
+        if self.cfg.generate_strings:
+            np_idx = is_person.nonzero()[0]
+            npn = len(np_idx)
+            name = np.empty(n, dtype=object); name[:] = ""
+            email = np.empty(n, dtype=object); email[:] = ""
+            city = np.empty(n, dtype=object); city[:] = ""
+            state = np.empty(n, dtype=object); state[:] = ""
+            if npn:
+                fn = np.array(FIRST_NAMES, dtype=object)[self.rng.integers(0, len(FIRST_NAMES), npn)]
+                ln = np.array(LAST_NAMES, dtype=object)[self.rng.integers(0, len(LAST_NAMES), npn)]
+                name[np_idx] = fn + " " + ln
+                email[np_idx] = (self._rand_strings(npn, 7) + "@"
+                                 + self._rand_strings(npn, 5) + ".com")
+                city[np_idx] = np.array(US_CITIES, dtype=object)[self.rng.integers(0, len(US_CITIES), npn)]
+                state[np_idx] = np.array(US_STATES, dtype=object)[self.rng.integers(0, len(US_STATES), npn)]
+            cols["person_name"] = name
+            cols["person_email"] = email
+            cols["person_city"] = city
+            cols["person_state"] = state
+
+            na_idx = is_auction.nonzero()[0]
+            item_name = np.empty(n, dtype=object); item_name[:] = ""
+            desc = np.empty(n, dtype=object); desc[:] = ""
+            if len(na_idx):
+                item_name[na_idx] = self._rand_strings(len(na_idx), 20)
+                desc[na_idx] = self._rand_strings(len(na_idx), 100)
+            cols["auction_item_name"] = item_name
+            cols["auction_description"] = desc
+
+            nb_idx = is_bid.nonzero()[0]
+            channel = np.empty(n, dtype=object); channel[:] = ""
+            url = np.empty(n, dtype=object); url[:] = ""
+            if len(nb_idx):
+                nb = len(nb_idx)
+                hot_ch = (self.rng.random(nb) * HOT_CHANNELS_RATIO).astype(np.int64) > 0
+                hidx = self.rng.integers(0, 4, nb)
+                cold_id = self.rng.integers(0, CHANNELS_NUMBER, nb)
+                ch = np.where(hot_ch, np.array(HOT_CHANNELS, dtype=object)[hidx],
+                              np.char.add("channel-", cold_id.astype(str)).astype(object))
+                u = np.where(hot_ch, np.array(HOT_URLS, dtype=object)[hidx],
+                             np.char.add(
+                                 "https://www.nexmark.com/item.htm?query=1&channel_id=",
+                                 cold_id.astype(str)).astype(object))
+                channel[nb_idx] = ch
+                url[nb_idx] = u
+            cols["bid_channel"] = channel
+            cols["bid_url"] = url
+
+        return Batch(ts, cols), i
+
+
+def make_splits(cfg: NexmarkConfig, base_time: int, parallelism: int
+                ) -> List[Tuple[int, int, int]]:
+    """GeneratorConfig::split (mod.rs:382-402): divide max_events among
+    generators; returns (first_event_id, max_events, first_event_number)."""
+    num_events = cfg.num_events
+    if num_events is None and cfg.runtime_secs is not None:
+        num_events = int(cfg.event_rate * cfg.runtime_secs)
+    if num_events is None:
+        num_events = 2**62
+    if parallelism == 1:
+        return [(1, num_events, 1)]
+    sub = num_events // parallelism
+    out = []
+    first_id = 1
+    for i in range(parallelism):
+        me = num_events - sub * (parallelism - 1) if i == parallelism - 1 else sub
+        out.append((first_id, me, 1))
+        first_id += me
+    return out
+
+
+class NexmarkSource(SourceOperator):
+    def __init__(self, cfg: Dict[str, Any]):
+        super().__init__("nexmark")
+        self.cfg = NexmarkConfig(**cfg)
+
+    def tables(self) -> List[TableDescriptor]:
+        return [global_table("s", "nexmark source state")]
+
+    async def run(self, ctx: Context) -> SourceFinishType:
+        state = ctx.state.get_global_keyed_state("s")
+        saved = state.get(ctx.task_info.task_index)
+        par = ctx.task_info.parallelism
+        if saved is not None:
+            base_time, split, count = saved
+        else:
+            base_time = now_micros()
+            split = make_splits(self.cfg, base_time, par)[ctx.task_info.task_index]
+            count = 0
+
+        gen = NexmarkGenerator(self.cfg, base_time, split[0], split[1], split[2],
+                               seed=ctx.task_info.task_index)
+        gen.set_rate(self.cfg.event_rate, par)
+        gen.events_so_far = count
+
+        batch_size = self.cfg.batch_size or config().target_batch_size
+        runner = getattr(ctx, "_runner", None)
+        wall_base = _time.monotonic() - (gen.inter_event_delay * count) / 1e6
+
+        while gen.has_next:
+            batch, nums = gen.next_batch(batch_size)
+            await ctx.collect(batch)
+            state.insert(ctx.task_info.task_index,
+                         (base_time, split, gen.events_so_far))
+            if runner is not None:
+                cm = await runner.poll_source_control()
+                if cm is not None and cm.kind == "stop":
+                    return (SourceFinishType.GRACEFUL
+                            if cm.stop_mode != StopMode.IMMEDIATE
+                            else SourceFinishType.IMMEDIATE)
+            if self.cfg.rate_limited and len(nums):
+                target_wall = wall_base + (gen.inter_event_delay * int(nums[-1] + 1)) / 1e6
+                ahead = target_wall - _time.monotonic()
+                if ahead > 0:
+                    await asyncio.sleep(ahead)
+                else:
+                    await asyncio.sleep(0)
+            else:
+                await asyncio.sleep(0)
+        return SourceFinishType.FINAL
+
+
+register_connector(ConnectorMeta(
+    name="nexmark",
+    description="Nexmark benchmark event generator",
+    source_factory=NexmarkSource,
+    config_model=NexmarkConfig,
+))

@@ -1,0 +1,86 @@
+"""Connector registry — analog of the reference's ``trait Connector``
+metadata crate (/root/reference/arroyo-connectors/src/lib.rs:71-111): each
+connector registers factories producing source/sink physical operators from a
+validated config dict (pydantic models play the role of the JSON-schema
+``connector-schemas/*/table.json`` files)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from ..engine.operator import Operator, SourceOperator
+
+
+@dataclass
+class ConnectorMeta:
+    name: str
+    description: str
+    source_factory: Optional[Callable[[Dict[str, Any]], SourceOperator]] = None
+    sink_factory: Optional[Callable[[Dict[str, Any]], Operator]] = None
+    config_model: Optional[type] = None  # pydantic model for validation
+
+    @property
+    def supports_source(self) -> bool:
+        return self.source_factory is not None
+
+    @property
+    def supports_sink(self) -> bool:
+        return self.sink_factory is not None
+
+
+_REGISTRY: Dict[str, ConnectorMeta] = {}
+
+
+def register_connector(meta: ConnectorMeta) -> None:
+    _REGISTRY[meta.name] = meta
+
+
+def get_connector(name: str) -> ConnectorMeta:
+    _ensure_builtin()
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown connector: {name!r}; known: {sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def list_connectors() -> List[ConnectorMeta]:
+    _ensure_builtin()
+    return sorted(_REGISTRY.values(), key=lambda m: m.name)
+
+
+def validate_config(name: str, config: Dict[str, Any]) -> Dict[str, Any]:
+    """Connector::validate analog: run config through the pydantic model."""
+    meta = get_connector(name)
+    if meta.config_model is not None:
+        return meta.config_model(**config).model_dump()
+    return config
+
+
+def make_source(name: str, config: Dict[str, Any]) -> SourceOperator:
+    meta = get_connector(name)
+    if not meta.supports_source:
+        raise ValueError(f"connector {name} does not support sources")
+    return meta.source_factory(validate_config(name, config))
+
+
+def make_sink(name: str, config: Dict[str, Any]) -> Operator:
+    meta = get_connector(name)
+    if not meta.supports_sink:
+        raise ValueError(f"connector {name} does not support sinks")
+    return meta.sink_factory(validate_config(name, config))
+
+
+_loaded = False
+
+
+def _ensure_builtin() -> None:
+    global _loaded
+    if _loaded:
+        return
+    _loaded = True
+    from . import impulse, single_file, blackhole, memory, nexmark  # noqa: F401
+    for mod in ("filesystem", "http_connectors", "kafka", "websocket_connector"):
+        try:
+            __import__(f"arroyo_tpu.connectors.{mod}")
+        except ImportError:
+            pass

@@ -1,0 +1,74 @@
+"""Logical operator -> physical operator construction.
+
+The analog of the reference's ``Program::make_graph_function``
+(/root/reference/arroyo-datastream/src/lib.rs:1216-1700): where the reference
+emits Rust constructor source per operator variant for cargo to compile, we
+instantiate Python operator objects whose hot paths are jitted at first batch.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from ..connectors.registry import make_sink, make_source
+from ..graph.logical import LogicalOperator, OpKind
+from .operator import Operator
+from .operators_basic import (
+    AggregateOperator,
+    CountOperator,
+    ExpressionOperator,
+    FlatMapOperator,
+    FlattenOperator,
+    GlobalKeyOperator,
+    KeyByOperator,
+    UdfOperator,
+    WatermarkOperator,
+)
+
+_BUILDERS: Dict[OpKind, Callable[[LogicalOperator], Operator]] = {}
+
+
+def register_builder(kind: OpKind):
+    def deco(fn):
+        _BUILDERS[kind] = fn
+        return fn
+    return deco
+
+
+def build_operator(op: LogicalOperator) -> Operator:
+    _ensure_window_ops()
+    builder = _BUILDERS.get(op.kind)
+    if builder is None:
+        raise NotImplementedError(f"no physical operator for {op.kind}")
+    return builder(op)
+
+
+_BUILDERS[OpKind.CONNECTOR_SOURCE] = lambda op: make_source(
+    op.spec.connector, op.spec.config)
+_BUILDERS[OpKind.CONNECTOR_SINK] = lambda op: make_sink(
+    op.spec.connector, op.spec.config)
+_BUILDERS[OpKind.EXPRESSION] = lambda op: ExpressionOperator(op.name, op.expr)
+_BUILDERS[OpKind.UDF] = lambda op: UdfOperator(op.name, op.expr)
+_BUILDERS[OpKind.FLAT_MAP] = lambda op: FlatMapOperator(op.name, op.expr)
+_BUILDERS[OpKind.FLATTEN] = lambda op: FlattenOperator(op.name)
+_BUILDERS[OpKind.WATERMARK] = lambda op: WatermarkOperator(op.name, op.spec)
+_BUILDERS[OpKind.KEY_BY] = lambda op: KeyByOperator(op.name, op.key_cols)
+_BUILDERS[OpKind.GLOBAL_KEY] = lambda op: GlobalKeyOperator(op.name)
+_BUILDERS[OpKind.COUNT] = lambda op: CountOperator(op.name)
+_BUILDERS[OpKind.AGGREGATE] = lambda op: AggregateOperator(op.name, op.spec)
+
+_window_ops_loaded = False
+
+
+def _ensure_window_ops() -> None:
+    """Window/join operators live in engine.operators_window which registers
+    its builders on import (deferred to avoid importing jax at graph-build
+    time)."""
+    global _window_ops_loaded
+    if _window_ops_loaded:
+        return
+    _window_ops_loaded = True
+    try:
+        from . import operators_window  # noqa: F401
+    except ImportError:
+        pass

@@ -1,0 +1,86 @@
+"""Physical operator base classes.
+
+The reference generates each operator's runtime loop with proc-macros
+(``#[process_fn]``/``#[source_fn]``/``#[co_process_fn]``,
+/root/reference/arroyo-macro/src/lib.rs:292-371); hooks like
+``on_start/on_close/handle_timer/handle_watermark/handle_commit/tables``
+(lib.rs:763-822) become overridable methods here, and a single generic
+:class:`~arroyo_tpu.engine.task.TaskRunner` replaces the generated loops.
+
+Operators process whole columnar batches; hot paths are jitted JAX functions
+the operator owns."""
+
+from __future__ import annotations
+
+import asyncio
+from enum import Enum
+from typing import Any, Dict, List, Optional
+
+from ..state.tables import TableDescriptor
+from ..types import Batch, CheckpointBarrier, ControlMessage
+from .context import Context
+
+
+class SourceFinishType(Enum):
+    """SourceFinishType (arroyo-worker/src/lib.rs): how a source loop ended."""
+
+    FINAL = "final"  # emit final watermark + EndOfData
+    GRACEFUL = "graceful"  # stop requested; checkpoint state is current
+    IMMEDIATE = "immediate"
+
+
+class Operator:
+    """Base for single-input (and generic) operators."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def tables(self) -> List[TableDescriptor]:
+        return []
+
+    async def on_start(self, ctx: Context) -> None:
+        pass
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        raise NotImplementedError
+
+    async def handle_timer(self, time: int, key: Any, payload: Any,
+                           ctx: Context) -> None:
+        pass
+
+    async def handle_watermark(self, watermark: int, ctx: Context) -> None:
+        """Called when the combined input watermark advances (after timers
+        fire).  Default: forward it downstream.  Overriders that hold back or
+        transform the watermark are responsible for their own forwarding."""
+        from ..types import Message, Watermark
+
+        await ctx.broadcast(Message.wm(Watermark.event_time(watermark)))
+
+    async def pre_checkpoint(self, barrier: CheckpointBarrier, ctx: Context) -> None:
+        """Flush any state living outside registered tables into them; called
+        right before the state store snapshot."""
+        pass
+
+    async def handle_commit(self, epoch: int, ctx: Context) -> None:
+        """Second phase of two-phase commit (sinks only)."""
+        pass
+
+    async def on_close(self, ctx: Context) -> None:
+        """Called when all inputs have finished, before EndOfData propagates."""
+        pass
+
+
+class SourceOperator(Operator):
+    """Base for sources: drives its own loop instead of reacting to inputs
+    (``#[source_fn]``, arroyo-macro/src/lib.rs:292-316)."""
+
+    async def run(self, ctx: Context) -> SourceFinishType:
+        raise NotImplementedError
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        raise RuntimeError("sources have no inputs")
+
+    # Helper: sources call this between emissions to service control messages
+    # (checkpoint barriers are *injected at sources*, §3.3 of SURVEY.md).
+    async def check_control(self, ctx: Context, runner) -> Optional[ControlMessage]:
+        return await runner.poll_source_control()

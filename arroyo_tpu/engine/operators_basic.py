@@ -1,0 +1,263 @@
+"""Scalar / element-wise physical operators — analog of the reference's
+operators/mod.rs:496-878 (Map/OptionMap/Filter/FlatMap/Flatten/ToGlobal/
+KeyMap/Count/Aggregate) plus the periodic watermark generator
+(operators/mod.rs:97-233)."""
+
+from __future__ import annotations
+
+import asyncio
+import time as _time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..graph.logical import (
+    AggKind,
+    AggSpec,
+    ColumnExpr,
+    ExprReturnType,
+    LogicalOperator,
+    PeriodicWatermarkSpec,
+)
+from ..ops.expr import CompiledExpr, eval_host_expr, eval_predicate, eval_record_expr
+from ..state.tables import TableDescriptor, TableType
+from ..types import Batch, Message, Watermark, MAX_TIMESTAMP
+from .context import Context
+from .operator import Operator
+
+
+class ExpressionOperator(Operator):
+    """Map / Filter / OptionMap over a batch via a jitted column expression
+    (Operator::ExpressionOperator; operators/mod.rs:496-610)."""
+
+    def __init__(self, name: str, expr: ColumnExpr):
+        super().__init__(name)
+        self.expr = expr
+        self.compiled = CompiledExpr(expr.name, expr.fn)
+        self.return_type = expr.return_type
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        if self.return_type == ExprReturnType.PREDICATE:
+            mask = eval_predicate(self.compiled, batch)
+            if mask.any():
+                await ctx.collect(batch.select(mask))
+        elif self.return_type == ExprReturnType.RECORD:
+            await ctx.collect(eval_record_expr(self.compiled, batch))
+        else:  # OPTIONAL_RECORD: expr returns dict with '__valid' bool column
+            out = eval_record_expr(self.compiled, batch)
+            if "__valid" in out.columns:
+                mask = out.columns.pop("__valid").astype(bool)
+                out = out.select(mask)
+            await ctx.collect(out)
+
+
+class UdfOperator(Operator):
+    """Python UDF over the raw batch (the reference's WasmOperator,
+    operators/mod.rs:347-494: sandboxing is unnecessary for in-process
+    Python)."""
+
+    def __init__(self, name: str, expr: ColumnExpr):
+        super().__init__(name)
+        self.fn = expr.fn
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        await ctx.collect(eval_host_expr(self.fn, batch))
+
+
+class FlattenOperator(Operator):
+    """Expand list-valued column '__flatten' rows into multiple rows
+    (FlattenOperator, operators/mod.rs)."""
+
+    def __init__(self, name: str, list_col: str = "__flatten"):
+        super().__init__(name)
+        self.list_col = list_col
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        col = batch.columns.get(self.list_col)
+        if col is None:
+            await ctx.collect(batch)
+            return
+        lengths = np.fromiter((len(x) for x in col), dtype=np.int64, count=len(col))
+        idx = np.repeat(np.arange(len(col)), lengths)
+        flat = np.concatenate([np.asarray(x) for x in col if len(x)]) if lengths.sum() else np.zeros(0)
+        out = batch.select(idx)
+        out.columns[self.list_col] = flat
+        await ctx.collect(out)
+
+
+class FlatMapOperator(Operator):
+    """Record expr producing a list column then flattening it."""
+
+    def __init__(self, name: str, expr: ColumnExpr, list_col: str = "__flatten"):
+        super().__init__(name)
+        self.inner = UdfOperator(name, expr)
+        self.flatten = FlattenOperator(name + "_flatten", list_col)
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        out = eval_host_expr(self.inner.fn, batch)
+        await self.flatten.process_batch(out, ctx, side)
+
+
+class KeyByOperator(Operator):
+    """Re-key the stream: computes the composite key hash for shuffle routing
+    (the reference expresses keying as an ExpressionOperator over keys)."""
+
+    def __init__(self, name: str, key_cols: tuple):
+        super().__init__(name)
+        self.key_cols = key_cols
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        await ctx.collect(batch.with_key(list(self.key_cols)))
+
+
+class GlobalKeyOperator(Operator):
+    """Route everything to one shard (ToGlobalOperator)."""
+
+    def __init__(self, name: str):
+        super().__init__(name)
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        kh = np.zeros(len(batch), dtype=np.uint64)
+        await ctx.collect(Batch(batch.timestamp, dict(batch.columns), kh,
+                                ("__global",)))
+
+
+class WatermarkOperator(Operator):
+    """PeriodicWatermarkGenerator (operators/mod.rs:97-233): watermark =
+    max(event_time) - max_lateness, emitted after each batch; Idle emitted
+    when no data arrives for idle_time (1s tick in the reference; here an
+    asyncio ticker).  Emits a final MAX watermark on close so downstream
+    windows flush (operators/mod.rs:179-186)."""
+
+    def __init__(self, name: str, spec: PeriodicWatermarkSpec):
+        super().__init__(name)
+        self.spec = spec
+        self.max_ts: Optional[int] = None
+        self.last_emitted: Optional[int] = None
+        self.last_data_wall: float = _time.monotonic()
+        self._idle_task: Optional[asyncio.Task] = None
+        self._expr = (CompiledExpr(spec.expression.name, spec.expression.fn)
+                      if spec.expression else None)
+
+    async def on_start(self, ctx: Context) -> None:
+        if self.spec.idle_time_micros:
+            self._idle_task = asyncio.ensure_future(self._idle_loop(ctx))
+
+    async def _idle_loop(self, ctx: Context) -> None:
+        idle_s = self.spec.idle_time_micros / 1e6
+        while True:
+            await asyncio.sleep(1.0)
+            if _time.monotonic() - self.last_data_wall > idle_s:
+                await ctx.broadcast(Message.wm(Watermark.idle()))
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        self.last_data_wall = _time.monotonic()
+        if self._expr is not None:
+            wm_src = eval_record_expr(self._expr, batch)
+            ts_max = int(np.max(wm_src.timestamp)) if len(wm_src) else None
+        else:
+            ts_max = int(np.max(batch.timestamp)) if len(batch) else None
+        if ts_max is not None:
+            self.max_ts = ts_max if self.max_ts is None else max(self.max_ts, ts_max)
+        await ctx.collect(batch)
+        if self.max_ts is not None:
+            wm = self.max_ts - self.spec.max_lateness_micros
+            if self.last_emitted is None or wm > self.last_emitted:
+                self.last_emitted = wm
+                await ctx.broadcast(Message.wm(Watermark.event_time(wm)))
+
+    async def handle_watermark(self, watermark: int, ctx: Context) -> None:
+        # Upstream watermarks (incl. the source's final MAX) pass through.
+        if watermark >= int(MAX_TIMESTAMP) - self.spec.max_lateness_micros:
+            await ctx.broadcast(Message.wm(Watermark.event_time(int(MAX_TIMESTAMP))))
+
+    async def on_close(self, ctx: Context) -> None:
+        if self._idle_task:
+            self._idle_task.cancel()
+
+
+class CountOperator(Operator):
+    """Per-key running count over an updating stream (CountOperator,
+    operators/mod.rs): emits the new count per key per batch."""
+
+    def __init__(self, name: str):
+        super().__init__(name)
+        self.counts: Dict[int, int] = {}
+
+    def tables(self) -> List[TableDescriptor]:
+        return [TableDescriptor("c", TableType.KEYED, "counts")]
+
+    async def on_start(self, ctx: Context) -> None:
+        t = ctx.state.get_keyed_state("c")
+        self.counts = {k: v for k, v in t.items()}
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        if batch.key_hash is None:
+            return
+        t = ctx.state.get_keyed_state("c")
+        keys, cnt = np.unique(batch.key_hash, return_counts=True)
+        out_counts = np.zeros(len(keys), dtype=np.int64)
+        ts = int(np.max(batch.timestamp))
+        for i, (k, c) in enumerate(zip(keys.tolist(), cnt.tolist())):
+            nc = self.counts.get(k, 0) + c
+            self.counts[k] = nc
+            out_counts[i] = nc
+            t.insert(ts, k, nc)
+        out = Batch(np.full(len(keys), ts, dtype=np.int64),
+                    {"count": out_counts}, keys.astype(np.uint64),
+                    batch.key_cols)
+        await ctx.collect(out)
+
+
+class AggregateOperator(Operator):
+    """Per-key running Max/Min/Sum (AggregateBehavior,
+    operators/mod.rs:700-878)."""
+
+    def __init__(self, name: str, agg: AggSpec):
+        super().__init__(name)
+        self.agg = agg
+        self.values: Dict[int, float] = {}
+
+    def tables(self) -> List[TableDescriptor]:
+        return [TableDescriptor("a", TableType.KEYED, "aggregates")]
+
+    async def on_start(self, ctx: Context) -> None:
+        t = ctx.state.get_keyed_state("a")
+        self.values = {k: v for k, v in t.items()}
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        if batch.key_hash is None or self.agg.column not in batch.columns:
+            return
+        t = ctx.state.get_keyed_state("a")
+        vals = batch.columns[self.agg.column].astype(np.float64)
+        order = np.argsort(batch.key_hash, kind="stable")
+        kh = batch.key_hash[order]
+        v = vals[order]
+        keys, starts = np.unique(kh, return_index=True)
+        ts = int(np.max(batch.timestamp))
+        if self.agg.kind == AggKind.SUM:
+            per = np.add.reduceat(v, starts)
+        elif self.agg.kind == AggKind.MAX:
+            per = np.maximum.reduceat(v, starts)
+        elif self.agg.kind == AggKind.MIN:
+            per = np.minimum.reduceat(v, starts)
+        else:
+            raise ValueError(self.agg.kind)
+        out_vals = np.zeros(len(keys))
+        for i, (k, x) in enumerate(zip(keys.tolist(), per.tolist())):
+            cur = self.values.get(k)
+            if cur is None:
+                nv = x
+            elif self.agg.kind == AggKind.SUM:
+                nv = cur + x
+            elif self.agg.kind == AggKind.MAX:
+                nv = max(cur, x)
+            else:
+                nv = min(cur, x)
+            self.values[k] = nv
+            out_vals[i] = nv
+            t.insert(ts, k, nv)
+        out = Batch(np.full(len(keys), ts, dtype=np.int64),
+                    {self.agg.output: out_vals}, keys.astype(np.uint64),
+                    batch.key_cols)
+        await ctx.collect(out)

@@ -1,0 +1,620 @@
+"""Logical dataflow graph — the analog of the reference's ``arroyo-datastream``
+crate (/root/reference/arroyo-datastream/src/lib.rs).
+
+Reproduces the full operator taxonomy (``Operator`` enum, lib.rs:321-372), the
+window types (lib.rs:102-108), ``StreamNode``/``StreamEdge``/``EdgeType``
+(lib.rs:497-553), the fluent ``Stream`` builder API (lib.rs:559-986), graph
+validation (window-needs-watermark, lib.rs:1099-1117) and the graph hash used
+for artifact caching (lib.rs:1140-1154).
+
+Where the reference's operators carry *Rust source strings* to be spliced into
+a generated binary (``make_graph_function``, lib.rs:1216-1700), ours carry
+Python callables over columnar batches: element-wise expressions are functions
+``cols -> cols`` traced by jax.jit inside the physical operators, so "compiling
+a pipeline" is tracing, not cargo.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import networkx as nx
+
+MICROS = 1_000_000
+
+
+# ---------------------------------------------------------------------------
+# Window types (arroyo-datastream/src/lib.rs:102-108)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class TumblingWindow:
+    width_micros: int
+
+
+@dataclass(frozen=True)
+class SlidingWindow:
+    width_micros: int
+    slide_micros: int
+
+
+@dataclass(frozen=True)
+class InstantWindow:
+    pass
+
+
+@dataclass(frozen=True)
+class SessionWindow:
+    gap_micros: int
+
+
+WindowType = Any  # union of the four dataclasses above
+
+
+def window_label(w: WindowType) -> str:
+    if isinstance(w, TumblingWindow):
+        return f"tumbling({w.width_micros}us)"
+    if isinstance(w, SlidingWindow):
+        return f"sliding({w.width_micros}us,{w.slide_micros}us)"
+    if isinstance(w, InstantWindow):
+        return "instant"
+    if isinstance(w, SessionWindow):
+        return f"session({w.gap_micros}us)"
+    raise TypeError(w)
+
+
+# ---------------------------------------------------------------------------
+# Aggregates & expressions
+# ---------------------------------------------------------------------------
+
+
+class AggKind(Enum):
+    COUNT = "count"
+    COUNT_DISTINCT = "count_distinct"
+    SUM = "sum"
+    MIN = "min"
+    MAX = "max"
+    AVG = "avg"
+    VEC = "vec"  # collect values (WindowAgg::Expression / flatten path)
+
+
+@dataclass(frozen=True)
+class AggSpec:
+    """One aggregate: kind + input column + output column name."""
+
+    kind: AggKind
+    column: Optional[str]  # None for COUNT(*)
+    output: str
+
+
+class ExprReturnType(Enum):
+    """ExpressionReturnType (arroyo-datastream/src/lib.rs:549-553)."""
+
+    PREDICATE = "predicate"
+    RECORD = "record"
+    OPTIONAL_RECORD = "optional_record"
+
+
+@dataclass
+class ColumnExpr:
+    """A columnar expression: ``fn(cols: dict[str, array]) -> dict | array``.
+
+    ``fn`` must be jnp-traceable (no data-dependent Python control flow); the
+    physical ExpressionOperator jits it over the batch columns.  ``name`` keys
+    the jit cache and the graph hash.
+    """
+
+    name: str
+    fn: Callable[[Dict[str, Any]], Any]
+    return_type: ExprReturnType = ExprReturnType.RECORD
+    output_schema: Optional[Dict[str, Any]] = None
+    sql: str = ""  # original SQL text when planner-generated (for hashing/UI)
+
+    def hash_token(self) -> str:
+        return self.sql or self.name
+
+
+# ---------------------------------------------------------------------------
+# Operator taxonomy (Operator enum, arroyo-datastream/src/lib.rs:321-372)
+# ---------------------------------------------------------------------------
+
+
+class OpKind(Enum):
+    CONNECTOR_SOURCE = "connector_source"
+    CONNECTOR_SINK = "connector_sink"
+    EXPRESSION = "expression"  # map / filter / option-map
+    FLAT_MAP = "flat_map"
+    FLATTEN = "flatten"
+    UDF = "udf"  # python UDF (reference: FusedWasmUDFs)
+    WATERMARK = "watermark"
+    KEY_BY = "key_by"
+    GLOBAL_KEY = "global_key"
+    WINDOW = "window"  # KeyedWindowFunc / SessionWindowFunc
+    COUNT = "count"
+    AGGREGATE = "aggregate"  # AggregateBehavior Max/Min/Sum
+    WINDOW_JOIN = "window_join"
+    SLIDING_WINDOW_AGGREGATOR = "sliding_window_aggregator"
+    TUMBLING_WINDOW_AGGREGATOR = "tumbling_window_aggregator"
+    TUMBLING_TOP_N = "tumbling_top_n"
+    SLIDING_AGGREGATING_TOP_N = "sliding_aggregating_top_n"
+    JOIN_WITH_EXPIRATION = "join_with_expiration"
+    UPDATING = "updating"
+    NON_WINDOW_AGGREGATOR = "non_window_aggregator"
+    UPDATING_KEY = "updating_key"
+
+
+class JoinType(Enum):
+    INNER = "inner"
+    LEFT = "left"
+    RIGHT = "right"
+    FULL = "full"
+
+
+@dataclass
+class PeriodicWatermarkSpec:
+    """Operator::Watermark(PeriodicWatermark) — fixed-lateness or expression
+    watermark with idle detection (operators/mod.rs:97-233)."""
+
+    max_lateness_micros: int = 0
+    idle_time_micros: Optional[int] = None
+    expression: Optional[ColumnExpr] = None  # row -> watermark timestamp
+
+
+@dataclass
+class WindowSpec:
+    """Operator::Window{typ, agg, flatten}."""
+
+    typ: WindowType
+    aggs: Tuple[AggSpec, ...] = ()
+    flatten: bool = False
+    # post-aggregate projection applied to {key cols + agg outputs + window bounds}
+    projection: Optional[ColumnExpr] = None
+
+
+@dataclass
+class SlidingAggregatorSpec:
+    """Operator::SlidingWindowAggregator — two-phase bin-merged sliding
+    aggregate (arroyo-datastream/src/lib.rs:224-241;
+    aggregating_window.rs:14-258)."""
+
+    width_micros: int
+    slide_micros: int
+    aggs: Tuple[AggSpec, ...] = ()
+    projection: Optional[ColumnExpr] = None
+
+
+@dataclass
+class TumblingAggregatorSpec:
+    width_micros: int
+    aggs: Tuple[AggSpec, ...] = ()
+    projection: Optional[ColumnExpr] = None
+
+
+@dataclass
+class TopNSpec:
+    """Operator::TumblingTopN (tumbling_top_n_window.rs)."""
+
+    width_micros: int
+    max_elements: int
+    # expression extracting the sort key column(s); descending order
+    sort_column: str = ""
+    partition_cols: Tuple[str, ...] = ()
+    projection: Optional[ColumnExpr] = None
+
+
+@dataclass
+class SlidingAggregatingTopNSpec:
+    """Operator::SlidingAggregatingTopN — fused sliding aggregate + TopN
+    (sliding_top_n_aggregating_window.rs; datastream lib.rs:242-262)."""
+
+    width_micros: int
+    slide_micros: int
+    aggs: Tuple[AggSpec, ...] = ()
+    partition_cols: Tuple[str, ...] = ()
+    sort_column: str = ""
+    max_elements: int = 10
+    projection: Optional[ColumnExpr] = None
+
+
+@dataclass
+class JoinWithExpirationSpec:
+    left_expiration_micros: int
+    right_expiration_micros: int
+    join_type: JoinType = JoinType.INNER
+
+
+@dataclass
+class NonWindowAggregatorSpec:
+    """Operator::NonWindowAggregator — updating aggregate with TTL
+    (updating_aggregate.rs; datastream lib.rs:264-273)."""
+
+    expiration_micros: int
+    aggs: Tuple[AggSpec, ...] = ()
+    projection: Optional[ColumnExpr] = None
+
+
+@dataclass
+class ConnectorOpSpec:
+    """ConnectorOp{operator, config, description}
+    (arroyo-datastream/src/lib.rs:281-319)."""
+
+    connector: str  # registry name, e.g. 'impulse', 'nexmark', 'kafka'
+    config: Dict[str, Any] = field(default_factory=dict)
+    description: str = ""
+
+
+@dataclass
+class LogicalOperator:
+    kind: OpKind
+    name: str
+    spec: Any = None
+    expr: Optional[ColumnExpr] = None
+    key_cols: Tuple[str, ...] = ()
+
+    def hash_token(self) -> str:
+        tok: Dict[str, Any] = {"kind": self.kind.value, "name": self.name}
+        if self.expr is not None:
+            tok["expr"] = self.expr.hash_token()
+        if self.key_cols:
+            tok["key"] = list(self.key_cols)
+        if self.spec is not None:
+            tok["spec"] = repr(self.spec)
+        return json.dumps(tok, sort_keys=True)
+
+
+# ---------------------------------------------------------------------------
+# Graph
+# ---------------------------------------------------------------------------
+
+
+class EdgeType(Enum):
+    FORWARD = "forward"
+    SHUFFLE = "shuffle"
+    SHUFFLE_JOIN_LEFT = "shuffle_join_0"
+    SHUFFLE_JOIN_RIGHT = "shuffle_join_1"
+
+    @property
+    def is_shuffle(self) -> bool:
+        return self is not EdgeType.FORWARD
+
+
+@dataclass
+class StreamNode:
+    """StreamNode{operator_id, operator, parallelism} (lib.rs:497-502)."""
+
+    operator_id: str
+    operator: LogicalOperator
+    parallelism: int = 1
+
+
+@dataclass
+class StreamEdge:
+    """StreamEdge{key, value, typ} (lib.rs:517-522); key/value are schema
+    descriptions used for display + hashing."""
+
+    typ: EdgeType
+    key_schema: str = "()"
+    value_schema: str = ""
+
+
+class Program:
+    """Program{graph: DiGraph<StreamNode, StreamEdge>} (lib.rs:1068-1074)."""
+
+    def __init__(self, name: str = "pipeline"):
+        self.name = name
+        self.graph = nx.DiGraph()
+        self._counter = 0
+
+    # -- construction ------------------------------------------------------
+
+    def add_node(self, op: LogicalOperator, parallelism: int = 1) -> str:
+        op_id = f"{self._counter}_{op.kind.value}"
+        self._counter += 1
+        self.graph.add_node(op_id, node=StreamNode(op_id, op, parallelism))
+        return op_id
+
+    def add_edge(self, src: str, dst: str, typ: EdgeType,
+                 key_schema: str = "()", value_schema: str = "") -> None:
+        self.graph.add_edge(src, dst, edge=StreamEdge(typ, key_schema, value_schema))
+
+    def node(self, op_id: str) -> StreamNode:
+        return self.graph.nodes[op_id]["node"]
+
+    def edge(self, src: str, dst: str) -> StreamEdge:
+        return self.graph.edges[src, dst]["edge"]
+
+    def nodes(self) -> List[StreamNode]:
+        return [self.graph.nodes[n]["node"] for n in self.graph.nodes]
+
+    def sources(self) -> List[StreamNode]:
+        return [self.node(n) for n in self.graph.nodes if self.graph.in_degree(n) == 0]
+
+    def sinks(self) -> List[StreamNode]:
+        return [self.node(n) for n in self.graph.nodes if self.graph.out_degree(n) == 0]
+
+    def topo_order(self) -> List[str]:
+        return list(nx.topological_sort(self.graph))
+
+    # -- validation (lib.rs:1099-1117) ------------------------------------
+
+    WINDOWED_KINDS = {
+        OpKind.WINDOW,
+        OpKind.WINDOW_JOIN,
+        OpKind.SLIDING_WINDOW_AGGREGATOR,
+        OpKind.TUMBLING_WINDOW_AGGREGATOR,
+        OpKind.TUMBLING_TOP_N,
+        OpKind.SLIDING_AGGREGATING_TOP_N,
+    }
+
+    def validate(self) -> List[str]:
+        """Window operators require a watermark generator upstream."""
+        errors: List[str] = []
+        for op_id in self.graph.nodes:
+            node = self.node(op_id)
+            if node.operator.kind in self.WINDOWED_KINDS:
+                if not self._has_upstream(op_id, OpKind.WATERMARK):
+                    errors.append(
+                        f"{op_id} ({node.operator.kind.value}) requires a "
+                        "watermark-assigning operator upstream"
+                    )
+        return errors
+
+    def _has_upstream(self, op_id: str, kind: OpKind) -> bool:
+        for anc in nx.ancestors(self.graph, op_id):
+            if self.node(anc).operator.kind == kind:
+                return True
+        return False
+
+    # -- hashing (lib.rs:1140-1154) ---------------------------------------
+
+    def get_hash(self) -> str:
+        h = hashlib.sha256()
+        for op_id in self.topo_order():
+            node = self.node(op_id)
+            h.update(node.operator.hash_token().encode())
+            h.update(str(node.parallelism).encode())
+            for _, dst, data in self.graph.out_edges(op_id, data=True):
+                e: StreamEdge = data["edge"]
+                h.update(f"{dst}:{e.typ.value}:{e.key_schema}:{e.value_schema}".encode())
+        return h.hexdigest()[:16]
+
+    # -- display -----------------------------------------------------------
+
+    def dot(self) -> str:
+        lines = ["digraph program {"]
+        for op_id in self.graph.nodes:
+            n = self.node(op_id)
+            lines.append(f'  "{op_id}" [label="{n.operator.name} (p={n.parallelism})"];')
+        for s, d, data in self.graph.edges(data=True):
+            lines.append(f'  "{s}" -> "{d}" [label="{data["edge"].typ.value}"];')
+        lines.append("}")
+        return "\n".join(lines)
+
+    def update_parallelism(self, overrides: Dict[str, int]) -> None:
+        """Rescaling entry point (states/mod.rs:203-211)."""
+        for op_id, p in overrides.items():
+            self.node(op_id).parallelism = p
+
+
+# ---------------------------------------------------------------------------
+# Fluent builder (Stream<T>/KeyedStream<K,T>, lib.rs:559-986)
+# ---------------------------------------------------------------------------
+
+
+class Stream:
+    """Fluent pipeline builder over a Program.
+
+    ``Stream.source(...).map(...).key_by(...).window(...).sink(...)``
+    """
+
+    def __init__(self, program: Program, tail: str, keyed: Tuple[str, ...] = ()):
+        self.program = program
+        self.tail = tail
+        self.keyed = keyed
+
+    # -- sources -----------------------------------------------------------
+
+    @staticmethod
+    def source(connector: str, config: Optional[Dict[str, Any]] = None,
+               parallelism: int = 1, program: Optional[Program] = None,
+               name: Optional[str] = None) -> "Stream":
+        from ..connectors.registry import get_connector, validate_config
+
+        meta = get_connector(connector)
+        if not meta.supports_source:
+            raise ValueError(f"connector {connector!r} does not support sources")
+        cfg = validate_config(connector, config or {})
+        p = program or Program()
+        op = LogicalOperator(
+            OpKind.CONNECTOR_SOURCE,
+            name or f"{connector}_source",
+            spec=ConnectorOpSpec(connector, cfg),
+        )
+        return Stream(p, p.add_node(op, parallelism))
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _chain(self, op: LogicalOperator, parallelism: Optional[int] = None,
+               edge: EdgeType = EdgeType.FORWARD,
+               keyed: Optional[Tuple[str, ...]] = None) -> "Stream":
+        par = parallelism if parallelism is not None else self.program.node(self.tail).parallelism
+        nid = self.program.add_node(op, par)
+        key_schema = ",".join(self.keyed) if self.keyed else "()"
+        self.program.add_edge(self.tail, nid, edge, key_schema=key_schema)
+        return Stream(self.program, nid, self.keyed if keyed is None else keyed)
+
+    # -- element-wise ------------------------------------------------------
+
+    def map(self, fn: Callable, name: str = "map") -> "Stream":
+        expr = ColumnExpr(name, fn, ExprReturnType.RECORD)
+        return self._chain(LogicalOperator(OpKind.EXPRESSION, name, expr=expr))
+
+    def filter(self, fn: Callable, name: str = "filter") -> "Stream":
+        expr = ColumnExpr(name, fn, ExprReturnType.PREDICATE)
+        return self._chain(LogicalOperator(OpKind.EXPRESSION, name, expr=expr))
+
+    def option_map(self, fn: Callable, name: str = "option_map") -> "Stream":
+        expr = ColumnExpr(name, fn, ExprReturnType.OPTIONAL_RECORD)
+        return self._chain(LogicalOperator(OpKind.EXPRESSION, name, expr=expr))
+
+    def flat_map(self, fn: Callable, name: str = "flat_map") -> "Stream":
+        expr = ColumnExpr(name, fn, ExprReturnType.RECORD)
+        return self._chain(LogicalOperator(OpKind.FLAT_MAP, name, expr=expr))
+
+    def flatten(self, name: str = "flatten") -> "Stream":
+        return self._chain(LogicalOperator(OpKind.FLATTEN, name))
+
+    def udf(self, fn: Callable, name: str = "udf") -> "Stream":
+        expr = ColumnExpr(name, fn, ExprReturnType.RECORD)
+        return self._chain(LogicalOperator(OpKind.UDF, name, expr=expr))
+
+    # -- time --------------------------------------------------------------
+
+    def watermark(self, max_lateness_micros: int = 0,
+                  idle_time_micros: Optional[int] = None,
+                  expression: Optional[Callable] = None,
+                  name: str = "watermark") -> "Stream":
+        expr = None
+        if expression is not None:
+            expr = ColumnExpr(f"{name}_expr", expression, ExprReturnType.RECORD)
+        spec = PeriodicWatermarkSpec(max_lateness_micros, idle_time_micros, expr)
+        return self._chain(LogicalOperator(OpKind.WATERMARK, name, spec=spec))
+
+    # -- keying ------------------------------------------------------------
+
+    def key_by(self, *cols: str, name: str = "key_by") -> "Stream":
+        op = LogicalOperator(OpKind.KEY_BY, name, key_cols=tuple(cols))
+        return self._chain(op, keyed=tuple(cols))
+
+    def global_key(self, name: str = "global_key") -> "Stream":
+        op = LogicalOperator(OpKind.GLOBAL_KEY, name)
+        return self._chain(op, keyed=("__global",))
+
+    # -- windows / aggregates (keyed) -------------------------------------
+
+    def window(self, typ: WindowType, aggs: Sequence[AggSpec] = (),
+               flatten: bool = False, projection: Optional[Callable] = None,
+               name: Optional[str] = None, parallelism: Optional[int] = None) -> "Stream":
+        proj = ColumnExpr(f"{name or 'window'}_proj", projection) if projection else None
+        spec = WindowSpec(typ, tuple(aggs), flatten, proj)
+        op = LogicalOperator(OpKind.WINDOW, name or f"window_{window_label(typ)}", spec=spec)
+        return self._chain(op, parallelism, EdgeType.SHUFFLE)
+
+    def sliding_aggregate(self, width_micros: int, slide_micros: int,
+                          aggs: Sequence[AggSpec],
+                          projection: Optional[Callable] = None,
+                          name: str = "sliding_agg",
+                          parallelism: Optional[int] = None) -> "Stream":
+        proj = ColumnExpr(f"{name}_proj", projection) if projection else None
+        spec = SlidingAggregatorSpec(width_micros, slide_micros, tuple(aggs), proj)
+        op = LogicalOperator(OpKind.SLIDING_WINDOW_AGGREGATOR, name, spec=spec)
+        return self._chain(op, parallelism, EdgeType.SHUFFLE)
+
+    def tumbling_aggregate(self, width_micros: int, aggs: Sequence[AggSpec],
+                           projection: Optional[Callable] = None,
+                           name: str = "tumbling_agg",
+                           parallelism: Optional[int] = None) -> "Stream":
+        proj = ColumnExpr(f"{name}_proj", projection) if projection else None
+        spec = TumblingAggregatorSpec(width_micros, tuple(aggs), proj)
+        op = LogicalOperator(OpKind.TUMBLING_WINDOW_AGGREGATOR, name, spec=spec)
+        return self._chain(op, parallelism, EdgeType.SHUFFLE)
+
+    def tumbling_top_n(self, width_micros: int, max_elements: int, sort_column: str,
+                       partition_cols: Sequence[str] = (),
+                       projection: Optional[Callable] = None,
+                       name: str = "tumbling_top_n",
+                       parallelism: Optional[int] = None) -> "Stream":
+        proj = ColumnExpr(f"{name}_proj", projection) if projection else None
+        spec = TopNSpec(width_micros, max_elements, sort_column, tuple(partition_cols), proj)
+        op = LogicalOperator(OpKind.TUMBLING_TOP_N, name, spec=spec)
+        return self._chain(op, parallelism, EdgeType.SHUFFLE)
+
+    def sliding_aggregating_top_n(self, width_micros: int, slide_micros: int,
+                                  aggs: Sequence[AggSpec], partition_cols: Sequence[str],
+                                  sort_column: str, max_elements: int,
+                                  projection: Optional[Callable] = None,
+                                  name: str = "sliding_topn",
+                                  parallelism: Optional[int] = None) -> "Stream":
+        proj = ColumnExpr(f"{name}_proj", projection) if projection else None
+        spec = SlidingAggregatingTopNSpec(
+            width_micros, slide_micros, tuple(aggs), tuple(partition_cols),
+            sort_column, max_elements, proj)
+        op = LogicalOperator(OpKind.SLIDING_AGGREGATING_TOP_N, name, spec=spec)
+        return self._chain(op, parallelism, EdgeType.SHUFFLE)
+
+    def count(self, name: str = "count") -> "Stream":
+        return self._chain(LogicalOperator(OpKind.COUNT, name), edge=EdgeType.SHUFFLE)
+
+    def aggregate(self, agg: AggSpec, name: str = "aggregate") -> "Stream":
+        op = LogicalOperator(OpKind.AGGREGATE, name, spec=agg)
+        return self._chain(op, edge=EdgeType.SHUFFLE)
+
+    def non_window_aggregate(self, expiration_micros: int, aggs: Sequence[AggSpec],
+                             projection: Optional[Callable] = None,
+                             name: str = "updating_agg") -> "Stream":
+        proj = ColumnExpr(f"{name}_proj", projection) if projection else None
+        spec = NonWindowAggregatorSpec(expiration_micros, tuple(aggs), proj)
+        op = LogicalOperator(OpKind.NON_WINDOW_AGGREGATOR, name, spec=spec)
+        return self._chain(op, edge=EdgeType.SHUFFLE)
+
+    # -- joins -------------------------------------------------------------
+
+    def window_join(self, other: "Stream", window: WindowType,
+                    name: str = "window_join",
+                    parallelism: Optional[int] = None) -> "Stream":
+        assert self.program is other.program, "join streams must share a Program"
+        spec = WindowSpec(window)
+        op = LogicalOperator(OpKind.WINDOW_JOIN, name, spec=spec)
+        par = parallelism or self.program.node(self.tail).parallelism
+        nid = self.program.add_node(op, par)
+        ks = ",".join(self.keyed) if self.keyed else "()"
+        self.program.add_edge(self.tail, nid, EdgeType.SHUFFLE_JOIN_LEFT, key_schema=ks)
+        self.program.add_edge(other.tail, nid, EdgeType.SHUFFLE_JOIN_RIGHT, key_schema=ks)
+        return Stream(self.program, nid, self.keyed)
+
+    def join_with_expiration(self, other: "Stream", left_expiration_micros: int,
+                             right_expiration_micros: int,
+                             join_type: JoinType = JoinType.INNER,
+                             name: str = "join", parallelism: Optional[int] = None) -> "Stream":
+        assert self.program is other.program
+        spec = JoinWithExpirationSpec(left_expiration_micros, right_expiration_micros, join_type)
+        op = LogicalOperator(OpKind.JOIN_WITH_EXPIRATION, name, spec=spec)
+        par = parallelism or self.program.node(self.tail).parallelism
+        nid = self.program.add_node(op, par)
+        ks = ",".join(self.keyed) if self.keyed else "()"
+        self.program.add_edge(self.tail, nid, EdgeType.SHUFFLE_JOIN_LEFT, key_schema=ks)
+        self.program.add_edge(other.tail, nid, EdgeType.SHUFFLE_JOIN_RIGHT, key_schema=ks)
+        return Stream(self.program, nid, self.keyed)
+
+    # -- updating ----------------------------------------------------------
+
+    def updating(self, fn: Callable, name: str = "updating") -> "Stream":
+        expr = ColumnExpr(name, fn, ExprReturnType.OPTIONAL_RECORD)
+        return self._chain(LogicalOperator(OpKind.UPDATING, name, expr=expr))
+
+    def updating_key(self, *cols: str, name: str = "updating_key") -> "Stream":
+        op = LogicalOperator(OpKind.UPDATING_KEY, name, key_cols=tuple(cols))
+        return self._chain(op, keyed=tuple(cols))
+
+    # -- sinks -------------------------------------------------------------
+
+    def sink(self, connector: str, config: Optional[Dict[str, Any]] = None,
+             parallelism: Optional[int] = None, name: Optional[str] = None) -> Program:
+        from ..connectors.registry import get_connector, validate_config
+
+        meta = get_connector(connector)
+        if not meta.supports_sink:
+            raise ValueError(f"connector {connector!r} does not support sinks")
+        cfg = validate_config(connector, config or {})
+        op = LogicalOperator(
+            OpKind.CONNECTOR_SINK,
+            name or f"{connector}_sink",
+            spec=ConnectorOpSpec(connector, cfg),
+        )
+        self._chain(op, parallelism)
+        return self.program

@@ -1,0 +1,147 @@
+"""StateStore — the typed state facade operators use
+(``StateStore<S: BackingStore>``, /root/reference/arroyo-state/src/lib.rs:162-343).
+
+Tables are registered by :class:`TableDescriptor`; the store owns live table
+objects plus the backing store, and drives checkpoint (snapshot all tables at
+a barrier) and restore (rebuild caches from the backing store filtered by the
+task's key range)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..types import SubtaskCheckpointMetadata, TaskInfo
+from .backend import BackingStore, InMemoryBackend, ParquetBackend, TableSnapshot
+from .tables import (
+    TABLE_CLASSES,
+    BatchBuffer,
+    DeviceTable,
+    GlobalKeyedState,
+    KeyTimeMultiMap,
+    KeyedState,
+    TableDescriptor,
+    TableType,
+    TimeKeyMap,
+)
+
+
+class StateStore:
+    def __init__(self, task_info: TaskInfo, backend: BackingStore,
+                 restore_epoch: Optional[int] = None):
+        self.task_info = task_info
+        self.backend = backend
+        self.restore_epoch = restore_epoch
+        self.descriptors: Dict[str, TableDescriptor] = {}
+        self.tables: Dict[str, Any] = {}
+        self._restored: Optional[Dict[str, TableSnapshot]] = None
+        self._pending_deletes: Dict[str, List[Any]] = {}
+
+    # -- constructors ------------------------------------------------------
+
+    @staticmethod
+    def new_in_memory(task_info: TaskInfo,
+                      restore_epoch: Optional[int] = None) -> "StateStore":
+        return StateStore(task_info, InMemoryBackend(), restore_epoch)
+
+    @staticmethod
+    def from_checkpoint_url(task_info: TaskInfo, url: str,
+                            restore_epoch: Optional[int] = None) -> "StateStore":
+        return StateStore(task_info, ParquetBackend.for_url(url), restore_epoch)
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, descriptor: TableDescriptor) -> Any:
+        name = descriptor.name
+        if name in self.tables:
+            return self.tables[name]
+        self.descriptors[name] = descriptor
+        if descriptor.table_type == TableType.DEVICE:
+            raise ValueError("register device tables via register_device()")
+        table = TABLE_CLASSES[descriptor.table_type]()
+        self.tables[name] = table
+        self._maybe_restore(name, table)
+        return table
+
+    def register_device(self, descriptor: TableDescriptor,
+                        device_table: DeviceTable) -> Optional[Dict[str, Any]]:
+        """Register device-resident state; returns restored arrays (if any)
+        for the operator to stage back into HBM."""
+        self.descriptors[descriptor.name] = descriptor
+        self.tables[descriptor.name] = device_table
+        snap = self._restored_snapshot(descriptor.name)
+        if snap is not None and snap.arrays:
+            device_table.restore(snap.arrays)
+            return snap.arrays
+        return None
+
+    # typed getters mirroring the reference's get_*_state API
+    def get_global_keyed_state(self, name: str, desc: str = "") -> GlobalKeyedState:
+        return self.register(TableDescriptor(name, TableType.GLOBAL, desc))
+
+    def get_time_key_map(self, name: str, desc: str = "",
+                         retention_micros: int = 0) -> TimeKeyMap:
+        return self.register(TableDescriptor(name, TableType.TIME_KEY_MAP, desc,
+                                             retention_micros))
+
+    def get_key_time_multi_map(self, name: str, desc: str = "",
+                               retention_micros: int = 0) -> KeyTimeMultiMap:
+        return self.register(TableDescriptor(name, TableType.KEY_TIME_MULTI_MAP,
+                                             desc, retention_micros))
+
+    def get_keyed_state(self, name: str, desc: str = "") -> KeyedState:
+        return self.register(TableDescriptor(name, TableType.KEYED, desc))
+
+    def get_batch_buffer(self, name: str, desc: str = "",
+                         retention_micros: int = 0) -> BatchBuffer:
+        return self.register(TableDescriptor(name, TableType.BATCH_BUFFER, desc,
+                                             retention_micros))
+
+    def note_delete(self, table: str, key: Any) -> None:
+        """Record a key tombstone for the next checkpoint (DataOperation::DeleteKey)."""
+        self._pending_deletes.setdefault(table, []).append(key)
+
+    # -- restore -----------------------------------------------------------
+
+    def _restored_snapshot(self, name: str) -> Optional[TableSnapshot]:
+        if self.restore_epoch is None:
+            return None
+        snaps = self.backend.restore_subtask(self.task_info, self.restore_epoch,
+                                             [name])
+        return snaps.get(name)
+
+    def _maybe_restore(self, name: str, table: Any) -> None:
+        snap = self._restored_snapshot(name)
+        if snap is None:
+            return
+        if isinstance(table, BatchBuffer):
+            if snap.batch is not None:
+                table.restore_batch(snap.batch)
+        elif snap.entries:
+            table.restore(snap.entries)
+
+    def restore_watermark(self) -> Optional[int]:
+        if self.restore_epoch is None:
+            return None
+        return self.backend.restore_watermark(self.task_info, self.restore_epoch)
+
+    # -- checkpoint --------------------------------------------------------
+
+    def checkpoint(self, epoch: int,
+                   watermark: Optional[int]) -> SubtaskCheckpointMetadata:
+        """Snapshot every registered table and persist (lib.rs:345-347 path).
+        Device tables call jax.device_get via their snapshot fn, giving a
+        device-consistent snapshot at the barrier."""
+        snaps: Dict[str, TableSnapshot] = {}
+        for name, table in self.tables.items():
+            desc = self.descriptors[name]
+            if isinstance(table, DeviceTable):
+                snaps[name] = TableSnapshot(desc, arrays=table.snapshot())
+            elif isinstance(table, BatchBuffer):
+                snaps[name] = TableSnapshot(desc, batch=table.snapshot_batch())
+            else:
+                snaps[name] = TableSnapshot(
+                    desc, entries=table.snapshot(),
+                    deletes=self._pending_deletes.get(name))
+        self._pending_deletes.clear()
+        return self.backend.write_subtask_checkpoint(
+            self.task_info, epoch, snaps, watermark)

@@ -1,0 +1,315 @@
+"""State table abstractions — the four table types of the reference's
+``arroyo-state`` crate (/root/reference/arroyo-state/src/tables/):
+
+* :class:`TimeKeyMap`       — time -> key -> value          (time_key_map.rs:8-241)
+* :class:`KeyTimeMultiMap`  — key -> time -> [values]       (key_time_multi_map.rs)
+* :class:`GlobalKeyedState` — kv visible to all subtasks    (global_keyed_map.rs)
+* :class:`KeyedState`       — kv with timestamp             (keyed_map.rs)
+
+plus :class:`BatchBuffer`, the batched/columnar hot-path analog of
+KeyTimeMultiMap used by window/join operators: whole batches are appended and
+consolidated lazily, and queries/evictions are vectorized numpy ops instead of
+per-record map lookups.  Device-resident operator state (bins, hash slots)
+registers as a :class:`DeviceTable` exposing snapshot()/restore() of arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..types import Batch
+
+
+class TableType(Enum):
+    """TableDescriptor.table_type (rpc.proto:246-283)."""
+
+    GLOBAL = "global"
+    TIME_KEY_MAP = "time_key_map"
+    KEY_TIME_MULTI_MAP = "key_time_multi_map"
+    KEYED = "keyed"
+    BATCH_BUFFER = "batch_buffer"
+    DEVICE = "device"
+
+
+class WriteBehavior(Enum):
+    DEFAULT = "default"
+    COMMIT_WRITES = "commit_writes"  # two-phase-commit sink tables
+
+
+@dataclass
+class TableDescriptor:
+    name: str
+    table_type: TableType
+    description: str = ""
+    retention_micros: int = 0
+    write_behavior: WriteBehavior = WriteBehavior.DEFAULT
+
+
+def global_table(name: str, description: str = "") -> TableDescriptor:
+    return TableDescriptor(name, TableType.GLOBAL, description)
+
+
+def timer_table() -> TableDescriptor:
+    # The reference reserves table name '[' for timers (arroyo-worker/src/lib.rs:152).
+    return TableDescriptor("[", TableType.TIME_KEY_MAP, "timers")
+
+
+# ---------------------------------------------------------------------------
+
+
+class TimeKeyMap:
+    """time -> key -> value with watermark-driven flush/evict
+    (time_key_map.rs:8-241).  Tracks a buffered vs persisted split so that
+    checkpoints only write new data."""
+
+    def __init__(self) -> None:
+        self._data: Dict[int, Dict[Any, Any]] = {}
+        self._dirty: List[Tuple[int, Any]] = []
+
+    def insert(self, time: int, key: Any, value: Any) -> None:
+        self._data.setdefault(int(time), {})[key] = value
+        self._dirty.append((int(time), key))
+
+    def get(self, time: int, key: Any) -> Any:
+        return self._data.get(int(time), {}).get(key)
+
+    def get_all_for_time(self, time: int) -> Dict[Any, Any]:
+        return self._data.get(int(time), {})
+
+    def get_min_time(self) -> Optional[int]:
+        return min(self._data) if self._data else None
+
+    def all_times(self) -> List[int]:
+        return sorted(self._data)
+
+    def evict_for_timestamp(self, time: int) -> Dict[Any, Any]:
+        """Remove and return the entries at exactly ``time``."""
+        return self._data.pop(int(time), {})
+
+    def evict_before(self, time: int) -> None:
+        for t in [t for t in self._data if t < time]:
+            del self._data[t]
+
+    def drain_dirty(self) -> List[Tuple[int, Any, Any]]:
+        out = []
+        seen = set()
+        for t, k in self._dirty:
+            if (t, k) in seen:
+                continue
+            seen.add((t, k))
+            if t in self._data and k in self._data[t]:
+                out.append((t, k, self._data[t][k]))
+        self._dirty.clear()
+        return out
+
+    def snapshot(self) -> List[Tuple[int, Any, Any]]:
+        return [(t, k, v) for t, kv in self._data.items() for k, v in kv.items()]
+
+    def restore(self, entries: Iterable[Tuple[int, Any, Any]]) -> None:
+        for t, k, v in entries:
+            self._data.setdefault(int(t), {})[k] = v
+
+    def __len__(self) -> int:
+        return sum(len(kv) for kv in self._data.values())
+
+
+class KeyTimeMultiMap:
+    """key -> time -> [values] with range queries and range clears
+    (key_time_multi_map.rs)."""
+
+    def __init__(self) -> None:
+        self._data: Dict[Any, Dict[int, List[Any]]] = {}
+
+    def insert(self, time: int, key: Any, value: Any) -> None:
+        self._data.setdefault(key, {}).setdefault(int(time), []).append(value)
+
+    def get_time_range(self, key: Any, start: int, end: int) -> List[Any]:
+        """Values for ``key`` with start <= time < end, time-ordered."""
+        by_time = self._data.get(key)
+        if not by_time:
+            return []
+        out: List[Any] = []
+        for t in sorted(by_time):
+            if start <= t < end:
+                out.extend(by_time[t])
+        return out
+
+    def clear_time_range(self, key: Any, start: int, end: int) -> None:
+        by_time = self._data.get(key)
+        if not by_time:
+            return
+        for t in [t for t in by_time if start <= t < end]:
+            del by_time[t]
+        if not by_time:
+            del self._data[key]
+
+    def expire_entries_before(self, time: int) -> None:
+        for key in list(self._data):
+            by_time = self._data[key]
+            for t in [t for t in by_time if t < time]:
+                del by_time[t]
+            if not by_time:
+                del self._data[key]
+
+    def keys(self) -> List[Any]:
+        return list(self._data)
+
+    def snapshot(self) -> List[Tuple[int, Any, Any]]:
+        return [
+            (t, k, v)
+            for k, by_time in self._data.items()
+            for t, vs in by_time.items()
+            for v in vs
+        ]
+
+    def restore(self, entries: Iterable[Tuple[int, Any, Any]]) -> None:
+        for t, k, v in entries:
+            self.insert(t, k, v)
+
+    def __len__(self) -> int:
+        return sum(len(vs) for bt in self._data.values() for vs in bt.values())
+
+
+class GlobalKeyedState:
+    """kv state visible across all subtasks — used for source offsets
+    (global_keyed_map.rs)."""
+
+    def __init__(self) -> None:
+        self._data: Dict[Any, Any] = {}
+
+    def insert(self, key: Any, value: Any) -> None:
+        self._data[key] = value
+
+    def get(self, key: Any) -> Any:
+        return self._data.get(key)
+
+    def get_all(self) -> Dict[Any, Any]:
+        return dict(self._data)
+
+    def snapshot(self) -> List[Tuple[int, Any, Any]]:
+        return [(0, k, v) for k, v in self._data.items()]
+
+    def restore(self, entries: Iterable[Tuple[int, Any, Any]]) -> None:
+        for _, k, v in entries:
+            self._data[k] = v
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+
+class KeyedState:
+    """kv with timestamp (keyed_map.rs); deletes produce tombstones so that
+    compaction/restore preserves removal."""
+
+    def __init__(self) -> None:
+        self._data: Dict[Any, Tuple[int, Any]] = {}
+
+    def insert(self, time: int, key: Any, value: Any) -> None:
+        self._data[key] = (int(time), value)
+
+    def get(self, key: Any) -> Any:
+        entry = self._data.get(key)
+        return entry[1] if entry is not None else None
+
+    def remove(self, key: Any) -> None:
+        self._data.pop(key, None)
+
+    def items(self) -> List[Tuple[Any, Any]]:
+        return [(k, v) for k, (_, v) in self._data.items()]
+
+    def snapshot(self) -> List[Tuple[int, Any, Any]]:
+        return [(t, k, v) for k, (t, v) in self._data.items()]
+
+    def restore(self, entries: Iterable[Tuple[int, Any, Any]]) -> None:
+        for t, k, v in entries:
+            self._data[k] = (int(t), v)
+
+    def __len__(self) -> int:
+        return len(self._data)
+
+
+# ---------------------------------------------------------------------------
+
+
+class BatchBuffer:
+    """Columnar buffered rows for window/join operators: the hot-path
+    KeyTimeMultiMap.  Batches are appended O(1) and consolidated lazily; range
+    query and eviction are vectorized over the merged batch."""
+
+    def __init__(self) -> None:
+        self._pending: List[Batch] = []
+        self._merged: Optional[Batch] = None
+
+    def append(self, batch: Batch) -> None:
+        if len(batch):
+            self._pending.append(batch)
+
+    def _consolidate(self) -> Optional[Batch]:
+        if self._pending:
+            parts = ([self._merged] if self._merged is not None else []) + self._pending
+            self._merged = Batch.concat(parts)
+            self._pending.clear()
+        return self._merged
+
+    def query_range(self, start: int, end: int) -> Optional[Batch]:
+        """Rows with start <= timestamp < end."""
+        m = self._consolidate()
+        if m is None or len(m) == 0:
+            return None
+        mask = (m.timestamp >= start) & (m.timestamp < end)
+        if not mask.any():
+            return None
+        return m.select(mask)
+
+    def evict_before(self, time: int) -> None:
+        m = self._consolidate()
+        if m is None:
+            return
+        mask = m.timestamp >= time
+        self._merged = m.select(mask) if not mask.all() else m
+
+    def all(self) -> Optional[Batch]:
+        return self._consolidate()
+
+    def __len__(self) -> int:
+        m = self._consolidate()
+        return len(m) if m is not None else 0
+
+    # checkpoint interface: the batch itself is the snapshot
+    def snapshot_batch(self) -> Optional[Batch]:
+        return self._consolidate()
+
+    def restore_batch(self, batch: Optional[Batch]) -> None:
+        self._merged = batch
+        self._pending.clear()
+
+
+class DeviceTable:
+    """Operator-owned device-resident state (HBM arrays) that participates in
+    checkpoints: the operator provides snapshot() -> dict[str, np.ndarray] and
+    restore(dict).  The barrier path calls jax.device_get through snapshot so
+    device state is serialized consistently with host queue positions."""
+
+    def __init__(self, snapshot_fn: Callable[[], Dict[str, np.ndarray]],
+                 restore_fn: Callable[[Dict[str, np.ndarray]], None]):
+        self.snapshot_fn = snapshot_fn
+        self.restore_fn = restore_fn
+
+    def snapshot(self) -> Dict[str, np.ndarray]:
+        return self.snapshot_fn()
+
+    def restore(self, arrays: Dict[str, np.ndarray]) -> None:
+        self.restore_fn(arrays)
+
+
+TABLE_CLASSES = {
+    TableType.GLOBAL: GlobalKeyedState,
+    TableType.TIME_KEY_MAP: TimeKeyMap,
+    TableType.KEY_TIME_MULTI_MAP: KeyTimeMultiMap,
+    TableType.KEYED: KeyedState,
+    TableType.BATCH_BUFFER: BatchBuffer,
+}

@@ -1,0 +1,425 @@
+"""Core data model for the TPU-native streaming engine.
+
+This is the analog of the reference's ``arroyo-types`` crate
+(/root/reference/arroyo-types/src/lib.rs): the message taxonomy
+(Record/Barrier/Watermark/Stop/EndOfData, lib.rs:280-286), watermarks
+(lib.rs:273-277), checkpoint barriers (lib.rs:741-747), task metadata and the
+key-range partitioning functions ``server_for_hash``/``range_for_server``
+(lib.rs:822-836) whose semantics are reproduced exactly so that state sharding
+and rescale-by-key-range behave identically.
+
+The central difference from the reference: the unit of dataflow is not a single
+``Record<K, T>`` but a columnar :class:`Batch` of records (numpy arrays on the
+host, staged to device inside jitted operator kernels).  Event time is int64
+microseconds since the unix epoch, matching Arrow's timestamp(us).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, Dict, Iterator, Mapping, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+U64_MAX = np.uint64(0xFFFF_FFFF_FFFF_FFFF)
+
+# Reserved timestamp value used as the "final" watermark on close, mirroring the
+# reference's u64::MAX final watermark (arroyo-worker/src/operators/mod.rs:179-186).
+MAX_TIMESTAMP = np.int64(2**63 - 1)
+MIN_TIMESTAMP = np.int64(-(2**63))
+
+
+def now_micros() -> int:
+    """Current wall-clock time in microseconds (event-time domain)."""
+    return _time.time_ns() // 1_000
+
+
+# ---------------------------------------------------------------------------
+# Key-range partitioning (arroyo-types/src/lib.rs:822-836 semantics)
+# ---------------------------------------------------------------------------
+
+
+def server_for_hash(x: int, n: int) -> int:
+    """Map a u64 key hash to one of ``n`` contiguous key ranges.
+
+    Matches the reference exactly: ``range_size = u64::MAX / n``;
+    ``min(n - 1, x / range_size)``.
+    """
+    range_size = int(U64_MAX) // n
+    return min(n - 1, int(x) // range_size)
+
+
+def server_for_hash_array(x: np.ndarray, n: int) -> np.ndarray:
+    """Vectorized :func:`server_for_hash` over a uint64 array."""
+    range_size = np.uint64(int(U64_MAX) // n)
+    idx = (x.astype(np.uint64) // range_size).astype(np.int64)
+    return np.minimum(idx, n - 1)
+
+
+def range_for_server(i: int, n: int) -> Tuple[int, int]:
+    """Inclusive [start, end] u64 key range owned by shard ``i`` of ``n``."""
+    range_size = int(U64_MAX) // n
+    start = range_size * i
+    end = int(U64_MAX) if i + 1 == n else start + range_size - 1
+    return (start, end)
+
+
+def ranges_overlap(a: Tuple[int, int], b: Tuple[int, int]) -> bool:
+    return a[0] <= b[1] and b[0] <= a[1]
+
+
+# ---------------------------------------------------------------------------
+# Hashing: stable vectorized 64-bit key hashing
+# ---------------------------------------------------------------------------
+
+_SPLITMIX_C1 = np.uint64(0xBF58476D1CE4E5B9)
+_SPLITMIX_C2 = np.uint64(0x94D049BB133111EB)
+_GOLDEN = np.uint64(0x9E3779B97F4A7C15)
+
+
+def hash_u64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer over an integer array -> uint64 hashes.
+
+    Used to spread integer keys uniformly over the u64 ring so that
+    key-range sharding balances (the reference relies on ahash for the same
+    property; exact hash values need only be internally consistent).
+    """
+    with np.errstate(over="ignore"):
+        z = x.astype(np.uint64) + _GOLDEN
+        z = (z ^ (z >> np.uint64(30))) * _SPLITMIX_C1
+        z = (z ^ (z >> np.uint64(27))) * _SPLITMIX_C2
+        return z ^ (z >> np.uint64(31))
+
+
+def hash_any_column(col: np.ndarray) -> np.ndarray:
+    """Hash an arbitrary column (ints, floats, strings/objects) to uint64."""
+    if np.issubdtype(col.dtype, np.integer):
+        return hash_u64(col)
+    if np.issubdtype(col.dtype, np.floating):
+        return hash_u64(col.astype(np.float64).view(np.uint64))
+    # Strings / objects: pandas' stable vectorized hash.
+    import pandas as pd
+
+    return pd.util.hash_array(np.asarray(col, dtype=object), categorize=False)
+
+
+def hash_columns(cols: Sequence[np.ndarray]) -> np.ndarray:
+    """Combine multiple column hashes into one composite uint64 key hash."""
+    assert cols, "need at least one key column"
+    acc = hash_any_column(cols[0])
+    with np.errstate(over="ignore"):
+        for c in cols[1:]:
+            acc = hash_u64(acc * np.uint64(31) + hash_any_column(c))
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Batch: the columnar record envelope
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Batch:
+    """A columnar batch of records flowing along one dataflow edge.
+
+    ``timestamp`` is int64 event-time micros (one per row); ``key_hash`` is the
+    uint64 hash of the key columns (present iff the edge is keyed);
+    ``columns`` maps column name -> numpy array (object dtype for strings).
+
+    This replaces the reference's per-record ``Record{timestamp, key, value}``
+    (arroyo-types/src/lib.rs:295-299) with a batch the device kernels can
+    consume directly.
+    """
+
+    timestamp: np.ndarray  # int64[n] micros
+    columns: Dict[str, np.ndarray]
+    key_hash: Optional[np.ndarray] = None  # uint64[n]
+    key_cols: Tuple[str, ...] = ()
+
+    def __post_init__(self) -> None:
+        self.timestamp = np.asarray(self.timestamp, dtype=np.int64)
+
+    def __len__(self) -> int:
+        return int(self.timestamp.shape[0])
+
+    @property
+    def num_rows(self) -> int:
+        return len(self)
+
+    def column(self, name: str) -> np.ndarray:
+        return self.columns[name]
+
+    def with_key(self, key_cols: Sequence[str]) -> "Batch":
+        """Return a batch keyed by ``key_cols`` (computes key_hash)."""
+        kh = hash_columns([self.columns[c] for c in key_cols])
+        return Batch(self.timestamp, dict(self.columns), kh, tuple(key_cols))
+
+    def select(self, mask_or_idx: np.ndarray) -> "Batch":
+        """Row subset by boolean mask or integer index array."""
+        cols = {k: v[mask_or_idx] for k, v in self.columns.items()}
+        kh = self.key_hash[mask_or_idx] if self.key_hash is not None else None
+        return Batch(self.timestamp[mask_or_idx], cols, kh, self.key_cols)
+
+    def project(self, names: Sequence[str]) -> "Batch":
+        cols = {n: self.columns[n] for n in names}
+        return Batch(self.timestamp, cols, self.key_hash, self.key_cols)
+
+    @staticmethod
+    def concat(batches: Sequence["Batch"]) -> "Batch":
+        assert batches
+        if len(batches) == 1:
+            return batches[0]
+        ts = np.concatenate([b.timestamp for b in batches])
+        names = batches[0].columns.keys()
+        cols = {n: np.concatenate([b.columns[n] for b in batches]) for n in names}
+        kh = None
+        if batches[0].key_hash is not None:
+            kh = np.concatenate([b.key_hash for b in batches])
+        return Batch(ts, cols, kh, batches[0].key_cols)
+
+    @staticmethod
+    def empty_like(other: "Batch") -> "Batch":
+        return other.select(np.zeros(0, dtype=np.int64))
+
+    def schema(self) -> Dict[str, np.dtype]:
+        return {k: v.dtype for k, v in self.columns.items()}
+
+    # Arrow interop (used by parquet sinks / checkpoints / network IPC).
+    def to_arrow(self):
+        import pyarrow as pa
+
+        arrays = {"__timestamp": pa.array(self.timestamp, type=pa.int64())}
+        for k, v in self.columns.items():
+            arrays[k] = pa.array(v.tolist() if v.dtype == object else v)
+        return pa.table(arrays)
+
+    @staticmethod
+    def from_arrow(table) -> "Batch":
+        cols = {}
+        ts = None
+        for name in table.column_names:
+            arr = table.column(name).combine_chunks().to_numpy(zero_copy_only=False)
+            if name == "__timestamp":
+                ts = arr.astype(np.int64)
+            else:
+                cols[name] = arr
+        assert ts is not None, "arrow table missing __timestamp"
+        return Batch(ts, cols)
+
+
+# ---------------------------------------------------------------------------
+# Watermarks, barriers, control messages
+# ---------------------------------------------------------------------------
+
+
+class WatermarkKind(Enum):
+    EVENT_TIME = "event_time"
+    IDLE = "idle"
+
+
+@dataclass(frozen=True)
+class Watermark:
+    """Watermark::{EventTime(t), Idle} (arroyo-types/src/lib.rs:273-277)."""
+
+    kind: WatermarkKind
+    time: int = 0  # micros; meaningful iff kind == EVENT_TIME
+
+    @staticmethod
+    def event_time(t: int) -> "Watermark":
+        return Watermark(WatermarkKind.EVENT_TIME, int(t))
+
+    @staticmethod
+    def idle() -> "Watermark":
+        return Watermark(WatermarkKind.IDLE)
+
+    @property
+    def is_idle(self) -> bool:
+        return self.kind == WatermarkKind.IDLE
+
+
+@dataclass(frozen=True)
+class CheckpointBarrier:
+    """CheckpointBarrier{epoch, min_epoch, timestamp, then_stop}
+    (arroyo-types/src/lib.rs:741-747)."""
+
+    epoch: int
+    min_epoch: int
+    timestamp: int  # micros
+    then_stop: bool = False
+
+
+class MessageKind(Enum):
+    RECORD = "record"
+    WATERMARK = "watermark"
+    BARRIER = "barrier"
+    STOP = "stop"
+    END_OF_DATA = "end_of_data"
+
+
+@dataclass
+class Message:
+    """Message::{Record, Barrier, Watermark, Stop, EndOfData}
+    (arroyo-types/src/lib.rs:280-286), batch-first."""
+
+    kind: MessageKind
+    batch: Optional[Batch] = None
+    watermark: Optional[Watermark] = None
+    barrier: Optional[CheckpointBarrier] = None
+
+    @staticmethod
+    def record(batch: Batch) -> "Message":
+        return Message(MessageKind.RECORD, batch=batch)
+
+    @staticmethod
+    def wm(w: Watermark) -> "Message":
+        return Message(MessageKind.WATERMARK, watermark=w)
+
+    @staticmethod
+    def barrier_msg(b: CheckpointBarrier) -> "Message":
+        return Message(MessageKind.BARRIER, barrier=b)
+
+    @staticmethod
+    def stop() -> "Message":
+        return Message(MessageKind.STOP)
+
+    @staticmethod
+    def end_of_data() -> "Message":
+        return Message(MessageKind.END_OF_DATA)
+
+    @property
+    def is_end(self) -> bool:
+        return self.kind in (MessageKind.STOP, MessageKind.END_OF_DATA)
+
+
+# ---------------------------------------------------------------------------
+# Updating / retraction data model (arroyo-types/src/lib.rs:315-507)
+# ---------------------------------------------------------------------------
+
+
+class UpdateOp(Enum):
+    """Row-level operation for updating streams (Debezium c/u/d model)."""
+
+    CREATE = 0
+    UPDATE = 1
+    DELETE = 2
+
+
+UPDATE_OP_COLUMN = "__op"  # int8 column carrying UpdateOp on updating edges
+RETRACT_OLD_PREFIX = "__old__"  # old-value columns for UPDATE rows
+
+
+# ---------------------------------------------------------------------------
+# Task metadata
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TaskInfo:
+    """TaskInfo (arroyo-types/src/lib.rs:558-586): identity + key range of one
+    parallel subtask of one operator."""
+
+    job_id: str
+    operator_id: str
+    operator_name: str
+    task_index: int
+    parallelism: int
+
+    @property
+    def key_range(self) -> Tuple[int, int]:
+        return range_for_server(self.task_index, self.parallelism)
+
+    def owns_hash(self, h: int) -> bool:
+        lo, hi = self.key_range
+        return lo <= int(h) <= hi
+
+    @property
+    def task_id(self) -> str:
+        return f"{self.operator_id}-{self.task_index}"
+
+
+# ---------------------------------------------------------------------------
+# Control plane messages (arroyo-rpc/src/lib.rs:26-100 analogs)
+# ---------------------------------------------------------------------------
+
+
+class StopMode(Enum):
+    GRACEFUL = "graceful"  # propagate Stop through the dataflow
+    IMMEDIATE = "immediate"  # stop now
+
+
+@dataclass
+class ControlMessage:
+    """Controller/worker -> task control messages (ControlMessage enum,
+    arroyo-rpc/src/lib.rs:26-47)."""
+
+    kind: str  # 'checkpoint' | 'stop' | 'commit' | 'load_compacted' | 'no_op'
+    barrier: Optional[CheckpointBarrier] = None
+    stop_mode: Optional[StopMode] = None
+    epoch: Optional[int] = None
+    compacted: Optional[Any] = None
+
+    @staticmethod
+    def checkpoint(barrier: CheckpointBarrier) -> "ControlMessage":
+        return ControlMessage("checkpoint", barrier=barrier)
+
+    @staticmethod
+    def stop(mode: StopMode = StopMode.GRACEFUL) -> "ControlMessage":
+        return ControlMessage("stop", stop_mode=mode)
+
+    @staticmethod
+    def commit(epoch: int) -> "ControlMessage":
+        return ControlMessage("commit", epoch=epoch)
+
+
+class CheckpointEventType(Enum):
+    """Per-subtask checkpoint lifecycle events (rpc.proto:34-45)."""
+
+    STARTED_ALIGNMENT = "started_alignment"
+    STARTED_CHECKPOINTING = "started_checkpointing"
+    FINISHED_OPERATOR_SETUP = "finished_operator_setup"
+    FINISHED_SYNC = "finished_sync"
+    FINISHED_COMMIT = "finished_commit"
+
+
+@dataclass
+class CheckpointEvent:
+    checkpoint_epoch: int
+    operator_id: str
+    subtask_index: int
+    time: int
+    event_type: CheckpointEventType
+
+
+@dataclass
+class SubtaskCheckpointMetadata:
+    epoch: int
+    operator_id: str
+    subtask_index: int
+    start_time: int
+    finish_time: int
+    bytes: int
+    tables: Dict[str, "TableCheckpointMetadata"] = field(default_factory=dict)
+    watermark: Optional[int] = None
+    committing_data: Optional[Dict[str, Any]] = None
+
+
+@dataclass
+class TableCheckpointMetadata:
+    table: str
+    files: Tuple[str, ...] = ()
+    min_key_hash: int = 0
+    max_key_hash: int = int(U64_MAX)
+
+
+@dataclass
+class ControlResp:
+    """Task -> controller responses (ControlResp, arroyo-rpc/src/lib.rs:60-100)."""
+
+    kind: str  # 'checkpoint_event'|'checkpoint_completed'|'task_started'|'task_finished'|'task_failed'|'error'
+    operator_id: str = ""
+    task_index: int = 0
+    checkpoint_event: Optional[CheckpointEvent] = None
+    subtask_metadata: Optional[SubtaskCheckpointMetadata] = None
+    error: Optional[str] = None

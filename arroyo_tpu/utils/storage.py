@@ -1,0 +1,145 @@
+"""URL-dispatched object storage — analog of the reference's ``arroyo-storage``
+crate (``StorageProvider::{for_url, get, put, delete_if_present}``,
+arroyo-storage/src/lib.rs:135-389).
+
+Schemes: ``file://`` (and bare paths), ``memory://`` (tests), with ``gs://`` /
+``s3://`` gated behind optional gcsfs/s3fs imports (not installed in this
+image — the provider raises a clear error rather than failing at import)."""
+
+from __future__ import annotations
+
+import os
+import shutil
+import threading
+from typing import Dict, List, Optional
+from urllib.parse import urlparse
+
+_MEMORY_STORES: Dict[str, Dict[str, bytes]] = {}
+_MEMORY_LOCK = threading.Lock()
+
+
+class StorageProvider:
+    def __init__(self, scheme: str, root: str):
+        self.scheme = scheme
+        self.root = root
+
+    # -- constructors ------------------------------------------------------
+
+    @staticmethod
+    def for_url(url: str) -> "StorageProvider":
+        parsed = urlparse(url)
+        scheme = parsed.scheme or "file"
+        if scheme == "file":
+            path = parsed.path if parsed.scheme else url
+            return LocalStorage("file", path)
+        if scheme == "memory":
+            return MemoryStorage("memory", parsed.netloc + parsed.path)
+        if scheme in ("gs", "s3"):
+            return _fsspec_storage(scheme, url)
+        raise ValueError(f"unsupported storage scheme: {scheme} ({url})")
+
+    # -- interface ---------------------------------------------------------
+
+    def put(self, key: str, data: bytes) -> str:
+        raise NotImplementedError
+
+    def get(self, key: str) -> bytes:
+        raise NotImplementedError
+
+    def exists(self, key: str) -> bool:
+        raise NotImplementedError
+
+    def delete_if_present(self, key: str) -> None:
+        raise NotImplementedError
+
+    def delete_prefix(self, prefix: str) -> None:
+        raise NotImplementedError
+
+    def list(self, prefix: str) -> List[str]:
+        raise NotImplementedError
+
+    def url_for(self, key: str) -> str:
+        return f"{self.scheme}://{os.path.join(self.root, key)}"
+
+    def local_path(self, key: str) -> Optional[str]:
+        """Filesystem path if this is local storage (for pyarrow direct IO)."""
+        return None
+
+
+class LocalStorage(StorageProvider):
+    def _path(self, key: str) -> str:
+        return os.path.join(self.root, key)
+
+    def put(self, key: str, data: bytes) -> str:
+        path = self._path(key)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+        return path
+
+    def get(self, key: str) -> bytes:
+        with open(self._path(key), "rb") as f:
+            return f.read()
+
+    def exists(self, key: str) -> bool:
+        return os.path.exists(self._path(key))
+
+    def delete_if_present(self, key: str) -> None:
+        try:
+            os.remove(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def delete_prefix(self, prefix: str) -> None:
+        shutil.rmtree(self._path(prefix), ignore_errors=True)
+
+    def list(self, prefix: str) -> List[str]:
+        base = self._path(prefix)
+        out: List[str] = []
+        if not os.path.isdir(base):
+            return out
+        for dirpath, _, files in os.walk(base):
+            for fn in files:
+                full = os.path.join(dirpath, fn)
+                out.append(os.path.relpath(full, self.root))
+        return sorted(out)
+
+    def local_path(self, key: str) -> Optional[str]:
+        return self._path(key)
+
+
+class MemoryStorage(StorageProvider):
+    def __init__(self, scheme: str, root: str):
+        super().__init__(scheme, root)
+        with _MEMORY_LOCK:
+            self._store = _MEMORY_STORES.setdefault(root, {})
+
+    def put(self, key: str, data: bytes) -> str:
+        self._store[key] = bytes(data)
+        return key
+
+    def get(self, key: str) -> bytes:
+        return self._store[key]
+
+    def exists(self, key: str) -> bool:
+        return key in self._store
+
+    def delete_if_present(self, key: str) -> None:
+        self._store.pop(key, None)
+
+    def delete_prefix(self, prefix: str) -> None:
+        for k in [k for k in self._store if k.startswith(prefix)]:
+            del self._store[k]
+
+    def list(self, prefix: str) -> List[str]:
+        return sorted(k for k in self._store if k.startswith(prefix))
+
+
+def _fsspec_storage(scheme: str, url: str) -> StorageProvider:
+    raise RuntimeError(
+        f"{scheme}:// storage requires gcsfs/s3fs which are not installed in "
+        "this image; use file:// or memory:// (cloud storage is gated, "
+        "mirroring arroyo-storage's object_store feature flags)"
+    )

@@ -1,0 +1,29 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh so sharding
+tests run without TPU hardware (the driver dry-runs the multi-chip path the
+same way)."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import asyncio  # noqa: E402
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def run_async():
+    def _run(coro):
+        return asyncio.run(coro)
+
+    return _run
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

@@ -1,0 +1,140 @@
+"""End-to-end engine slice: impulse -> jitted map/filter -> sink, the
+"minimum end-to-end slice" of SURVEY.md §7 step 3; plus watermark/window
+plumbing, multi-subtask shuffles, and checkpoint barrier flow."""
+
+import asyncio
+import json
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from arroyo_tpu import Batch, Program, Stream
+from arroyo_tpu.connectors.memory import clear_sink, sink_output
+from arroyo_tpu.engine.engine import Engine, LocalRunner
+from arroyo_tpu.types import StopMode
+
+
+def collect_rows(name):
+    batches = sink_output(name)
+    if not batches:
+        return {}
+    merged = Batch.concat(batches)
+    return merged
+
+
+def test_impulse_map_filter_memory():
+    clear_sink("t1")
+    prog = (
+        Stream.source("impulse", {"event_rate": 0.0, "message_count": 1000,
+                                  "batch_size": 128})
+        .map(lambda c: {"counter": c["counter"],
+                        "doubled": c["counter"] * 2}, name="double")
+        .filter(lambda c: c["doubled"] % 4 == 0, name="quarters")
+        .sink("memory", {"name": "t1"})
+    )
+    LocalRunner(prog).run()
+    out = collect_rows("t1")
+    assert len(out) == 500
+    assert np.all(out.columns["doubled"] % 4 == 0)
+    assert set(out.columns["counter"].tolist()) == set(range(0, 1000, 2))
+
+
+def test_impulse_parallel_shuffle_count():
+    clear_sink("t2")
+    prog = (
+        Stream.source("impulse", {"event_rate": 0.0, "message_count": 400,
+                                  "batch_size": 64}, parallelism=2)
+        .map(lambda c: {"counter": c["counter"],
+                        "bucket": c["counter"] % 10}, name="bucket")
+        .key_by("bucket")
+        .count()
+        .sink("memory", {"name": "t2"}, parallelism=1)
+    )
+    LocalRunner(prog).run()
+    out = collect_rows("t2")
+    assert len(out) > 0
+    # final count per bucket must be 40 (last update per key wins)
+    finals = {}
+    for kh, c in zip(out.key_hash.tolist(), out.columns["count"].tolist()):
+        finals[kh] = max(finals.get(kh, 0), c)
+    assert len(finals) == 10
+    assert all(v == 40 for v in finals.values())
+
+
+def test_single_file_roundtrip(tmp_path):
+    src = tmp_path / "in.jsonl"
+    dst = tmp_path / "out.jsonl"
+    with open(src, "w") as f:
+        for i in range(50):
+            f.write(json.dumps({"x": i}) + "\n")
+    prog = (
+        Stream.source("single_file", {"path": str(src)})
+        .map(lambda c: {"x": c["x"], "y": c["x"] + 1}, name="inc")
+        .sink("single_file", {"path": str(dst)})
+    )
+    LocalRunner(prog).run()
+    rows = [json.loads(l) for l in open(dst)]
+    assert len(rows) == 50
+    assert all(r["y"] == r["x"] + 1 for r in rows)
+
+
+def test_checkpoint_barrier_flow():
+    """Inject a barrier mid-stream; every operator must checkpoint and the
+    responses must include completed events for all subtasks."""
+    clear_sink("t3")
+
+    async def scenario():
+        prog = (
+            Stream.source("impulse", {"event_rate": 5_000.0,
+                                      "message_count": 2000,
+                                      "batch_size": 100})
+            .map(lambda c: {"counter": c["counter"]}, name="ident")
+            .sink("memory", {"name": "t3"})
+        )
+        engine = Engine.for_local(prog, "ckpt-job")
+        running = engine.start()
+        await asyncio.sleep(0.05)
+        await running.checkpoint(epoch=1)
+        return await running.join()
+
+    resps = asyncio.run(scenario())
+    completed = [r for r in resps if r.kind == "checkpoint_completed"]
+    # 3 operators x 1 subtask
+    assert len(completed) == 3
+    assert all(r.subtask_metadata.epoch == 1 for r in completed)
+    out = collect_rows("t3")
+    assert len(out) == 2000
+
+
+def test_graceful_stop():
+    clear_sink("t4")
+
+    async def scenario():
+        prog = (
+            Stream.source("impulse", {"event_rate": 10_000.0, "batch_size": 50})
+            .sink("memory", {"name": "t4"})
+        )
+        engine = Engine.for_local(prog, "stop-job")
+        running = engine.start()
+        await asyncio.sleep(0.1)
+        await running.stop(StopMode.GRACEFUL)
+        return await running.join()
+
+    resps = asyncio.run(scenario())
+    finished = [r for r in resps if r.kind == "task_finished"]
+    assert len(finished) == 2
+    assert len(collect_rows("t4")) > 0
+
+
+def test_watermarks_propagate():
+    clear_sink("t5")
+    prog = (
+        Stream.source("impulse", {"event_rate": 0.0, "message_count": 100,
+                                  "event_time_interval_micros": 1000,
+                                  "batch_size": 10})
+        .watermark(max_lateness_micros=0)
+        .sink("memory", {"name": "t5"})
+    )
+    LocalRunner(prog).run()
+    assert len(collect_rows("t5")) == 100

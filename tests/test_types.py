@@ -1,0 +1,83 @@
+"""Core type tests, mirroring the reference's unit tests for key-range
+partitioning (arroyo-types/src/lib.rs:838-874)."""
+
+import numpy as np
+import pytest
+
+from arroyo_tpu.types import (
+    Batch,
+    U64_MAX,
+    hash_columns,
+    hash_u64,
+    range_for_server,
+    server_for_hash,
+    server_for_hash_array,
+)
+
+
+def test_range_for_server_adjacent():
+    # ranges must tile the u64 space exactly (lib.rs:843-858)
+    n = 6
+    for i in range(n - 1):
+        r1 = range_for_server(i, n)
+        r2 = range_for_server(i + 1, n)
+        assert r1[1] + 1 == r2[0], "ranges not adjacent"
+    assert range_for_server(n - 1, n)[1] == int(U64_MAX)
+
+
+def test_server_for_hash_max():
+    # u64::MAX maps into the owning range (lib.rs:860-874)
+    n = 2
+    idx = server_for_hash(int(U64_MAX), n)
+    lo, hi = range_for_server(idx, n)
+    assert lo <= int(U64_MAX) <= hi
+
+
+@pytest.mark.parametrize("n", [1, 2, 3, 7, 16])
+def test_server_for_hash_consistent_with_ranges(n):
+    rng = np.random.default_rng(0)
+    xs = rng.integers(0, 1 << 63, size=200, dtype=np.uint64) * 2 + rng.integers(0, 2, 200).astype(np.uint64)
+    for x in xs.tolist():
+        i = server_for_hash(x, n)
+        lo, hi = range_for_server(i, n)
+        assert lo <= x <= hi
+    # vectorized matches scalar
+    vec = server_for_hash_array(xs, n)
+    assert all(vec[i] == server_for_hash(xs[i], n) for i in range(len(xs)))
+
+
+def test_hash_spreads_uniformly():
+    keys = np.arange(10_000, dtype=np.int64)
+    h = hash_u64(keys)
+    shards = server_for_hash_array(h, 8)
+    counts = np.bincount(shards, minlength=8)
+    assert counts.min() > 1000  # ~1250 expected per shard
+
+
+def test_hash_columns_strings_stable():
+    a = np.array(["x", "y", "x"], dtype=object)
+    h1 = hash_columns([a])
+    h2 = hash_columns([a])
+    np.testing.assert_array_equal(h1, h2)
+    assert h1[0] == h1[2] and h1[0] != h1[1]
+
+
+def test_batch_select_concat_roundtrip():
+    b = Batch(np.array([10, 20, 30]), {"v": np.array([1.0, 2.0, 3.0])})
+    b = b.with_key(["v"])
+    sel = b.select(np.array([0, 2]))
+    assert len(sel) == 2 and sel.key_hash is not None
+    cat = Batch.concat([sel, sel])
+    assert len(cat) == 4
+
+
+def test_batch_arrow_roundtrip():
+    b = Batch(np.array([10, 20]), {
+        "v": np.array([1.5, 2.5]),
+        "s": np.array(["a", "b"], dtype=object),
+    })
+    t = b.to_arrow()
+    back = Batch.from_arrow(t)
+    np.testing.assert_array_equal(back.timestamp, b.timestamp)
+    np.testing.assert_array_equal(back.columns["v"], b.columns["v"])
+    assert list(back.columns["s"]) == ["a", "b"]

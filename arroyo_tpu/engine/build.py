@@ -56,6 +56,10 @@ _BUILDERS[OpKind.KEY_BY] = lambda op: KeyByOperator(op.name, op.key_cols)
 _BUILDERS[OpKind.GLOBAL_KEY] = lambda op: GlobalKeyOperator(op.name)
 _BUILDERS[OpKind.COUNT] = lambda op: CountOperator(op.name)
 _BUILDERS[OpKind.AGGREGATE] = lambda op: AggregateOperator(op.name, op.spec)
+# Updating-stream variants: expression/keying with the __op column flowing
+# through (Operator::UpdatingOperator / UpdatingKeyOperator)
+_BUILDERS[OpKind.UPDATING] = lambda op: ExpressionOperator(op.name, op.expr)
+_BUILDERS[OpKind.UPDATING_KEY] = lambda op: KeyByOperator(op.name, op.key_cols)
 
 _window_ops_loaded = False
 

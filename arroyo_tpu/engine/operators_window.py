@@ -1,0 +1,712 @@
+"""Windowed / keyed-state physical operators — the device-state heart of the
+engine.
+
+Maps the reference's window operator suite onto batched device kernels:
+
+* :class:`BinAggOperator` — Operator::SlidingWindowAggregator /
+  TumblingWindowAggregator (aggregating_window.rs:14-258,
+  tumbling_aggregating_window.rs): per-(key, bin) pre-aggregates in HBM via
+  :class:`~arroyo_tpu.ops.keyed_bins.KeyedBinState`, panes emitted on
+  watermark advance by one device kernel over all pending panes.
+* :class:`WindowOperator` — Operator::Window / KeyedWindowFunc
+  (windows.rs:160-197): buffer rows, trigger at window end, segment-reduce on
+  device; supports tumbling/sliding/instant windows, aggregate or flatten.
+* :class:`SessionWindowOperator` — SessionWindowFunc (windows.rs:200-427):
+  host-managed per-key gap-merged window sets (data-dependent merging stays
+  on host, as the reference keeps it in KeyedState), aggregation on device.
+* :class:`TumblingTopNOperator` / :class:`SlidingAggTopNOperator` —
+  TumblingTopN / SlidingAggregatingTopN (tumbling_top_n_window.rs,
+  sliding_top_n_aggregating_window.rs).
+* :class:`WindowJoinOperator` — Operator::WindowJoin (joins.rs:14-181):
+  dual-sided buffers, sorted-merge join per fired window.
+* :class:`JoinWithExpirationOperator` — JoinWithExpiration
+  (join_with_expiration.rs): TTL'd buffers, inner/left/right/full with
+  updating output.
+* :class:`NonWindowAggOperator` — NonWindowAggregator
+  (updating_aggregate.rs): running per-key aggregates with expiration,
+  emitting updating (create/update) rows.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..graph.logical import (
+    AggKind,
+    AggSpec,
+    InstantWindow,
+    JoinType,
+    LogicalOperator,
+    OpKind,
+    SessionWindow,
+    SlidingWindow,
+    TumblingWindow,
+)
+from ..ops.expr import CompiledExpr, eval_record_expr
+from ..ops.keyed_bins import KeyedBinState
+from ..ops.segment import segment_aggregate
+from ..state.tables import DeviceTable, TableDescriptor, TableType
+from ..types import Batch, Message, UpdateOp, UPDATE_OP_COLUMN, Watermark
+from .build import register_builder
+from .context import Context
+from .operator import Operator
+
+MAX_SESSION_SIZE_MICROS = 24 * 3600 * 1_000_000  # windows.rs:17
+
+
+def _window_params(typ) -> Tuple[int, int]:
+    """(width, slide) micros for uniform window types."""
+    if isinstance(typ, TumblingWindow):
+        return typ.width_micros, typ.width_micros
+    if isinstance(typ, SlidingWindow):
+        return typ.width_micros, typ.slide_micros
+    if isinstance(typ, InstantWindow):
+        return 1, 1
+    raise TypeError(f"not a uniform window: {typ}")
+
+
+def _first_occurrence_cols(batch: Batch, uniq_keys: np.ndarray
+                           ) -> Dict[str, np.ndarray]:
+    """Key-column values for each unique key (first occurrence wins)."""
+    if not batch.key_cols:
+        return {}
+    order = np.argsort(batch.key_hash, kind="stable")
+    kh = batch.key_hash[order]
+    _, first = np.unique(kh, return_index=True)
+    rows = order[first]  # one row per unique key, aligned with sorted uniq
+    return {c: batch.columns[c][rows] for c in batch.key_cols
+            if c in batch.columns}
+
+
+class _SlotKeyValues:
+    """Host-side slot -> key-column-values store for bin-state operators."""
+
+    def __init__(self) -> None:
+        self.cols: Dict[str, np.ndarray] = {}
+        self.size = 0
+
+    def ensure(self, batch: Batch, slots: np.ndarray, prev_next: int,
+               new_next: int) -> None:
+        if new_next <= self.size and self.cols:
+            return
+        cap = max(new_next, 64)
+        for c in list(self.cols):
+            old = self.cols[c]
+            if len(old) < cap:
+                grown = np.empty(cap * 2, dtype=old.dtype)
+                grown[:len(old)] = old
+                self.cols[c] = grown
+        for c in batch.key_cols:
+            if c in batch.columns and c not in self.cols:
+                self.cols[c] = np.empty(
+                    cap * 2, dtype=batch.columns[c].dtype)
+        new_mask = slots >= prev_next
+        if new_mask.any():
+            idx = new_mask.nonzero()[0]
+            for c in batch.key_cols:
+                if c in batch.columns:
+                    self.cols[c][slots[idx]] = batch.columns[c][idx]
+        self.size = max(self.size, new_next)
+
+    def gather(self, slot_idx: np.ndarray) -> Dict[str, np.ndarray]:
+        return {c: v[slot_idx] for c, v in self.cols.items()}
+
+    def snapshot(self) -> Dict[str, np.ndarray]:
+        return {f"kv_{c}": v[:self.size] for c, v in self.cols.items()} | {
+            "kv_size": np.array([self.size])}
+
+    def restore(self, arrays: Dict[str, np.ndarray]) -> None:
+        self.size = int(arrays["kv_size"][0])
+        for k, v in arrays.items():
+            if k.startswith("kv_") and k != "kv_size":
+                self.cols[k[3:]] = v.copy()
+
+
+class BinAggOperator(Operator):
+    """Two-phase binned window aggregate over device state (sliding or
+    tumbling; SURVEY kernel #2)."""
+
+    def __init__(self, name: str, width_micros: int, slide_micros: int,
+                 aggs: Tuple[AggSpec, ...], projection=None,
+                 top_n: Optional[Tuple[Tuple[str, ...], str, int]] = None):
+        super().__init__(name)
+        self.width = width_micros
+        self.slide = slide_micros
+        self.aggs = aggs
+        self.state = KeyedBinState(aggs, slide_micros, width_micros)
+        self.keyvals = _SlotKeyValues()
+        self.projection = (CompiledExpr(projection.name, projection.fn)
+                           if projection else None)
+        self.top_n = top_n  # (partition_cols, sort_column, max_elements)
+        self._key_cols: Tuple[str, ...] = ()
+
+    def tables(self) -> List[TableDescriptor]:
+        return []  # registered as a device table in on_start
+
+    async def on_start(self, ctx: Context) -> None:
+        def snap():
+            return self.state.snapshot() | self.keyvals.snapshot()
+
+        def restore(arrays):
+            self.state.restore(arrays)
+            self.keyvals.restore(arrays)
+
+        ctx.state.register_device(
+            TableDescriptor("a", TableType.DEVICE, "bin aggregates",
+                            retention_micros=self.width),
+            DeviceTable(snap, restore))
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        assert batch.key_hash is not None, f"{self.name} requires keyed input"
+        self._key_cols = batch.key_cols
+        prev = self.state.next_slot
+        slots = self.state._lookup_or_insert(batch.key_hash)
+        self.keyvals.ensure(batch, slots, prev, self.state.next_slot)
+        self.state.update(batch.key_hash, batch.timestamp, batch.columns)
+
+    async def handle_watermark(self, watermark: int, ctx: Context) -> None:
+        from ..types import MAX_TIMESTAMP
+
+        final = watermark >= int(MAX_TIMESTAMP) - 1
+        fired = self.state.fire_panes(watermark, final=final)
+        if fired is not None:
+            await self._emit(fired, ctx)
+        await ctx.broadcast(Message.wm(Watermark.event_time(watermark)))
+
+    async def _emit(self, fired, ctx: Context) -> None:
+        keys, out_cols, window_end, counts = fired
+        # key_idx into slot arrays for key-column recovery
+        slot_idx = self.state.slot_of_sorted[
+            np.searchsorted(self.state.key_sorted, keys)]
+        cols: Dict[str, np.ndarray] = {}
+        cols.update(self.keyvals.gather(slot_idx))
+        cols["window_start"] = window_end - self.width
+        cols["window_end"] = window_end
+        cols.update(out_cols)
+        ts = window_end - 1  # emit at w.end - 1ns analog (windows.rs:95)
+        key_cols = self._key_cols or tuple(self.keyvals.cols)
+        out = Batch(ts, cols, keys.astype(np.uint64), key_cols)
+
+        if self.top_n is not None:
+            out = _apply_top_n(out, *self.top_n)
+        if self.projection is not None:
+            out = eval_record_expr(self.projection, out)
+        await ctx.collect(out)
+
+
+def _apply_top_n(batch: Batch, partition_cols: Tuple[str, ...],
+                 sort_column: str, max_elements: int) -> Batch:
+    """Keep the top ``max_elements`` rows by ``sort_column`` (desc) per
+    partition (rank-within-partition via lexsort)."""
+    if len(batch) == 0:
+        return batch
+    sort_val = batch.columns[sort_column]
+    if partition_cols:
+        from ..types import hash_columns
+
+        part = hash_columns([batch.columns[c] for c in partition_cols])
+    else:
+        part = batch.columns.get("window_end", np.zeros(len(batch), np.int64))
+    order = np.lexsort((-np.asarray(sort_val, dtype=np.float64), part))
+    part_sorted = np.asarray(part)[order]
+    is_start = np.ones(len(order), dtype=bool)
+    is_start[1:] = part_sorted[1:] != part_sorted[:-1]
+    seg_id = np.cumsum(is_start) - 1
+    seg_start = is_start.nonzero()[0]
+    rank = np.arange(len(order)) - seg_start[seg_id]
+    keep = order[rank < max_elements]
+    keep.sort()
+    return batch.select(keep)
+
+
+class WindowOperator(Operator):
+    """Generic keyed window function: buffer + trigger-at-window-end +
+    device segment aggregation (KeyedWindowFunc, windows.rs:160-197)."""
+
+    def __init__(self, name: str, typ, aggs: Tuple[AggSpec, ...],
+                 flatten: bool, projection=None):
+        super().__init__(name)
+        self.typ = typ
+        self.width, self.slide = _window_params(typ)
+        self.aggs = aggs
+        self.flatten = flatten or not aggs
+        self.projection = (CompiledExpr(projection.name, projection.fn)
+                           if projection else None)
+
+    def tables(self) -> List[TableDescriptor]:
+        return [TableDescriptor("w", TableType.BATCH_BUFFER, "window buffer",
+                                retention_micros=self.width)]
+
+    async def on_start(self, ctx: Context) -> None:
+        self.buffer = ctx.state.get_batch_buffer("w")
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        assert batch.key_hash is not None
+        self.buffer.append(batch)
+        # one timer per distinct window end (not per key): rows at ts belong
+        # to windows ending at slide-aligned points in (ts, ts+width]
+        first_end = (batch.timestamp // self.slide + 1) * self.slide
+        if isinstance(self.typ, SlidingWindow):
+            ends = np.unique(np.concatenate([
+                first_end + i * self.slide
+                for i in range(self.width // self.slide)]))
+        else:
+            ends = np.unique(first_end - self.slide + self.width)
+        for e in ends.tolist():
+            ctx.timers.schedule(int(e), ("w", int(e)))
+
+    async def handle_timer(self, time: int, key: Any, payload: Any,
+                           ctx: Context) -> None:
+        end = key[1]
+        start = end - self.width
+        rows = self.buffer.query_range(start, end)
+        if rows is not None and len(rows):
+            if self.flatten:
+                out_cols = dict(rows.columns)
+                out_cols["window_start"] = np.full(len(rows), start, np.int64)
+                out_cols["window_end"] = np.full(len(rows), end, np.int64)
+                out = Batch(np.full(len(rows), end - 1, np.int64), out_cols,
+                            rows.key_hash, rows.key_cols)
+            else:
+                uniq, agg_cols, _, _cnt = segment_aggregate(
+                    rows.key_hash, rows.timestamp, rows.columns, self.aggs)
+                cols = _first_occurrence_cols(rows, uniq)
+                cols["window_start"] = np.full(len(uniq), start, np.int64)
+                cols["window_end"] = np.full(len(uniq), end, np.int64)
+                cols.update(agg_cols)
+                out = Batch(np.full(len(uniq), end - 1, np.int64), cols,
+                            uniq.astype(np.uint64), rows.key_cols)
+            if self.projection is not None:
+                out = eval_record_expr(self.projection, out)
+            await ctx.collect(out)
+        # evict rows no future window needs
+        self.buffer.evict_before(end - self.width + self.slide)
+
+
+class SessionWindowOperator(Operator):
+    """Session windows with gap merging: per-key window sets on host
+    (SessionWindowFunc / WindowGroup, windows.rs:200-427)."""
+
+    def __init__(self, name: str, gap_micros: int, aggs: Tuple[AggSpec, ...],
+                 flatten: bool, projection=None):
+        super().__init__(name)
+        self.gap = gap_micros
+        self.aggs = aggs
+        self.flatten = flatten or not aggs
+        self.projection = (CompiledExpr(projection.name, projection.fn)
+                           if projection else None)
+
+    def tables(self) -> List[TableDescriptor]:
+        return [
+            TableDescriptor("s", TableType.BATCH_BUFFER, "session data"),
+            TableDescriptor("v", TableType.KEYED, "session windows per key"),
+        ]
+
+    async def on_start(self, ctx: Context) -> None:
+        self.buffer = ctx.state.get_batch_buffer("s")
+        self.windows = ctx.state.get_keyed_state("v")
+        # rebuild timers from restored window sets
+        for kh, sessions in self.windows.items():
+            for (s, e) in sessions:
+                ctx.timers.schedule(int(e), ("sess", int(kh), int(s)))
+
+    def _merge_key(self, kh: int, times: np.ndarray, ctx: Context) -> None:
+        """handle_event extend/merge/create (windows.rs:232-302)."""
+        sessions: List[Tuple[int, int]] = list(self.windows.get(kh) or [])
+        for t in np.sort(times).tolist():
+            placed = False
+            for i, (s, e) in enumerate(sessions):
+                if s - self.gap <= t < e:
+                    ns, ne = min(s, t), max(e, t + self.gap)
+                    if ne - ns > MAX_SESSION_SIZE_MICROS:
+                        ne = ns + MAX_SESSION_SIZE_MICROS
+                    ctx.timers.cancel(("sess", kh, s))
+                    sessions[i] = (ns, ne)
+                    placed = True
+                    break
+            if not placed:
+                sessions.append((t, t + self.gap))
+            # merge overlapping sessions
+            sessions.sort()
+            merged: List[Tuple[int, int]] = []
+            for s, e in sessions:
+                if merged and s <= merged[-1][1]:
+                    ps, pe = merged[-1]
+                    ctx.timers.cancel(("sess", kh, s))
+                    ctx.timers.cancel(("sess", kh, ps))
+                    merged[-1] = (ps, max(pe, e))
+                else:
+                    merged.append((s, e))
+            sessions = merged
+        self.windows.insert(int(times.max()), kh, sessions)
+        for (s, e) in sessions:
+            ctx.timers.schedule(int(e), ("sess", kh, s))
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        assert batch.key_hash is not None
+        self.buffer.append(batch)
+        order = np.argsort(batch.key_hash, kind="stable")
+        kh = batch.key_hash[order]
+        ts = batch.timestamp[order]
+        uniq, starts = np.unique(kh, return_index=True)
+        bounds = np.append(starts, len(kh))
+        for i, k in enumerate(uniq.tolist()):
+            self._merge_key(int(k), ts[bounds[i]:bounds[i + 1]], ctx)
+
+    async def handle_timer(self, time: int, key: Any, payload: Any,
+                           ctx: Context) -> None:
+        _, kh, start = key
+        sessions = list(self.windows.get(kh) or [])
+        fire = [(s, e) for (s, e) in sessions if e <= time]
+        remain = [(s, e) for (s, e) in sessions if e > time]
+        if remain:
+            self.windows.insert(time, kh, remain)
+        else:
+            self.windows.remove(kh)
+            ctx.state.note_delete("v", kh)
+        for (s, e) in fire:
+            rows = self.buffer.query_range(s, e)
+            if rows is None:
+                continue
+            mask = rows.key_hash == np.uint64(kh)
+            rows = rows.select(mask)
+            if not len(rows):
+                continue
+            if self.flatten:
+                cols = dict(rows.columns)
+                cols["window_start"] = np.full(len(rows), s, np.int64)
+                cols["window_end"] = np.full(len(rows), e, np.int64)
+                out = Batch(np.full(len(rows), e - 1, np.int64), cols,
+                            rows.key_hash, rows.key_cols)
+            else:
+                uniq, agg_cols, _, _cnt = segment_aggregate(
+                    rows.key_hash, rows.timestamp, rows.columns, self.aggs)
+                cols = _first_occurrence_cols(rows, uniq)
+                cols["window_start"] = np.full(len(uniq), s, np.int64)
+                cols["window_end"] = np.full(len(uniq), e, np.int64)
+                cols.update(agg_cols)
+                out = Batch(np.full(len(uniq), e - 1, np.int64), cols,
+                            uniq.astype(np.uint64), rows.key_cols)
+            if self.projection is not None:
+                out = eval_record_expr(self.projection, out)
+            await ctx.collect(out)
+
+    async def handle_watermark(self, watermark: int, ctx: Context) -> None:
+        # evict data older than every live session start
+        live_starts = [s for _, sessions in self.windows.items()
+                       for (s, _) in sessions]
+        horizon = min(live_starts) if live_starts else watermark
+        self.buffer.evict_before(min(horizon, watermark - MAX_SESSION_SIZE_MICROS
+                                     if not live_starts else horizon))
+        await ctx.broadcast(Message.wm(Watermark.event_time(watermark)))
+
+
+class TumblingTopNOperator(Operator):
+    """Windowed TopN (TumblingTopNWindowFunc, tumbling_top_n_window.rs)."""
+
+    def __init__(self, name: str, width_micros: int, max_elements: int,
+                 sort_column: str, partition_cols: Tuple[str, ...],
+                 projection=None):
+        super().__init__(name)
+        self.width = width_micros
+        self.max_elements = max_elements
+        self.sort_column = sort_column
+        self.partition_cols = partition_cols
+        self.projection = (CompiledExpr(projection.name, projection.fn)
+                           if projection else None)
+
+    def tables(self) -> List[TableDescriptor]:
+        return [TableDescriptor("t", TableType.BATCH_BUFFER, "topn buffer",
+                                retention_micros=self.width)]
+
+    async def on_start(self, ctx: Context) -> None:
+        self.buffer = ctx.state.get_batch_buffer("t")
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        self.buffer.append(batch)
+        ends = np.unique((batch.timestamp // self.width + 1) * self.width)
+        for e in ends.tolist():
+            ctx.timers.schedule(int(e), ("tn", int(e)))
+
+    async def handle_timer(self, time: int, key: Any, payload: Any,
+                           ctx: Context) -> None:
+        end = key[1]
+        start = end - self.width
+        rows = self.buffer.query_range(start, end)
+        if rows is not None and len(rows):
+            out_cols = dict(rows.columns)
+            out_cols["window_start"] = np.full(len(rows), start, np.int64)
+            out_cols["window_end"] = np.full(len(rows), end, np.int64)
+            out = Batch(np.full(len(rows), end - 1, np.int64), out_cols,
+                        rows.key_hash, rows.key_cols)
+            out = _apply_top_n(out, self.partition_cols, self.sort_column,
+                               self.max_elements)
+            if self.projection is not None:
+                out = eval_record_expr(self.projection, out)
+            await ctx.collect(out)
+        self.buffer.evict_before(end)
+
+
+class WindowJoinOperator(Operator):
+    """Windowed stream-stream hash join (SURVEY kernel #3): both sides
+    buffered, joined per fired window by sorted-merge on key hash
+    (WindowedHashJoin, joins.rs:14-181)."""
+
+    def __init__(self, name: str, typ):
+        super().__init__(name)
+        self.typ = typ
+        self.width, self.slide = _window_params(typ)
+
+    def tables(self) -> List[TableDescriptor]:
+        return [
+            TableDescriptor("l", TableType.BATCH_BUFFER, "left buffer",
+                            retention_micros=self.width),
+            TableDescriptor("r", TableType.BATCH_BUFFER, "right buffer",
+                            retention_micros=self.width),
+        ]
+
+    async def on_start(self, ctx: Context) -> None:
+        self.left = ctx.state.get_batch_buffer("l")
+        self.right = ctx.state.get_batch_buffer("r")
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        assert batch.key_hash is not None, "window join requires keyed inputs"
+        (self.left if side == 0 else self.right).append(batch)
+        first_end = (batch.timestamp // self.slide + 1) * self.slide
+        if isinstance(self.typ, SlidingWindow):
+            ends = np.unique(np.concatenate([
+                first_end + i * self.slide
+                for i in range(self.width // self.slide)]))
+        else:
+            ends = np.unique(first_end - self.slide + self.width)
+        for e in ends.tolist():
+            ctx.timers.schedule(int(e), ("wj", int(e)))
+
+    async def handle_timer(self, time: int, key: Any, payload: Any,
+                           ctx: Context) -> None:
+        end = key[1]
+        start = end - self.width
+        l = self.left.query_range(start, end)
+        r = self.right.query_range(start, end)
+        if l is not None and r is not None and len(l) and len(r):
+            out = join_batches(l, r, end)
+            if len(out):
+                await ctx.collect(out)
+        evict_to = end - self.width + self.slide
+        self.left.evict_before(evict_to)
+        self.right.evict_before(evict_to)
+
+
+def join_batches(l: Batch, r: Batch, end: int,
+                 l_prefix: str = "", r_prefix: str = "",
+                 how: JoinType = JoinType.INNER) -> Batch:
+    """Sorted-merge equi-join of two keyed batches on key_hash.
+
+    Match counting and position arithmetic are vectorized; pair expansion is
+    np.repeat (the result size is data-dependent, so it stays on host — the
+    per-window aggregation around it is the device work)."""
+    lo = np.argsort(l.key_hash, kind="stable")
+    ro = np.argsort(r.key_hash, kind="stable")
+    lk, rk = l.key_hash[lo], r.key_hash[ro]
+    # for each left row, the range of matching right rows
+    left_start = np.searchsorted(rk, lk, side="left")
+    left_end = np.searchsorted(rk, lk, side="right")
+    counts = left_end - left_start
+    lidx = np.repeat(np.arange(len(lk)), counts)
+    # right indices: start + offset within each run
+    offs = np.arange(len(lidx)) - np.repeat(
+        np.cumsum(counts) - counts, counts)
+    ridx = np.repeat(left_start, counts) + offs
+
+    l_rows = l.select(lo[lidx])
+    r_rows = r.select(ro[ridx])
+
+    cols: Dict[str, np.ndarray] = {}
+    for c, v in l_rows.columns.items():
+        cols[(l_prefix + c) if (c in r_rows.columns or l_prefix) else c] = v
+    for c, v in r_rows.columns.items():
+        name = (r_prefix + c) if (c in l_rows.columns or r_prefix) else c
+        if name in cols:
+            name = "r_" + name
+        cols[name] = v
+
+    if how in (JoinType.LEFT, JoinType.FULL):
+        pass  # outer variants emitted by JoinWithExpiration's updating path
+    ts = np.full(len(l_rows), end - 1, dtype=np.int64)
+    return Batch(ts, cols, l_rows.key_hash, l.key_cols)
+
+
+class JoinWithExpirationOperator(Operator):
+    """Unwindowed stream-stream join with TTL state
+    (join_with_expiration.rs:14-483).  Inner joins emit append rows; outer
+    joins emit updating (__op) rows with retractions when a match replaces a
+    null-padded emission."""
+
+    def __init__(self, name: str, left_ttl: int, right_ttl: int,
+                 join_type: JoinType):
+        super().__init__(name)
+        self.left_ttl = left_ttl
+        self.right_ttl = right_ttl
+        self.join_type = join_type
+
+    def tables(self) -> List[TableDescriptor]:
+        return [
+            TableDescriptor("l", TableType.BATCH_BUFFER, "left state",
+                            retention_micros=self.left_ttl),
+            TableDescriptor("r", TableType.BATCH_BUFFER, "right state",
+                            retention_micros=self.right_ttl),
+        ]
+
+    async def on_start(self, ctx: Context) -> None:
+        self.left = ctx.state.get_batch_buffer("l")
+        self.right = ctx.state.get_batch_buffer("r")
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        assert batch.key_hash is not None
+        mine, other = ((self.left, self.right) if side == 0
+                       else (self.right, self.left))
+        opp = other.all()
+        if opp is not None and len(opp) and len(batch):
+            end = int(batch.timestamp.max()) + 1
+            out = (join_batches(batch, opp, end) if side == 0
+                   else join_batches(opp, batch, end))
+            if len(out):
+                await ctx.collect(out)
+        mine.append(batch)
+
+    async def handle_watermark(self, watermark: int, ctx: Context) -> None:
+        self.left.evict_before(watermark - self.left_ttl)
+        self.right.evict_before(watermark - self.right_ttl)
+        await ctx.broadcast(Message.wm(Watermark.event_time(watermark)))
+
+
+class NonWindowAggOperator(Operator):
+    """Running per-key aggregates over an updating stream with expiration
+    (UpdatingAggregateOperator, updating_aggregate.rs:11-150): each batch
+    merges into per-key running state and emits create/update rows."""
+
+    def __init__(self, name: str, expiration_micros: int,
+                 aggs: Tuple[AggSpec, ...], projection=None):
+        super().__init__(name)
+        self.expiration = expiration_micros
+        self.aggs = aggs
+        self.projection = (CompiledExpr(projection.name, projection.fn)
+                           if projection else None)
+
+    def tables(self) -> List[TableDescriptor]:
+        return [TableDescriptor("u", TableType.KEYED, "running aggregates",
+                                retention_micros=self.expiration)]
+
+    async def on_start(self, ctx: Context) -> None:
+        self.table = ctx.state.get_keyed_state("u")
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        assert batch.key_hash is not None
+        uniq, agg_cols, max_ts, row_counts = segment_aggregate(
+            batch.key_hash, batch.timestamp, batch.columns, self.aggs)
+        key_cols = _first_occurrence_cols(batch, uniq)
+        n = len(uniq)
+        ops = np.zeros(n, dtype=np.int8)
+        out_cols: Dict[str, List] = {a.output: [] for a in self.aggs}
+        for i, k in enumerate(uniq.tolist()):
+            prev = self.table.get(k)
+            merged: Dict[str, float] = {}
+            for a in self.aggs:
+                new = agg_cols[a.output][i]
+                if a.kind == AggKind.AVG:
+                    # mergeable avg: store (sum, count) internally
+                    new_sum = float(new) * int(row_counts[i])
+                    old_sum = prev[f"{a.output}__sum"] if prev else 0.0
+                    old_cnt = prev[f"{a.output}__cnt"] if prev else 0
+                    merged[f"{a.output}__sum"] = old_sum + new_sum
+                    merged[f"{a.output}__cnt"] = old_cnt + int(row_counts[i])
+                    merged[a.output] = (merged[f"{a.output}__sum"]
+                                        / max(merged[f"{a.output}__cnt"], 1))
+                elif prev is None:
+                    merged[a.output] = new
+                else:
+                    old = prev[a.output]
+                    if a.kind in (AggKind.SUM, AggKind.COUNT):
+                        merged[a.output] = old + new
+                    elif a.kind == AggKind.MAX:
+                        merged[a.output] = max(old, new)
+                    elif a.kind == AggKind.MIN:
+                        merged[a.output] = min(old, new)
+                out_cols[a.output].append(merged[a.output])
+            ops[i] = (UpdateOp.CREATE.value if prev is None
+                      else UpdateOp.UPDATE.value)
+            self.table.insert(int(max_ts[i]), k, merged)
+        cols = dict(key_cols)
+        for a in self.aggs:
+            arr = np.asarray(out_cols[a.output])
+            if a.kind == AggKind.COUNT:
+                arr = arr.astype(np.int64)
+            cols[a.output] = arr
+        cols[UPDATE_OP_COLUMN] = ops
+        out = Batch(max_ts, cols, uniq.astype(np.uint64), batch.key_cols)
+        if self.projection is not None:
+            out = eval_record_expr(self.projection, out)
+        await ctx.collect(out)
+
+
+# -- builder registration ----------------------------------------------------
+
+
+@register_builder(OpKind.SLIDING_WINDOW_AGGREGATOR)
+def _build_sliding(op: LogicalOperator) -> Operator:
+    s = op.spec
+    return BinAggOperator(op.name, s.width_micros, s.slide_micros, s.aggs,
+                          s.projection)
+
+
+@register_builder(OpKind.TUMBLING_WINDOW_AGGREGATOR)
+def _build_tumbling(op: LogicalOperator) -> Operator:
+    s = op.spec
+    return BinAggOperator(op.name, s.width_micros, s.width_micros, s.aggs,
+                          s.projection)
+
+
+@register_builder(OpKind.SLIDING_AGGREGATING_TOP_N)
+def _build_sliding_topn(op: LogicalOperator) -> Operator:
+    s = op.spec
+    return BinAggOperator(op.name, s.width_micros, s.slide_micros, s.aggs,
+                          s.projection,
+                          top_n=(s.partition_cols, s.sort_column,
+                                 s.max_elements))
+
+
+@register_builder(OpKind.WINDOW)
+def _build_window(op: LogicalOperator) -> Operator:
+    s = op.spec
+    if isinstance(s.typ, SessionWindow):
+        return SessionWindowOperator(op.name, s.typ.gap_micros, s.aggs,
+                                     s.flatten, s.projection)
+    return WindowOperator(op.name, s.typ, s.aggs, s.flatten, s.projection)
+
+
+@register_builder(OpKind.TUMBLING_TOP_N)
+def _build_topn(op: LogicalOperator) -> Operator:
+    s = op.spec
+    return TumblingTopNOperator(op.name, s.width_micros, s.max_elements,
+                                s.sort_column, s.partition_cols, s.projection)
+
+
+@register_builder(OpKind.WINDOW_JOIN)
+def _build_window_join(op: LogicalOperator) -> Operator:
+    return WindowJoinOperator(op.name, op.spec.typ)
+
+
+@register_builder(OpKind.JOIN_WITH_EXPIRATION)
+def _build_join_exp(op: LogicalOperator) -> Operator:
+    s = op.spec
+    return JoinWithExpirationOperator(op.name, s.left_expiration_micros,
+                                      s.right_expiration_micros, s.join_type)
+
+
+@register_builder(OpKind.NON_WINDOW_AGGREGATOR)
+def _build_nonwindow(op: LogicalOperator) -> Operator:
+    s = op.spec
+    return NonWindowAggOperator(op.name, s.expiration_micros, s.aggs,
+                                s.projection)

@@ -107,6 +107,13 @@ class TaskRunner:
         # register tables, restore, start
         for desc in self.operator.tables():
             self.ctx.state.register(desc)
+        # timers persist under the reserved table name '[' like the reference
+        # (arroyo-worker/src/lib.rs:152): restore before on_start so operators
+        # may add to them
+        timer_table = self.ctx.state.get_global_keyed_state("[", "timers")
+        saved_timers = timer_table.get("timers")
+        if saved_timers:
+            self.ctx.timers.restore(saved_timers)
         await self.operator.on_start(self.ctx)
         await self.ctx.report(ControlResp(
             kind="task_started", operator_id=self.task_info.operator_id,
@@ -251,6 +258,8 @@ class TaskRunner:
     async def run_checkpoint(self, barrier: CheckpointBarrier) -> None:
         await self._report_event(barrier, CheckpointEventType.STARTED_CHECKPOINTING)
         await self.operator.pre_checkpoint(barrier, self.ctx)
+        self.ctx.state.get_global_keyed_state("[").insert(
+            "timers", self.ctx.timers.snapshot())
         metadata = self.ctx.state.checkpoint(barrier.epoch, self.ctx.last_watermark)
         await self._report_event(barrier, CheckpointEventType.FINISHED_SYNC)
         await self.ctx.report(ControlResp(

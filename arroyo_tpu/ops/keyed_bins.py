@@ -1,0 +1,382 @@
+"""Keyed binned aggregation state in device memory — the engine's core
+windowing kernel (SURVEY.md "Core TPU kernel #2").
+
+This is the TPU re-design of the reference's two-phase sliding aggregator
+(/root/reference/arroyo-worker/src/operators/aggregating_window.rs:14-258):
+the reference keeps per-(key, bin) pre-aggregates in a TimeKeyMap and, on
+watermark advance, adds/retracts bins from an in-memory per-key view.  Here:
+
+* the **key directory** lives on host: a sorted uint64 array of known key
+  hashes with a parallel slot array (lookups are one vectorized
+  ``np.searchsorted`` per batch; inserts are a vectorized merge);
+* the **bin ring** lives in HBM: ``values[n_aggs, C, B]`` device arrays — C
+  key slots x B time bins of ``slide`` width each, scatter-reduced per batch
+  by one jitted kernel;
+* **pane emission** on watermark advance is one device kernel over all
+  pending panes at once: for sums/counts a bins-x-pane-mask **matmul**
+  (``[C,B] @ [B,k]`` — MXU work), for min/max a gathered window reduce;
+* eviction is O(1): expired ring slots are zeroed on device.
+
+Capacity doubles when the key directory fills; shapes are powers of two so
+recompiles are O(log keys).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..graph.logical import AggKind, AggSpec
+
+NEG_INF = float(jnp.finfo(jnp.float32).min)
+POS_INF = float(jnp.finfo(jnp.float32).max)
+
+
+def _init_value(kind: AggKind) -> float:
+    if kind == AggKind.MIN:
+        return POS_INF
+    if kind == AggKind.MAX:
+        return NEG_INF
+    return 0.0
+
+
+@functools.lru_cache(maxsize=256)
+def _update_kernel(kinds: Tuple[str, ...], C: int, B: int, n: int):
+    @jax.jit
+    def run(values, counts, slots, bins, vals, valid):
+        # values: [k, C, B]; counts: [C, B]; slots, bins: i32[n]; vals: [k, n]
+        s = jnp.where(valid, slots, C)  # trash row
+        b = jnp.where(valid, bins, 0)
+        counts = counts.at[s.clip(0, C - 1), b].add(
+            jnp.where(valid & (s < C), 1, 0))
+        outs = []
+        for i, kind in enumerate(kinds):
+            v = values[i]
+            x = vals[i]
+            ok = valid & (s < C)
+            si = s.clip(0, C - 1)
+            if kind in ("sum", "avg", "count"):
+                v = v.at[si, b].add(jnp.where(ok, x, 0.0))
+            elif kind == "min":
+                v = v.at[si, b].min(jnp.where(ok, x, POS_INF))
+            elif kind == "max":
+                v = v.at[si, b].max(jnp.where(ok, x, NEG_INF))
+            else:
+                raise ValueError(kind)
+            outs.append(v)
+        return jnp.stack(outs), counts
+
+    return run
+
+
+@functools.lru_cache(maxsize=256)
+def _emit_kernel(kinds: Tuple[str, ...], C: int, B: int, W: int, k: int):
+    """Compute per-key aggregates for k panes; pane j covers ring bins
+    (pane_end[j] - W, pane_end[j]] (absolute bin indices, taken mod B)."""
+
+    @jax.jit
+    def run(values, counts, pane_ends, pane_valid):
+        # window bin offsets: for pane end e, absolute bins e-W+1..e
+        offs = jnp.arange(W) - (W - 1)  # [-W+1..0]
+        abs_bins = pane_ends[:, None] + offs[None, :]  # [k, W]
+        ring = jnp.mod(abs_bins, B)  # [k, W]
+        # guard: bins below 0 don't exist
+        bin_ok = (abs_bins >= 0) & pane_valid[:, None]  # [k, W]
+
+        # counts per key per pane: gather [C, k, W] then sum
+        cnt_g = counts[:, ring]  # [C, k, W]
+        cnt = jnp.sum(jnp.where(bin_ok[None], cnt_g, 0), axis=-1)  # [C, k]
+
+        outs = []
+        for i, kind in enumerate(kinds):
+            v = values[i]  # [C, B]
+            g = v[:, ring]  # [C, k, W]
+            if kind in ("sum", "avg", "count"):
+                r = jnp.sum(jnp.where(bin_ok[None], g, 0.0), axis=-1)
+                if kind == "avg":
+                    r = r / jnp.maximum(cnt, 1)
+                elif kind == "count":
+                    # per-bin counts were accumulated into the value channel
+                    pass
+            elif kind == "min":
+                r = jnp.min(jnp.where(bin_ok[None], g, POS_INF), axis=-1)
+            elif kind == "max":
+                r = jnp.max(jnp.where(bin_ok[None], g, NEG_INF), axis=-1)
+            else:
+                raise ValueError(kind)
+            outs.append(r)
+        return (jnp.stack(outs) if outs else jnp.zeros((0, C, k))), cnt
+
+    return run
+
+
+@functools.lru_cache(maxsize=256)
+def _evict_kernel(kinds: Tuple[str, ...], C: int, B: int):
+    @jax.jit
+    def run(values, counts, ring_slots, slot_valid):
+        # zero expired ring columns
+        mask = jnp.zeros((B,), dtype=bool).at[
+            jnp.where(slot_valid, ring_slots, 0)].max(slot_valid)
+        counts = jnp.where(mask[None, :], 0, counts)
+        outs = []
+        for i, kind in enumerate(kinds):
+            init = _init_value(AggKind(kind))
+            outs.append(jnp.where(mask[None, :], init, values[i]))
+        return jnp.stack(outs), counts
+
+    return run
+
+
+def _bucket(n: int, floor: int = 8) -> int:
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+class KeyedBinState:
+    """Sharded keyed bin-ring aggregation state for one subtask."""
+
+    def __init__(self, aggs: Tuple[AggSpec, ...], slide_micros: int,
+                 width_micros: int, capacity: int = 1024):
+        assert width_micros % slide_micros == 0, (
+            "window width must be a multiple of slide")
+        self.aggs = aggs
+        self.kinds = tuple(a.kind.value for a in aggs)
+        self.slide = slide_micros
+        self.W = width_micros // slide_micros  # bins per window
+        # ring must hold all open bins: W for the widest window plus headroom
+        # for out-of-order arrivals ahead of the watermark
+        self.B = _bucket(2 * self.W + 4, floor=8)
+        self.C = _bucket(capacity)
+
+        self.key_sorted = np.zeros(0, dtype=np.uint64)  # sorted known hashes
+        self.slot_of_sorted = np.zeros(0, dtype=np.int64)
+        self.next_slot = 0
+        self.slot_to_key = np.zeros(self.C, dtype=np.uint64)
+
+        self.values = jnp.zeros((len(aggs), self.C, self.B), dtype=jnp.float32)
+        for i, a in enumerate(aggs):
+            iv = _init_value(a.kind)
+            if iv != 0.0:
+                self.values = self.values.at[i].set(iv)
+        self.counts = jnp.zeros((self.C, self.B), dtype=jnp.int32)
+
+        self.min_bin: Optional[int] = None  # oldest retained absolute bin
+        self.max_bin: Optional[int] = None
+        self.last_fired_pane: Optional[int] = None
+
+    # -- key directory -----------------------------------------------------
+
+    def _lookup_or_insert(self, kh: np.ndarray) -> np.ndarray:
+        """Vectorized key hash -> slot id, inserting unknown keys."""
+        uniq = np.unique(kh)
+        pos = np.searchsorted(self.key_sorted, uniq)
+        pos_c = np.minimum(pos, max(len(self.key_sorted) - 1, 0))
+        known = (len(self.key_sorted) > 0) & (
+            self.key_sorted[pos_c] == uniq if len(self.key_sorted) else
+            np.zeros(len(uniq), dtype=bool))
+        new_keys = uniq[~known] if len(self.key_sorted) else uniq
+        if len(new_keys):
+            n_new = len(new_keys)
+            if self.next_slot + n_new > self.C:
+                self._grow(self.next_slot + n_new)
+            new_slots = np.arange(self.next_slot, self.next_slot + n_new)
+            self.slot_to_key[new_slots] = new_keys
+            self.next_slot += n_new
+            merged = np.concatenate([self.key_sorted, new_keys])
+            merged_slots = np.concatenate([self.slot_of_sorted, new_slots])
+            order = np.argsort(merged, kind="stable")
+            self.key_sorted = merged[order]
+            self.slot_of_sorted = merged_slots[order]
+        idx = np.searchsorted(self.key_sorted, kh)
+        return self.slot_of_sorted[idx]
+
+    def _grow(self, needed: int) -> None:
+        newC = self.C
+        while newC < needed:
+            newC <<= 1
+        pad = newC - self.C
+        self.values = jnp.concatenate([
+            self.values,
+            jnp.stack([jnp.full((pad, self.B), _init_value(a.kind), jnp.float32)
+                       for a in self.aggs]) if self.aggs else
+            jnp.zeros((0, pad, self.B), jnp.float32)], axis=1)
+        self.counts = jnp.concatenate(
+            [self.counts, jnp.zeros((pad, self.B), jnp.int32)], axis=0)
+        self.slot_to_key = np.concatenate(
+            [self.slot_to_key, np.zeros(pad, dtype=np.uint64)])
+        self.C = newC
+
+    # -- update ------------------------------------------------------------
+
+    def update(self, key_hash: np.ndarray, timestamps: np.ndarray,
+               agg_inputs: Dict[str, np.ndarray]) -> None:
+        n = len(key_hash)
+        if n == 0:
+            return
+        bins_abs = timestamps // self.slide
+        # a row in bin b feeds panes b..b+W-1; it is late (dropped) only when
+        # all those panes already fired — matching the reference's
+        # drop-behind-watermark semantics
+        if self.last_fired_pane is not None:
+            threshold = self.last_fired_pane - self.W + 2
+            live = bins_abs >= threshold
+        else:
+            live = np.ones(n, dtype=bool)
+        if not live.any():
+            return
+        lo = int(bins_abs[live].min())
+        self.min_bin = lo if self.min_bin is None else min(self.min_bin, lo)
+        bmax = int(bins_abs.max())
+        self.max_bin = bmax if self.max_bin is None else max(self.max_bin, bmax)
+        # ring capacity check: if new data spans too far ahead, fire nothing —
+        # bins wrap only after panes are emitted and evicted; enforce window
+        if self.max_bin - self.min_bin >= self.B:
+            self._grow_ring(self.max_bin - self.min_bin + 1)
+
+        slots = self._lookup_or_insert(key_hash)
+        npad = _bucket(n, floor=256)
+        slots_p = np.zeros(npad, dtype=np.int32)
+        slots_p[:n] = slots
+        bins_p = np.zeros(npad, dtype=np.int32)
+        bins_p[:n] = (bins_abs % self.B).astype(np.int32)
+        valid = np.zeros(npad, dtype=bool)
+        valid[:n] = live
+        vals = np.zeros((len(self.aggs), npad), dtype=np.float32)
+        for i, a in enumerate(self.aggs):
+            if a.kind == AggKind.COUNT or a.column is None:
+                vals[i, :n] = 1.0
+            else:
+                vals[i, :n] = agg_inputs[a.column].astype(np.float32)
+
+        kernel = _update_kernel(self.kinds, self.C, self.B, npad)
+        self.values, self.counts = kernel(
+            self.values, self.counts, jnp.asarray(slots_p),
+            jnp.asarray(bins_p), jnp.asarray(vals), jnp.asarray(valid))
+
+    def _grow_ring(self, needed: int) -> None:
+        """Rare: data spans more bins than the ring; re-layout host-side."""
+        newB = self.B
+        while newB < needed:
+            newB <<= 1
+        vals = np.asarray(self.values)
+        cnts = np.asarray(self.counts)
+        new_vals = np.zeros((len(self.aggs), self.C, newB), dtype=np.float32)
+        for i, a in enumerate(self.aggs):
+            new_vals[i] = _init_value(a.kind)
+        new_cnts = np.zeros((self.C, newB), dtype=np.int32)
+        if self.min_bin is not None and self.max_bin is not None:
+            for ab in range(self.min_bin, self.max_bin + 1):
+                new_vals[:, :, ab % newB] = vals[:, :, ab % self.B]
+                new_cnts[:, ab % newB] = cnts[:, ab % self.B]
+        self.values = jnp.asarray(new_vals)
+        self.counts = jnp.asarray(new_cnts)
+        self.B = newB
+
+    # -- pane emission ------------------------------------------------------
+
+    def fire_panes(self, watermark: int, final: bool = False
+                   ) -> Optional[Tuple[np.ndarray, Dict[str, np.ndarray],
+                                       np.ndarray, np.ndarray]]:
+        """Emit all panes whose window end <= watermark.
+
+        Pane with absolute end-bin e covers bins (e-W, e]; its window end time
+        is (e+1)*slide.  Returns (keys, {agg_output: values}, window_end,
+        counts) flattened over (pane, key-with-data), or None.
+        """
+        if self.max_bin is None or self.next_slot == 0:
+            return None
+        if final:
+            # flush every window containing data: the last data bin feeds
+            # panes up to max_bin + W - 1
+            last_pane = self.max_bin + self.W - 1
+        else:
+            last_pane = min(int(watermark // self.slide) - 1, self.max_bin)
+        first_pane = (self.last_fired_pane + 1
+                      if self.last_fired_pane is not None
+                      else (self.min_bin or 0))
+        if last_pane < first_pane:
+            return None
+        pane_ends = np.arange(first_pane, last_pane + 1, dtype=np.int64)
+        k = len(pane_ends)
+        kpad = _bucket(k, floor=1)
+        # absolute bin indices can exceed i32 (micros-since-epoch / slide): i64
+        ends_p = np.zeros(kpad, dtype=np.int64)
+        ends_p[:k] = pane_ends
+        pvalid = np.zeros(kpad, dtype=bool)
+        pvalid[:k] = True
+
+        kernel = _emit_kernel(self.kinds, self.C, self.B, self.W, kpad)
+        outs, cnts = kernel(self.values, self.counts, jnp.asarray(ends_p),
+                            jnp.asarray(pvalid))
+        outs = np.asarray(outs)  # [n_aggs, C, kpad]
+        cnts = np.asarray(cnts)  # [C, kpad]
+
+        self.last_fired_pane = last_pane
+        # evict bins that no future pane needs: abs bins <= last_pane - W + 1
+        new_min = last_pane - self.W + 2
+        if self.min_bin is not None and new_min > self.min_bin:
+            expired = np.arange(self.min_bin, min(new_min, self.max_bin + 1))
+            if len(expired):
+                epad = _bucket(len(expired), floor=8)
+                ring = np.zeros(epad, dtype=np.int32)
+                ring[:len(expired)] = expired % self.B
+                ev = np.zeros(epad, dtype=bool)
+                ev[:len(expired)] = True
+                ek = _evict_kernel(self.kinds, self.C, self.B)
+                self.values, self.counts = ek(self.values, self.counts,
+                                              jnp.asarray(ring), jnp.asarray(ev))
+            self.min_bin = new_min
+
+        # flatten (key, pane) pairs with data on host
+        C_used = self.next_slot
+        cnts_u = cnts[:C_used, :k]
+        key_idx, pane_idx = np.nonzero(cnts_u)
+        if len(key_idx) == 0:
+            return None
+        keys = self.slot_to_key[key_idx]
+        window_end = (pane_ends[pane_idx] + 1) * self.slide
+        out_cols: Dict[str, np.ndarray] = {}
+        for i, a in enumerate(self.aggs):
+            col = outs[i, :C_used, :k][key_idx, pane_idx]
+            if a.kind == AggKind.COUNT:
+                col = col.astype(np.int64)
+            out_cols[a.output] = col
+        return keys, out_cols, window_end, cnts_u[key_idx, pane_idx]
+
+    # -- checkpoint ---------------------------------------------------------
+
+    def snapshot(self) -> Dict[str, np.ndarray]:
+        return {
+            "values": np.asarray(jax.device_get(self.values)),
+            "counts": np.asarray(jax.device_get(self.counts)),
+            "key_sorted": self.key_sorted,
+            "slot_of_sorted": self.slot_of_sorted,
+            "slot_to_key": self.slot_to_key,
+            "meta": np.array([
+                self.next_slot,
+                -1 if self.min_bin is None else self.min_bin,
+                -1 if self.max_bin is None else self.max_bin,
+                -1 if self.last_fired_pane is None else self.last_fired_pane,
+                self.B, self.C,
+            ], dtype=np.int64),
+        }
+
+    def restore(self, arrays: Dict[str, np.ndarray]) -> None:
+        meta = arrays["meta"]
+        self.next_slot = int(meta[0])
+        self.min_bin = None if meta[1] < 0 else int(meta[1])
+        self.max_bin = None if meta[2] < 0 else int(meta[2])
+        self.last_fired_pane = None if meta[3] < 0 else int(meta[3])
+        self.B = int(meta[4])
+        self.C = int(meta[5])
+        self.values = jnp.asarray(arrays["values"])
+        self.counts = jnp.asarray(arrays["counts"])
+        self.key_sorted = arrays["key_sorted"].astype(np.uint64)
+        self.slot_of_sorted = arrays["slot_of_sorted"].astype(np.int64)
+        self.slot_to_key = arrays["slot_to_key"].astype(np.uint64)

@@ -1,0 +1,107 @@
+"""Device segment aggregation: group-by-key over a sorted batch.
+
+The TPU replacement for the reference's per-record aggregator loops
+(windows.rs:19-59 built-in vec/count/min/max/sum aggregators): rows are
+sorted by key hash, segment ids assigned by run-length, and aggregates
+computed with jax.ops.segment_* in one fused XLA program.  Shapes are
+bucketed to powers of two so each operator compiles O(log n) kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..graph.logical import AggKind, AggSpec
+from .expr import bucket_size
+
+NEG_INF = jnp.finfo(jnp.float32).min
+POS_INF = jnp.finfo(jnp.float32).max
+
+
+@functools.lru_cache(maxsize=256)
+def _segment_agg_kernel(n_padded: int, n_segments: int, agg_kinds: Tuple[str, ...]):
+    """Jitted kernel: (values[k, n], segment_ids[n], valid[n]) ->
+    per-segment aggregates [k, n_segments] + counts [n_segments]."""
+
+    @jax.jit
+    def run(values: jnp.ndarray, segment_ids: jnp.ndarray, valid: jnp.ndarray):
+        # invalid rows go to a trash segment
+        sid = jnp.where(valid, segment_ids, n_segments)
+        counts = jax.ops.segment_sum(
+            jnp.where(valid, 1, 0), sid, num_segments=n_segments + 1)[:n_segments]
+        outs = []
+        for i, kind in enumerate(agg_kinds):
+            v = values[i]
+            if kind in ("sum", "avg"):
+                r = jax.ops.segment_sum(jnp.where(valid, v, 0.0), sid,
+                                        num_segments=n_segments + 1)[:n_segments]
+                if kind == "avg":
+                    r = r / jnp.maximum(counts, 1)
+            elif kind == "min":
+                r = jax.ops.segment_min(jnp.where(valid, v, POS_INF), sid,
+                                        num_segments=n_segments + 1)[:n_segments]
+            elif kind == "max":
+                r = jax.ops.segment_max(jnp.where(valid, v, NEG_INF), sid,
+                                        num_segments=n_segments + 1)[:n_segments]
+            elif kind == "count":
+                r = counts.astype(jnp.float32)
+            else:
+                raise ValueError(kind)
+            outs.append(r)
+        return jnp.stack(outs) if outs else jnp.zeros((0, n_segments)), counts
+
+    return run
+
+
+def segment_aggregate(
+    key_hash: np.ndarray,
+    timestamps: np.ndarray,
+    agg_inputs: Dict[str, np.ndarray],
+    aggs: Tuple[AggSpec, ...],
+) -> Tuple[np.ndarray, Dict[str, np.ndarray], np.ndarray, np.ndarray]:
+    """Group rows by key_hash and compute ``aggs``.
+
+    Returns (unique_keys, {output_name: values}, max_ts_per_key,
+    row_counts_per_key).  Host does the sort (numpy argsort, C speed) — the
+    reduce runs on device.
+    """
+    n = len(key_hash)
+    order = np.argsort(key_hash, kind="stable")
+    kh = key_hash[order]
+    uniq, seg_start = np.unique(kh, return_index=True)
+    seg_ids = np.searchsorted(uniq, kh).astype(np.int32)
+    n_seg = len(uniq)
+
+    npad = bucket_size(n)
+    spad = bucket_size(n_seg)
+    valid = np.zeros(npad, dtype=bool)
+    valid[:n] = True
+    sid_p = np.zeros(npad, dtype=np.int32)
+    sid_p[:n] = seg_ids
+
+    kinds = tuple(a.kind.value for a in aggs)
+    vals = np.zeros((len(aggs), npad), dtype=np.float32)
+    for i, a in enumerate(aggs):
+        if a.column is not None and a.kind != AggKind.COUNT:
+            vals[i, :n] = agg_inputs[a.column][order].astype(np.float32)
+
+    kernel = _segment_agg_kernel(npad, spad, kinds)
+    outs, counts = kernel(jnp.asarray(vals), jnp.asarray(sid_p),
+                          jnp.asarray(valid))
+    outs = np.asarray(outs)[:, :n_seg]
+    out_cols = {}
+    for i, a in enumerate(aggs):
+        col = outs[i]
+        if a.kind == AggKind.COUNT:
+            col = col.astype(np.int64)
+        out_cols[a.output] = col
+
+    # per-key max timestamp (host; used for emitted record timestamps)
+    ts_sorted = timestamps[order]
+    max_ts = np.maximum.reduceat(ts_sorted, seg_start)
+    return uniq, out_cols, max_ts, np.asarray(counts)[:n_seg].astype(np.int64)

@@ -122,7 +122,7 @@ def _serialize_rows(
     if snapshot.arrays is not None:
         for name, arr in snapshot.arrays.items():
             buf = io.BytesIO()
-            np.save(buf, np.asarray(arr), allow_pickle=False)
+            np.save(buf, np.asarray(arr), allow_pickle=True)
             key_hashes.append(0)
             timestamps.append(0)
             keys.append(b"__array__" + name.encode())
@@ -184,7 +184,7 @@ def _deserialize_rows(
             continue
         if k.startswith(b"__array__"):
             buf = io.BytesIO(v)
-            arrays[k[len(b"__array__"):].decode()] = np.load(buf, allow_pickle=False)
+            arrays[k[len(b"__array__"):].decode()] = np.load(buf, allow_pickle=True)
             continue
         if range_filter and not (key_range[0] <= int(kh) <= key_range[1]):
             continue

@@ -38,9 +38,10 @@ class ImpulseSource(SourceOperator):
 
     async def run(self, ctx: Context) -> SourceFinishType:
         state = ctx.state.get_global_keyed_state("i")
-        start = state.get(ctx.task_info.task_index)
-        if start is not None:
-            self.counter = start
+        saved = state.get(ctx.task_info.task_index)
+        saved_base = None
+        if saved is not None:
+            self.counter, saved_base = saved
 
         par = ctx.task_info.parallelism
         rate = self.cfg.event_rate / par
@@ -53,7 +54,9 @@ class ImpulseSource(SourceOperator):
         interval = self.cfg.event_time_interval_micros
         t0_wall = _time.monotonic()
         emitted_since_start = 0
-        base_event_time = now_micros()
+        # event-time base must survive restarts so restored events land in
+        # the same windows as the checkpointed state
+        base_event_time = saved_base if saved_base is not None else now_micros()
 
         runner = getattr(ctx, "_runner", None)
         while total is None or self.counter < total:
@@ -69,7 +72,8 @@ class ImpulseSource(SourceOperator):
             })
             await ctx.collect(batch)
             self.counter += n
-            state.insert(ctx.task_info.task_index, self.counter)
+            state.insert(ctx.task_info.task_index,
+                         (self.counter, base_event_time))
 
             if runner is not None:
                 cm = await runner.poll_source_control()

@@ -65,6 +65,7 @@ class Engine:
         self.restore_epoch = restore_epoch
         self.control_resp: asyncio.Queue = asyncio.Queue()
         self.subtasks: Dict[Tuple[str, int], SubtaskHandle] = {}
+        self.resps: List[ControlResp] = []  # responses drained so far
 
     @staticmethod
     def for_local(program: Program, job_id: str = "local-job",
@@ -190,9 +191,43 @@ class RunningEngine:
         for q in self.source_controls():
             await q.put(ControlMessage.checkpoint(barrier))
 
+    async def wait_for_checkpoint(self, epoch: int,
+                                  timeout: float = 30.0) -> bool:
+        """Block until every subtask reported checkpoint_completed for
+        ``epoch`` — only then is the epoch restorable (the reference's
+        controller CheckpointState aggregation, checkpointer.rs:186-410).
+        Returns False on timeout."""
+        import time as _time
+
+        n_subtasks = len(self.engine.subtasks)
+        deadline = _time.monotonic() + timeout
+        count = sum(1 for r in self.engine.resps
+                    if r.kind == "checkpoint_completed"
+                    and r.subtask_metadata.epoch == epoch)
+        while count < n_subtasks:
+            remain = deadline - _time.monotonic()
+            if remain <= 0:
+                return False
+            try:
+                resp = await asyncio.wait_for(
+                    self.engine.control_resp.get(), timeout=remain)
+            except asyncio.TimeoutError:
+                return False
+            self.engine.resps.append(resp)
+            if (resp.kind == "checkpoint_completed"
+                    and resp.subtask_metadata.epoch == epoch):
+                count += 1
+        return True
+
     async def stop(self, mode: StopMode = StopMode.GRACEFUL) -> None:
-        for q in self.source_controls():
-            await q.put(ControlMessage.stop(mode))
+        if mode == StopMode.IMMEDIATE:
+            # kill-style stop reaches every subtask directly (the reference's
+            # recovering path SIGKILLs workers; in-process we signal all loops)
+            for h in self.engine.subtasks.values():
+                await h.control_tx.put(ControlMessage.stop(mode))
+        else:
+            for q in self.source_controls():
+                await q.put(ControlMessage.stop(mode))
 
     async def commit(self, epoch: int) -> None:
         for q in self.sink_controls():
@@ -202,7 +237,7 @@ class RunningEngine:
         """Wait for all subtasks to finish; drain + return control responses."""
         tasks = [h.task for h in self.engine.subtasks.values() if h.task]
         await asyncio.gather(*tasks, return_exceptions=True)
-        resps: List[ControlResp] = []
+        resps: List[ControlResp] = self.engine.resps
         while not self.engine.control_resp.empty():
             resps.append(self.engine.control_resp.get_nowait())
         failures = [r for r in resps if r.kind == "task_failed"]

@@ -240,6 +240,14 @@ class TaskRunner:
             for p in self.pumps:
                 if p.task is not None:
                     p.task.cancel()
+            # unblock upstreams possibly parked on a full queue (matters on
+            # immediate stop, where this task exits while producers still run)
+            for _, q in self.inputs:
+                while not q.empty():
+                    try:
+                        q.get_nowait()
+                    except asyncio.QueueEmpty:
+                        break
 
         await self.operator.on_close(self.ctx)
         if then_stop or stop_mode is not None:

@@ -87,8 +87,9 @@ class Config:
         default_factory=lambda: _env_str("ARROYO_TPU_PLATFORM", "")
     )  # '' = jax default
     state_capacity: int = field(
-        default_factory=lambda: _env_int("STATE_CAPACITY", 1 << 17)
-    )  # per-subtask keyed-state slots (doubles on overflow)
+        default_factory=lambda: _env_int("STATE_CAPACITY", 1 << 12)
+    )  # initial per-subtask keyed-state slots (doubles on overflow;
+    # benchmarks pre-size via STATE_CAPACITY to avoid growth recompiles)
 
     # Telemetry
     disable_telemetry: bool = field(

@@ -136,14 +136,19 @@ class NexmarkGenerator:
                               np.clip(offset - pp, 0, ap - 1))
         return adj_epoch * ap + adj_offset
 
-    def _next_base0_person_id(self, event_id: np.ndarray) -> np.ndarray:
-        num_people = self._last_base0_person_id(event_id)
+    def _next_base0_person_id(self, event_id: np.ndarray,
+                              num_people: Optional[np.ndarray] = None
+                              ) -> np.ndarray:
+        if num_people is None:
+            num_people = self._last_base0_person_id(event_id)
         active = np.minimum(num_people, self.cfg.num_active_people)
         n = (self.rng.random(len(event_id)) * (active + PERSON_ID_LEAD)).astype(np.int64)
         return num_people - active + n
 
-    def _next_base0_auction_id(self, event_id: np.ndarray) -> np.ndarray:
-        max_a = self._last_base0_auction_id(event_id)
+    def _next_base0_auction_id(self, event_id: np.ndarray,
+                               max_a: Optional[np.ndarray] = None) -> np.ndarray:
+        if max_a is None:
+            max_a = self._last_base0_auction_id(event_id)
         min_a = np.maximum(max_a - self.cfg.num_inflight_auctions, 0)
         span = max_a + 1 + AUCTION_ID_LEAD - min_a
         return min_a + (self.rng.random(len(event_id)) * span).astype(np.int64)
@@ -186,24 +191,27 @@ class NexmarkGenerator:
         is_auction = (~is_person) & (rem < pp + ap)
         is_bid = ~(is_person | is_auction)
 
-        etype = np.where(is_person, EVENT_PERSON,
-                         np.where(is_auction, EVENT_AUCTION, EVENT_BID)).astype(np.int8)
+        etype = np.full(n, EVENT_BID, dtype=np.int8)
+        etype[is_person] = EVENT_PERSON
+        etype[is_auction] = EVENT_AUCTION
 
         cols: Dict[str, np.ndarray] = {"event_type": etype}
-        z64 = np.zeros(n, dtype=np.int64)
+
+        # shared closed forms computed once (the Rust generator recomputes
+        # them per event; here per batch)
+        last_person = self._last_base0_person_id(event_id)
+        last_auction = self._last_base0_auction_id(event_id)
 
         # persons (next_person, mod.rs:545-587)
-        p_id = np.where(is_person,
-                        self._last_base0_person_id(event_id) + FIRST_PERSON_ID, 0)
-        cols["person_id"] = p_id.astype(np.int64)
+        p_id = np.where(is_person, last_person + FIRST_PERSON_ID, 0)
+        cols["person_id"] = p_id
 
         # auctions (next_auction, mod.rs:419-462)
-        last_person = self._last_base0_person_id(event_id)
-        hot_seller = (self.rng.random(n) * self.cfg.hot_seller_ratio).astype(np.int64) > 0
+        hot_seller = self.rng.random(n) * self.cfg.hot_seller_ratio >= 1.0
         seller = np.where(
             hot_seller, (last_person // HOT_SELLER_RATIO) * HOT_SELLER_RATIO,
-            self._next_base0_person_id(event_id)) + FIRST_PERSON_ID
-        a_id = self._last_base0_auction_id(event_id) + FIRST_AUCTION_ID
+            self._next_base0_person_id(event_id, last_person)) + FIRST_PERSON_ID
+        a_id = last_auction + FIRST_AUCTION_ID
         category = FIRST_CATEGORY_ID + self.rng.integers(0, NUM_CATEGORIES, n)
         initial_bid = self._next_price(n)
         reserve = initial_bid + self._next_price(n)
@@ -214,29 +222,29 @@ class NexmarkGenerator:
         length_ms = 1 + np.maximum(
             (self.rng.random(n) * (horizon_ms * 2)).astype(np.int64), 1)
         expires = ts + length_ms * 1000
-        cols["auction_id"] = np.where(is_auction, a_id, 0).astype(np.int64)
-        cols["auction_seller"] = np.where(is_auction, seller, 0).astype(np.int64)
-        cols["auction_category"] = np.where(is_auction, category, 0).astype(np.int64)
+        cols["auction_id"] = np.where(is_auction, a_id, 0)
+        cols["auction_seller"] = np.where(is_auction, seller, 0)
+        cols["auction_category"] = np.where(is_auction, category, 0)
         cols["auction_initial_bid"] = np.where(is_auction, initial_bid, 0)
         cols["auction_reserve"] = np.where(is_auction, reserve, 0)
-        cols["auction_expires"] = np.where(is_auction, expires, 0).astype(np.int64)
-        cols["auction_datetime"] = np.where(is_auction, ts, 0).astype(np.int64)
+        cols["auction_expires"] = np.where(is_auction, expires, 0)
+        cols["auction_datetime"] = np.where(is_auction, ts, 0)
 
         # bids (next_bid, mod.rs:588-631)
-        hot_auction = (self.rng.random(n) * self.cfg.hot_auction_ratio).astype(np.int64) > 0
+        hot_auction = self.rng.random(n) * self.cfg.hot_auction_ratio >= 1.0
         bid_auction = np.where(
             hot_auction,
-            (self._last_base0_auction_id(event_id) // HOT_AUCTION_RATIO) * HOT_AUCTION_RATIO,
-            self._next_base0_auction_id(event_id)) + FIRST_AUCTION_ID
-        hot_bidder = (self.rng.random(n) * self.cfg.hot_bidders_ratio).astype(np.int64) > 0
+            (last_auction // HOT_AUCTION_RATIO) * HOT_AUCTION_RATIO,
+            self._next_base0_auction_id(event_id, last_auction)) + FIRST_AUCTION_ID
+        hot_bidder = self.rng.random(n) * self.cfg.hot_bidders_ratio >= 1.0
         bidder = np.where(
             hot_bidder, (last_person // HOT_BIDDER_RATIO) * HOT_BIDDER_RATIO,
-            self._next_base0_person_id(event_id)) + FIRST_PERSON_ID
+            self._next_base0_person_id(event_id, last_person)) + FIRST_PERSON_ID
         bid_price = self._next_price(n)
-        cols["bid_auction"] = np.where(is_bid, bid_auction, 0).astype(np.int64)
-        cols["bid_bidder"] = np.where(is_bid, bidder, 0).astype(np.int64)
+        cols["bid_auction"] = np.where(is_bid, bid_auction, 0)
+        cols["bid_bidder"] = np.where(is_bid, bidder, 0)
         cols["bid_price"] = np.where(is_bid, bid_price, 0)
-        cols["bid_datetime"] = np.where(is_bid, ts, 0).astype(np.int64)
+        cols["bid_datetime"] = np.where(is_bid, ts, 0)
 
         if self.cfg.generate_strings:
             np_idx = is_person.nonzero()[0]

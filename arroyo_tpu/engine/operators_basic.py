@@ -136,8 +136,8 @@ class WatermarkOperator(Operator):
         self.last_emitted: Optional[int] = None
         self.last_data_wall: float = _time.monotonic()
         self._idle_task: Optional[asyncio.Task] = None
-        self._expr = (CompiledExpr(spec.expression.name, spec.expression.fn)
-                      if spec.expression else None)
+        # watermark expressions produce int64 micros -> host eval only
+        self._expr_fn = spec.expression.fn if spec.expression else None
 
     async def on_start(self, ctx: Context) -> None:
         if self.spec.idle_time_micros:
@@ -152,9 +152,9 @@ class WatermarkOperator(Operator):
 
     async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
         self.last_data_wall = _time.monotonic()
-        if self._expr is not None:
-            wm_src = eval_record_expr(self._expr, batch)
-            ts_max = int(np.max(wm_src.timestamp)) if len(wm_src) else None
+        if self._expr_fn is not None:
+            out = eval_host_expr(self._expr_fn, batch)
+            ts_max = int(np.max(out.timestamp)) if len(out) else None
         else:
             ts_max = int(np.max(batch.timestamp)) if len(batch) else None
         if ts_max is not None:

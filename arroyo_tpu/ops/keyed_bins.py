@@ -75,18 +75,13 @@ def _update_kernel(kinds: Tuple[str, ...], C: int, B: int, n: int):
 
 @functools.lru_cache(maxsize=256)
 def _emit_kernel(kinds: Tuple[str, ...], C: int, B: int, W: int, k: int):
-    """Compute per-key aggregates for k panes; pane j covers ring bins
-    (pane_end[j] - W, pane_end[j]] (absolute bin indices, taken mod B)."""
+    """Compute per-key aggregates for k panes.  ``ring[k, W]`` (int32) and
+    ``bin_ok[k, W]`` are computed on host from the absolute (int64) bin
+    indices — keeping 64-bit bin arithmetic out of jit, where x64-disabled
+    JAX would truncate it."""
 
     @jax.jit
-    def run(values, counts, pane_ends, pane_valid):
-        # window bin offsets: for pane end e, absolute bins e-W+1..e
-        offs = jnp.arange(W) - (W - 1)  # [-W+1..0]
-        abs_bins = pane_ends[:, None] + offs[None, :]  # [k, W]
-        ring = jnp.mod(abs_bins, B)  # [k, W]
-        # guard: bins below 0 don't exist
-        bin_ok = (abs_bins >= 0) & pane_valid[:, None]  # [k, W]
-
+    def run(values, counts, ring, bin_ok):
         # counts per key per pane: gather [C, k, W] then sum
         cnt_g = counts[:, ring]  # [C, k, W]
         cnt = jnp.sum(jnp.where(bin_ok[None], cnt_g, 0), axis=-1)  # [C, k]
@@ -142,7 +137,14 @@ class KeyedBinState:
     """Sharded keyed bin-ring aggregation state for one subtask."""
 
     def __init__(self, aggs: Tuple[AggSpec, ...], slide_micros: int,
-                 width_micros: int, capacity: int = 1024):
+                 width_micros: int, capacity: int = 0):
+        if capacity <= 0:
+            # pre-size from config: capacity growth doubles the arrays and
+            # recompiles the kernels, so starting near the expected key
+            # cardinality avoids O(log C) recompile stalls mid-stream
+            from ..config import config
+
+            capacity = config().state_capacity
         assert width_micros % slide_micros == 0, (
             "window width must be a multiple of slide")
         self.aggs = aggs
@@ -305,15 +307,21 @@ class KeyedBinState:
         pane_ends = np.arange(first_pane, last_pane + 1, dtype=np.int64)
         k = len(pane_ends)
         kpad = _bucket(k, floor=1)
-        # absolute bin indices can exceed i32 (micros-since-epoch / slide): i64
-        ends_p = np.zeros(kpad, dtype=np.int64)
-        ends_p[:k] = pane_ends
-        pvalid = np.zeros(kpad, dtype=bool)
-        pvalid[:k] = True
+        # host-side 64-bit bin arithmetic -> small int32 ring indices for jit
+        offs = np.arange(self.W, dtype=np.int64) - (self.W - 1)
+        abs_bins = pane_ends[:, None] + offs[None, :]  # [k, W] int64
+        ring = np.zeros((kpad, self.W), dtype=np.int32)
+        ring[:k] = (abs_bins % self.B).astype(np.int32)
+        bin_ok = np.zeros((kpad, self.W), dtype=bool)
+        # only bins in [min_bin, max_bin] are live in the ring; anything
+        # outside is either evicted/dropped or never written (and its ring
+        # slot may alias a live bin)
+        lo = self.min_bin if self.min_bin is not None else 0
+        bin_ok[:k] = (abs_bins >= lo) & (abs_bins <= self.max_bin)
 
         kernel = _emit_kernel(self.kinds, self.C, self.B, self.W, kpad)
-        outs, cnts = kernel(self.values, self.counts, jnp.asarray(ends_p),
-                            jnp.asarray(pvalid))
+        outs, cnts = kernel(self.values, self.counts, jnp.asarray(ring),
+                            jnp.asarray(bin_ok))
         outs = np.asarray(outs)  # [n_aggs, C, kpad]
         cnts = np.asarray(cnts)  # [C, kpad]
 

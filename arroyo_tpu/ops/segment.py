@@ -84,9 +84,28 @@ def segment_aggregate(
     sid_p = np.zeros(npad, dtype=np.int32)
     sid_p[:n] = seg_ids
 
-    kinds = tuple(a.kind.value for a in aggs)
-    vals = np.zeros((len(aggs), npad), dtype=np.float32)
-    for i, a in enumerate(aggs):
+    # COUNT(DISTINCT x) is inherently sort-based: host np.unique over
+    # (key, value) pairs (not mergeable across bins, hence only available on
+    # the buffered window path — matching the reference's two-phase
+    # exclusion of non-mergeable aggregates, operators.rs:165-167)
+    distinct_results: Dict[str, np.ndarray] = {}
+    device_aggs = []
+    for a in aggs:
+        if a.kind == AggKind.COUNT_DISTINCT:
+            v = agg_inputs[a.column][order]
+            pair_sort = np.lexsort((v, kh))
+            kv, vv = kh[pair_sort], v[pair_sort]
+            is_new = np.ones(n, dtype=bool)
+            is_new[1:] = (kv[1:] != kv[:-1]) | (vv[1:] != vv[:-1])
+            per_key = np.zeros(n_seg, dtype=np.int64)
+            np.add.at(per_key, np.searchsorted(uniq, kv[is_new]), 1)
+            distinct_results[a.output] = per_key
+        else:
+            device_aggs.append(a)
+
+    kinds = tuple(a.kind.value for a in device_aggs)
+    vals = np.zeros((len(device_aggs), npad), dtype=np.float32)
+    for i, a in enumerate(device_aggs):
         if a.column is not None and a.kind != AggKind.COUNT:
             vals[i, :n] = agg_inputs[a.column][order].astype(np.float32)
 
@@ -94,8 +113,8 @@ def segment_aggregate(
     outs, counts = kernel(jnp.asarray(vals), jnp.asarray(sid_p),
                           jnp.asarray(valid))
     outs = np.asarray(outs)[:, :n_seg]
-    out_cols = {}
-    for i, a in enumerate(aggs):
+    out_cols = dict(distinct_results)
+    for i, a in enumerate(device_aggs):
         col = outs[i]
         if a.kind == AggKind.COUNT:
             col = col.astype(np.int64)

@@ -1,0 +1,48 @@
+"""Device mesh helpers for SPMD streaming execution.
+
+The reference scales out by running operator subtasks on worker processes
+connected by a TCP data plane (arroyo-worker/src/network_manager.rs); the TPU
+build instead shards the *keyed state* across a mesh axis and exchanges rows
+with XLA collectives over ICI (SURVEY.md §2 "Distributed communication
+backend").  Mesh axes:
+
+* ``source`` — data-parallel axis: independent source partitions (the analog
+  of source subtasks / reference data parallelism #1)
+* ``keys``   — state-sharding axis: contiguous u64 key ranges, one per shard
+  (``server_for_hash`` semantics, arroyo-types/src/lib.rs:822-836)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def make_mesh(n_devices: Optional[int] = None, source: int = 1,
+              keys: Optional[int] = None):
+    """Build a (source, keys) mesh over the available devices."""
+    import jax
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    devs = devs[:n]
+    if keys is None:
+        keys = n // source
+    assert source * keys == len(devs), (
+        f"mesh {source}x{keys} != {len(devs)} devices")
+    arr = np.array(devs).reshape(source, keys)
+    return Mesh(arr, ("source", "keys"))
+
+
+def key_shard_spec():
+    from jax.sharding import PartitionSpec as P
+
+    return P(None, "keys")
+
+
+def row_shard_spec():
+    from jax.sharding import PartitionSpec as P
+
+    return P("source")

@@ -1,0 +1,6 @@
+"""SQL frontend: parse -> plan -> Program (arroyo-sql analog)."""
+
+from .parser import parse_sql  # noqa: F401
+from .planner import Planner, SqlPlanError, plan_sql  # noqa: F401
+from .schema_provider import SchemaProvider  # noqa: F401
+from .compiler import Schema, SqlCompileError  # noqa: F401

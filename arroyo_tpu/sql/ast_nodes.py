@@ -1,0 +1,187 @@
+"""SQL AST — the analog of the expression/statement trees the reference gets
+from sqlparser + DataFusion (arroyo-sql/src/expressions.rs operator taxonomy)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from enum import Enum
+from typing import Any, List, Optional, Tuple
+
+
+# -- expressions -------------------------------------------------------------
+
+
+@dataclass
+class Expr:
+    pass
+
+
+@dataclass
+class Literal(Expr):
+    value: Any  # int | float | str | bool | None
+    type: str = ""  # 'int'|'float'|'string'|'bool'|'null'
+
+
+@dataclass
+class IntervalLit(Expr):
+    micros: int
+
+
+@dataclass
+class ColumnRef(Expr):
+    name: str
+    qualifier: Optional[str] = None  # table alias or struct column
+
+    @property
+    def display(self) -> str:
+        return f"{self.qualifier}.{self.name}" if self.qualifier else self.name
+
+
+@dataclass
+class Star(Expr):
+    qualifier: Optional[str] = None
+
+
+@dataclass
+class BinaryOp(Expr):
+    op: str  # + - * / % = <> < <= > >= and or || like
+    left: Expr
+    right: Expr
+
+
+@dataclass
+class UnaryOp(Expr):
+    op: str  # - not
+    operand: Expr
+
+
+@dataclass
+class IsNull(Expr):
+    operand: Expr
+    negated: bool = False
+
+
+@dataclass
+class InList(Expr):
+    operand: Expr
+    items: List[Expr]
+    negated: bool = False
+
+
+@dataclass
+class Between(Expr):
+    operand: Expr
+    low: Expr
+    high: Expr
+    negated: bool = False
+
+
+@dataclass
+class Case(Expr):
+    operand: Optional[Expr]
+    whens: List[Tuple[Expr, Expr]]
+    else_: Optional[Expr]
+
+
+@dataclass
+class Cast(Expr):
+    operand: Expr
+    target_type: str  # normalized lowercase type name
+
+
+@dataclass
+class FunctionCall(Expr):
+    name: str  # lowercase
+    args: List[Expr]
+    distinct: bool = False
+
+    @property
+    def is_window_fn(self) -> bool:
+        return self.name in ("hop", "tumble", "session")
+
+
+AGG_FUNCTIONS = {"count", "sum", "min", "max", "avg"}
+
+
+# -- statements --------------------------------------------------------------
+
+
+@dataclass
+class SelectItem:
+    expr: Expr
+    alias: Optional[str] = None
+
+
+class JoinKind(Enum):
+    INNER = "inner"
+    LEFT = "left"
+    RIGHT = "right"
+    FULL = "full"
+
+
+@dataclass
+class TableRef:
+    pass
+
+
+@dataclass
+class NamedTable(TableRef):
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclass
+class DerivedTable(TableRef):
+    query: "Select"
+    alias: Optional[str] = None
+
+
+@dataclass
+class Join(TableRef):
+    left: TableRef
+    right: TableRef
+    kind: JoinKind
+    on: Optional[Expr]
+
+
+@dataclass
+class OrderItem:
+    expr: Expr
+    desc: bool = False
+
+
+@dataclass
+class Select:
+    items: List[SelectItem]
+    from_: Optional[TableRef] = None
+    where: Optional[Expr] = None
+    group_by: List[Expr] = field(default_factory=list)
+    having: Optional[Expr] = None
+    order_by: List[OrderItem] = field(default_factory=list)
+    limit: Optional[int] = None
+    distinct: bool = False
+    ctes: List[Tuple[str, "Select"]] = field(default_factory=list)
+
+
+@dataclass
+class ColumnDef:
+    name: str
+    type: str
+    not_null: bool = False
+    generated_as: Optional[Expr] = None
+
+
+@dataclass
+class CreateTable:
+    name: str
+    columns: List[ColumnDef]
+    with_options: dict = field(default_factory=dict)
+
+
+@dataclass
+class Insert:
+    table: str
+    query: Select
+
+
+Statement = Any  # CreateTable | Insert | Select

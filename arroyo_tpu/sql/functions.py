@@ -1,0 +1,340 @@
+"""SQL scalar function library — jnp implementations of the reference's
+function set (arroyo-worker/src/operators/functions/*.rs: datetime, strings,
+regexp, hash, json + math built-ins from the expression compiler).
+
+Each function takes/returns `(value, mask)` pairs (mask None = all valid).
+Numeric functions are jnp-traceable (run inside the jitted expression);
+string/regex/json functions are host-side numpy-object ops and force the
+expression onto the host path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+MV = Tuple[Any, Optional[Any]]  # (value array, validity mask)
+
+SECONDS = 1_000_000
+DEVICE_FUNCTIONS: Dict[str, Callable] = {}
+HOST_FUNCTIONS: Dict[str, Callable] = {}
+
+
+def device_fn(name):
+    def deco(f):
+        DEVICE_FUNCTIONS[name] = f
+        return f
+    return deco
+
+
+def host_fn(name):
+    def deco(f):
+        HOST_FUNCTIONS[name] = f
+        return f
+    return deco
+
+
+def _all_valid_mask(masks):
+    import jax.numpy as jnp
+
+    ms = [m for m in masks if m is not None]
+    if not ms:
+        return None
+    out = ms[0]
+    for m in ms[1:]:
+        out = out & m
+    return out
+
+
+# -- math (device) -----------------------------------------------------------
+
+def _unary_math(fn):
+    def impl(args: List[MV]) -> MV:
+        (v, m), = args
+        return fn(v), m
+    return impl
+
+
+def _register_math():
+    import jax.numpy as jnp
+
+    for name, fn in [
+        ("abs", jnp.abs), ("ceil", jnp.ceil), ("floor", jnp.floor),
+        ("round", jnp.round), ("sqrt", jnp.sqrt), ("exp", jnp.exp),
+        ("ln", jnp.log), ("log10", jnp.log10), ("log2", jnp.log2),
+        ("sin", jnp.sin), ("cos", jnp.cos), ("tan", jnp.tan),
+        ("asin", jnp.arcsin), ("acos", jnp.arccos), ("atan", jnp.arctan),
+        ("signum", jnp.sign), ("trunc", jnp.trunc),
+    ]:
+        DEVICE_FUNCTIONS[name] = _unary_math(fn)
+
+    def power(args):
+        (a, ma), (b, mb) = args
+        return jnp.power(a, b), _all_valid_mask([ma, mb])
+
+    DEVICE_FUNCTIONS["power"] = power
+    DEVICE_FUNCTIONS["pow"] = power
+
+    def nullif(args):
+        (a, ma), (b, mb) = args
+        eq = a == b
+        mask = ~eq if ma is None else (ma & ~eq)
+        return a, mask
+
+    DEVICE_FUNCTIONS["nullif"] = nullif
+
+    def coalesce(args):
+        out_v, out_m = args[0]
+        for v, m in args[1:]:
+            if out_m is None:
+                break
+            out_v = jnp.where(out_m, out_v, v)
+            out_m = out_m | (jnp.ones_like(out_m) if m is None else m)
+        return out_v, out_m
+
+    DEVICE_FUNCTIONS["coalesce"] = coalesce
+
+
+_register_math()
+
+
+# -- datetime (device; timestamps are int64 micros) --------------------------
+
+def _register_datetime():
+    import jax.numpy as jnp
+
+    TRUNC = {
+        "second": SECONDS,
+        "minute": 60 * SECONDS,
+        "hour": 3600 * SECONDS,
+        "day": 86400 * SECONDS,
+        "week": 7 * 86400 * SECONDS,
+    }
+
+    def date_trunc_factory(unit_micros):
+        def impl(args):
+            v, m = args[-1]
+            return (v // unit_micros) * unit_micros, m
+        return impl
+
+    def date_trunc(args, precision: str):
+        p = precision.lower()
+        if p in TRUNC:
+            v, m = args
+            return (v // TRUNC[p]) * TRUNC[p], m
+        raise ValueError(f"date_trunc precision {p} requires host path")
+
+    DEVICE_FUNCTIONS["__date_trunc"] = date_trunc  # special-cased in compiler
+
+    def extract(args, field: str):
+        v, m = args
+        f = field.lower()
+        if f == "second":
+            return (v // SECONDS) % 60, m
+        if f == "minute":
+            return (v // (60 * SECONDS)) % 60, m
+        if f == "hour":
+            return (v // (3600 * SECONDS)) % 24, m
+        if f in ("epoch",):
+            return v // SECONDS, m
+        if f in ("dow",):
+            return ((v // (86400 * SECONDS)) + 4) % 7, m  # 1970-01-01 = Thursday
+        raise ValueError(f"extract field {f} requires host path")
+
+    DEVICE_FUNCTIONS["__extract"] = extract
+
+    def from_unixtime(args):
+        # nanoseconds -> micros timestamp (reference from_unixtime takes ns)
+        (v, m), = args
+        return v // 1000, m
+
+    DEVICE_FUNCTIONS["from_unixtime"] = from_unixtime
+
+    def to_timestamp(args):
+        (v, m), = args
+        return v.astype(jnp.int64), m
+
+    DEVICE_FUNCTIONS["to_timestamp"] = to_timestamp
+
+    def unix_timestamp(args):
+        (v, m), = args
+        return v // SECONDS, m
+
+    DEVICE_FUNCTIONS["unix_timestamp"] = unix_timestamp
+
+
+_register_datetime()
+
+
+# -- strings (host) ----------------------------------------------------------
+
+def _obj(v):
+    return np.asarray(v, dtype=object)
+
+
+@host_fn("upper")
+def _upper(args):
+    (v, m), = args
+    return _obj([s.upper() if s is not None else None for s in v]), m
+
+
+@host_fn("lower")
+def _lower(args):
+    (v, m), = args
+    return _obj([s.lower() if s is not None else None for s in v]), m
+
+
+@host_fn("length")
+def _length(args):
+    (v, m), = args
+    return np.array([len(s) if s is not None else 0 for s in v],
+                    dtype=np.int64), m
+
+
+@host_fn("char_length")
+def _char_length(args):
+    return _length(args)
+
+
+@host_fn("concat")
+def _concat(args):
+    n = len(args[0][0])
+    out = ["".join(str(a[0][i]) for a in args if a[0][i] is not None)
+           for i in range(n)]
+    return _obj(out), _all_valid_mask([m for _, m in args])
+
+
+@host_fn("substr")
+def _substr(args):
+    v, m = args[0]
+    start = np.asarray(args[1][0]).astype(int)
+    if len(args) > 2:
+        ln = np.asarray(args[2][0]).astype(int)
+        out = [s[st - 1:st - 1 + l] if s is not None else None
+               for s, st, l in zip(v, np.broadcast_to(start, (len(v),)),
+                                   np.broadcast_to(ln, (len(v),)))]
+    else:
+        out = [s[st - 1:] if s is not None else None
+               for s, st in zip(v, np.broadcast_to(start, (len(v),)))]
+    return _obj(out), m
+
+
+@host_fn("substring")
+def _substring(args):
+    return _substr(args)
+
+
+@host_fn("trim")
+def _trim(args):
+    (v, m), = args
+    return _obj([s.strip() if s is not None else None for s in v]), m
+
+
+@host_fn("ltrim")
+def _ltrim(args):
+    (v, m), = args
+    return _obj([s.lstrip() if s is not None else None for s in v]), m
+
+
+@host_fn("rtrim")
+def _rtrim(args):
+    (v, m), = args
+    return _obj([s.rstrip() if s is not None else None for s in v]), m
+
+
+@host_fn("replace")
+def _replace(args):
+    v, m = args[0]
+    old = args[1][0]
+    new = args[2][0]
+    out = [s.replace(o, nw) if s is not None else None
+           for s, o, nw in zip(v, np.broadcast_to(old, (len(v),)),
+                               np.broadcast_to(new, (len(v),)))]
+    return _obj(out), m
+
+
+@host_fn("split_part")
+def _split_part(args):
+    v, m = args[0]
+    delim = args[1][0]
+    idx = np.asarray(args[2][0]).astype(int)
+    out = []
+    for s, d, i in zip(v, np.broadcast_to(delim, (len(v),)),
+                       np.broadcast_to(idx, (len(v),))):
+        if s is None:
+            out.append(None)
+            continue
+        parts = s.split(d)
+        out.append(parts[i - 1] if 0 < i <= len(parts) else "")
+    return _obj(out), m
+
+
+@host_fn("starts_with")
+def _starts_with(args):
+    v, m = args[0]
+    prefix = args[1][0]
+    return np.array([bool(s and s.startswith(p)) for s, p in
+                     zip(v, np.broadcast_to(prefix, (len(v),)))]), m
+
+
+@host_fn("regexp_match")
+def _regexp_match(args):
+    v, m = args[0]
+    pattern = str(np.asarray(args[1][0]).reshape(-1)[0])
+    rx = re.compile(pattern)
+    return np.array([bool(s is not None and rx.search(s)) for s in v]), m
+
+
+@host_fn("regexp_replace")
+def _regexp_replace(args):
+    v, m = args[0]
+    pattern = str(np.asarray(args[1][0]).reshape(-1)[0])
+    repl = str(np.asarray(args[2][0]).reshape(-1)[0])
+    rx = re.compile(pattern)
+    return _obj([rx.sub(repl, s) if s is not None else None for s in v]), m
+
+
+@host_fn("md5")
+def _md5(args):
+    (v, m), = args
+    return _obj([hashlib.md5(str(s).encode()).hexdigest()
+                 if s is not None else None for s in v]), m
+
+
+@host_fn("sha256")
+def _sha256(args):
+    (v, m), = args
+    return _obj([hashlib.sha256(str(s).encode()).hexdigest()
+                 if s is not None else None for s in v]), m
+
+
+@host_fn("get_json_objects")
+def _get_json_objects(args):
+    import json as _json
+
+    v, m = args[0]
+    path = str(np.asarray(args[1][0]).reshape(-1)[0])
+    keys = [p for p in path.replace("$.", "").split(".") if p]
+    out = []
+    for s in v:
+        try:
+            obj = _json.loads(s)
+            for k in keys:
+                obj = obj[k]
+            out.append(_json.dumps(obj) if isinstance(obj, (dict, list))
+                       else obj)
+        except Exception:
+            out.append(None)
+    mask = np.array([o is not None for o in out])
+    return _obj(out), mask if m is None else (m & mask)
+
+
+@host_fn("hash")
+def _hash(args):
+    from ..types import hash_any_column
+
+    (v, m), = args
+    return hash_any_column(np.asarray(v)).astype(np.int64), m

@@ -1,0 +1,852 @@
+"""SQL planner: AST -> logical dataflow Program.
+
+The analog of the reference's ``SqlPipelineBuilder`` + ``PlanGraph``
+(arroyo-sql/src/pipeline.rs:384-441, plan_graph.rs:36-94) with its optimizer
+decisions folded in: mergeable windowed aggregates plan straight onto the
+two-phase binned aggregator (the reference's two-phase rewrite,
+optimizations.rs:241-291), session windows and DISTINCT aggregates fall back
+to the buffered window operator, aggregate-without-window becomes the
+updating NonWindowAggregator, and joins become windowed hash joins (window
+equality present) or TTL'd updating joins."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..graph.logical import (
+    AggKind,
+    AggSpec,
+    ColumnExpr,
+    ExprReturnType,
+    InstantWindow,
+    JoinType,
+    LogicalOperator,
+    OpKind,
+    Program,
+    SessionWindow,
+    SlidingWindow,
+    Stream,
+    TumblingWindow,
+)
+from .ast_nodes import (
+    BinaryOp,
+    Case,
+    Cast,
+    ColumnRef,
+    CreateTable,
+    DerivedTable,
+    Expr,
+    FunctionCall,
+    Insert,
+    IntervalLit,
+    IsNull,
+    Join,
+    JoinKind,
+    Literal,
+    NamedTable,
+    Select,
+    SelectItem,
+    Star,
+    TableRef,
+    UnaryOp,
+)
+from .compiler import Compiled, Schema, SqlCompileError, StructDef, compile_scalar
+from .parser import parse_sql
+from .schema_provider import SchemaProvider, TableDef
+
+AGG_NAMES = {"count", "sum", "min", "max", "avg"}
+DEFAULT_JOIN_TTL = 3_600_000_000  # 1h, micros
+DEFAULT_UPDATING_TTL = 86_400_000_000  # 1d (reference updating default)
+
+
+class SqlPlanError(ValueError):
+    pass
+
+
+def _expr_name(e: Expr, i: int) -> str:
+    if isinstance(e, ColumnRef):
+        return e.name.lower()
+    if isinstance(e, FunctionCall):
+        return f"{e.name}_{i}"
+    if isinstance(e, Cast):
+        return _expr_name(e.operand, i)
+    return f"expr_{i}"
+
+
+def _window_from_call(fc: FunctionCall):
+    def micros(arg):
+        if isinstance(arg, IntervalLit):
+            return arg.micros
+        raise SqlPlanError(f"{fc.name}() arguments must be INTERVALs")
+
+    if fc.name == "tumble":
+        return TumblingWindow(micros(fc.args[0]))
+    if fc.name == "hop":
+        if len(fc.args) != 2:
+            raise SqlPlanError("hop(slide, width) takes two intervals")
+        return SlidingWindow(width_micros=micros(fc.args[1]),
+                             slide_micros=micros(fc.args[0]))
+    if fc.name == "session":
+        return SessionWindow(micros(fc.args[0]))
+    return None
+
+
+class AggCollector:
+    """Find aggregate calls in an expression tree and replace them with
+    placeholder column refs ``__agg{i}``."""
+
+    def __init__(self) -> None:
+        self.aggs: List[FunctionCall] = []
+
+    def rewrite(self, e: Expr) -> Expr:
+        if isinstance(e, FunctionCall):
+            if e.name in AGG_NAMES:
+                for j, existing in enumerate(self.aggs):
+                    if repr(existing) == repr(e):
+                        return ColumnRef(f"__agg{j}")
+                self.aggs.append(e)
+                return ColumnRef(f"__agg{len(self.aggs) - 1}")
+            return FunctionCall(e.name, [self.rewrite(a) for a in e.args],
+                                e.distinct)
+        if isinstance(e, BinaryOp):
+            return BinaryOp(e.op, self.rewrite(e.left), self.rewrite(e.right))
+        if isinstance(e, UnaryOp):
+            return UnaryOp(e.op, self.rewrite(e.operand))
+        if isinstance(e, Cast):
+            return Cast(self.rewrite(e.operand), e.target_type)
+        if isinstance(e, IsNull):
+            return IsNull(self.rewrite(e.operand), e.negated)
+        if isinstance(e, Case):
+            return Case(
+                self.rewrite(e.operand) if e.operand else None,
+                [(self.rewrite(c), self.rewrite(v)) for c, v in e.whens],
+                self.rewrite(e.else_) if e.else_ else None)
+        return e
+
+
+def _has_aggregates(sel: Select) -> bool:
+    c = AggCollector()
+    for item in sel.items:
+        if not isinstance(item.expr, Star):
+            c.rewrite(item.expr)
+    if sel.having is not None:
+        c.rewrite(sel.having)
+    return bool(c.aggs) or bool(sel.group_by)
+
+
+def _wrap_record(compiled: List[Tuple[str, Compiled]], passthrough: List[str]
+                 ) -> Callable:
+    """Build a cols->cols projection fn from compiled items."""
+
+    def fn(cols: Dict[str, Any]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for name, c in compiled:
+            v, _m = c.fn(cols)
+            if not hasattr(v, "shape") and not isinstance(v, np.ndarray):
+                # scalar literal: broadcast to batch length
+                n = len(cols["__timestamp"])
+                v = np.full(n, v)
+            out[name] = v
+        for name in passthrough:
+            if name in cols:
+                out[name] = cols[name]
+        # NOTE: __timestamp is deliberately NOT passed through here — the
+        # engine preserves batch.timestamp (int64 micros) host-side when the
+        # projection doesn't set it, keeping epoch timestamps out of jit
+        # (where x64-disabled JAX would truncate them to int32)
+        return out
+
+    return fn
+
+
+def _wrap_predicate(compiled: Compiled) -> Callable:
+    def fn(cols: Dict[str, Any]) -> Any:
+        v, m = compiled.fn(cols)
+        import jax.numpy as jnp
+
+        v = jnp.asarray(v).astype(bool) if not isinstance(v, np.ndarray) \
+            else v.astype(bool)
+        if m is not None:
+            v = v & m
+        return v
+
+    return fn
+
+
+@dataclass
+class Planned:
+    stream: Stream
+    schema: Schema
+
+
+class Planner:
+    def __init__(self, provider: Optional[SchemaProvider] = None):
+        self.provider = provider or SchemaProvider()
+        self._sql_counter = 0
+
+    # -- top level ---------------------------------------------------------
+
+    def plan(self, sql: str, query_parallelism: int = 1) -> Program:
+        """parse_and_get_program analog (arroyo-sql/src/lib.rs:350-362)."""
+        stmts = parse_sql(sql)
+        program: Optional[Program] = None
+        inserts: List[Insert] = []
+        selects: List[Select] = []
+        for s in stmts:
+            if isinstance(s, CreateTable):
+                self.provider.add_create_table(s)
+            elif isinstance(s, Insert):
+                inserts.append(s)
+            elif isinstance(s, Select):
+                selects.append(s)
+
+        self.parallelism = query_parallelism
+        prog = Program()
+        if inserts:
+            for ins in inserts:
+                self._plan_insert(ins, prog)
+        elif selects:
+            # bare SELECT: attach the preview sink (the reference auto-adds a
+            # GrpcSink streaming results to the console, lib.rs:386-418)
+            planned = self.plan_select(selects[-1], prog, {})
+            planned.stream.sink("memory", {"name": "results"})
+        else:
+            raise SqlPlanError("no executable statement (SELECT/INSERT) found")
+        return prog
+
+    def _plan_insert(self, ins: Insert, prog: Program) -> None:
+        sink_table = self.provider.get(ins.table)
+        if not sink_table.is_sink and sink_table.connector in ("kafka",):
+            pass
+        planned = self.plan_select(ins.query, prog, {})
+        # positional projection onto the sink's declared columns
+        declared = [c.name.lower() for c in sink_table.columns]
+        have = [c for c in planned.schema.columns if not c.startswith("__")]
+        if declared and len(declared) == len(have) and declared != have:
+            mapping = list(zip(declared, have))
+
+            def rename(cols, _mapping=mapping):
+                out = {new: cols[old] for new, old in _mapping}
+                out["__timestamp"] = cols["__timestamp"]
+                return out
+
+            planned = Planned(
+                planned.stream.udf(rename, name=f"to_{ins.table}"),
+                planned.schema)
+        planned.stream.sink(sink_table.connector, sink_table.config,
+                            name=f"{ins.table}_sink")
+
+    # -- FROM --------------------------------------------------------------
+
+    def plan_select(self, sel: Select, prog: Program,
+                    ctes: Dict[str, Planned]) -> Planned:
+        scope = dict(ctes)
+        for name, cte_sel in sel.ctes:
+            scope[name.lower()] = self.plan_select(cte_sel, prog, scope)
+
+        if sel.from_ is None:
+            raise SqlPlanError("SELECT without FROM is not a stream")
+        upstream = self._plan_table_ref(sel.from_, prog, scope)
+
+        # WHERE
+        if sel.where is not None:
+            upstream = self._filter(upstream, sel.where, "where")
+
+        if _has_aggregates(sel):
+            planned = self._plan_aggregate(sel, upstream)
+        else:
+            planned = self._plan_projection(sel, upstream)
+
+        if sel.having is not None and not _has_aggregates(sel):
+            planned = self._filter(planned, sel.having, "having")
+
+        if sel.order_by and sel.limit is not None:
+            planned = self._plan_top_n(sel, planned)
+        return planned
+
+    def _plan_table_ref(self, tr: TableRef, prog: Program,
+                        scope: Dict[str, Planned]) -> Planned:
+        if isinstance(tr, NamedTable):
+            key = tr.name.lower()
+            if key in scope:
+                base = scope[key]
+                schema = base.schema.clone()
+                if tr.alias:
+                    schema.aliases.add(tr.alias)
+                schema.aliases.add(tr.name)
+                return Planned(base.stream, schema)
+            td = self.provider.get(tr.name)
+            planned = self._plan_source(td, prog)
+            schema = planned.schema.clone()
+            if tr.alias:
+                schema.aliases.add(tr.alias)
+            schema.aliases.add(tr.name)
+            return Planned(planned.stream, schema)
+        if isinstance(tr, DerivedTable):
+            planned = self.plan_select(tr.query, prog, scope)
+            schema = planned.schema.clone()
+            if tr.alias:
+                schema.aliases.add(tr.alias)
+            return Planned(planned.stream, schema)
+        if isinstance(tr, Join):
+            return self._plan_join(tr, prog, scope)
+        raise SqlPlanError(f"unsupported FROM clause {tr!r}")
+
+    def _plan_source(self, td: TableDef, prog: Program) -> Planned:
+        stream = Stream.source(td.connector, td.config, program=prog,
+                               parallelism=self.parallelism,
+                               name=f"{td.name}_source")
+        schema = td.schema.clone()
+
+        # generated (virtual) columns (tables.rs virtual fields)
+        if td.generated:
+            compiled = []
+            for name, kind, expr in td.generated:
+                compiled.append((name, compile_scalar(expr, schema)))
+            passthrough = [c for c in schema.columns
+                           if c not in {n for n, _, _ in td.generated}]
+            fn = _wrap_record(compiled, passthrough)
+            # timestamp-typed generated columns stay host-side (int64 micros)
+            host = (any(c.needs_host for _, c in compiled)
+                    or any(kind == "t" for _, kind, _ in td.generated))
+            stream = (stream.udf(fn, name=f"{td.name}_virtual") if host
+                      else stream.map(fn, name=f"{td.name}_virtual"))
+
+        # event-time column (host path: timestamps are int64 micros)
+        if td.event_time_field:
+            et = td.event_time_field.lower()
+
+            def set_ts(cols, _et=et):
+                out = dict(cols)
+                out["__timestamp"] = np.asarray(cols[_et], dtype=np.int64)
+                return out
+
+            stream = stream.udf(set_ts, name=f"{td.name}_event_time")
+
+        # watermark generator
+        if td.watermark_field:
+            wf = td.watermark_field.lower()
+            stream = stream.watermark(
+                expression=lambda cols, _wf=wf: {"__timestamp": cols[_wf]},
+                name=f"{td.name}_watermark")
+        else:
+            stream = stream.watermark(
+                max_lateness_micros=td.default_lateness_micros,
+                name=f"{td.name}_watermark")
+        return Planned(stream, schema)
+
+    # -- filters / projections --------------------------------------------
+
+    def _filter(self, planned: Planned, pred: Expr, name: str) -> Planned:
+        compiled = compile_scalar(pred, planned.schema)
+        fn = _wrap_predicate(compiled)
+        expr = ColumnExpr(f"{name}_{self._next_id()}", fn,
+                          ExprReturnType.PREDICATE)
+        if compiled.needs_host:
+            stream = planned.stream._chain(LogicalOperator(
+                OpKind.UDF, expr.name,
+                expr=ColumnExpr(expr.name, self._host_filter(fn),
+                                ExprReturnType.RECORD)))
+        else:
+            stream = planned.stream.filter(fn, name=expr.name)
+        return Planned(stream, planned.schema)
+
+    @staticmethod
+    def _host_filter(pred_fn):
+        def fn(cols):
+            mask = np.asarray(pred_fn(cols)).astype(bool)
+            return {k: np.asarray(v)[mask] for k, v in cols.items()}
+
+        return fn
+
+    def _next_id(self) -> int:
+        self._sql_counter += 1
+        return self._sql_counter
+
+    def _expand_items(self, sel: Select, schema: Schema
+                      ) -> List[Tuple[str, Expr]]:
+        """Resolve * and name every projection item."""
+        out: List[Tuple[str, Expr]] = []
+        for i, item in enumerate(sel.items):
+            if isinstance(item.expr, Star):
+                q = item.expr.qualifier
+                if q and (q in schema.structs or q.lower() in schema.structs):
+                    sd = schema.structs.get(q) or schema.structs[q.lower()]
+                    for fname, phys in sd.fields.items():
+                        out.append((fname, ColumnRef(fname, sd.name)))
+                else:
+                    for col in schema.columns:
+                        if not col.startswith("__"):
+                            out.append((col, ColumnRef(col)))
+                    if schema.window:
+                        pass
+                continue
+            name = item.alias.lower() if item.alias else _expr_name(item.expr, i)
+            out.append((name, item.expr))
+        return out
+
+    def _plan_projection(self, sel: Select, planned: Planned) -> Planned:
+        schema = planned.schema
+        items = self._expand_items(sel, schema)
+
+        compiled: List[Tuple[str, Compiled]] = []
+        new_schema = Schema(aliases=set(), window=False,
+                            window_names=set())
+        passthrough: List[str] = []
+        needs_host = False
+        identity = True
+        for name, expr in items:
+            if isinstance(expr, ColumnRef):
+                try:
+                    kind, target = schema.resolve(expr)
+                except SqlCompileError:
+                    kind, target = "col", None
+                if kind == "struct":
+                    sd: StructDef = target
+                    new_schema.structs[name] = StructDef(
+                        name, dict(sd.fields), sd.presence_col,
+                        sd.presence_val)
+                    passthrough.extend(sd.fields.values())
+                    if sd.presence_col:
+                        passthrough.append(sd.presence_col)
+                    for f, phys in sd.fields.items():
+                        if phys in schema.columns:
+                            new_schema.columns[phys] = schema.columns[phys]
+                    continue
+                if kind == "window":
+                    new_schema.window = True
+                    new_schema.window_names.add(name)
+                    passthrough.extend(["window_start", "window_end"])
+                    new_schema.columns["window_start"] = "t"
+                    new_schema.columns["window_end"] = "t"
+                    continue
+            c = compile_scalar(expr, schema)
+            needs_host = needs_host or c.needs_host
+            compiled.append((name, c))
+            new_schema.columns[name] = self._infer_kind(expr, schema)
+            if not (isinstance(expr, ColumnRef)
+                    and schema.resolve(expr) == ("col", name)):
+                identity = False
+
+        if identity and not compiled and passthrough:
+            # pure struct/window passthrough — no map needed
+            return Planned(planned.stream, new_schema)
+
+        fn = _wrap_record(compiled, passthrough)
+        name = f"project_{self._next_id()}"
+        stream = (planned.stream.udf(fn, name=name) if needs_host
+                  else planned.stream.map(fn, name=name))
+        return Planned(stream, new_schema)
+
+    def _infer_kind(self, e: Expr, schema: Schema) -> str:
+        if isinstance(e, ColumnRef):
+            try:
+                kind, target = schema.resolve(e)
+                if kind == "col":
+                    return schema.columns.get(target, "n")
+            except SqlCompileError:
+                return "n"
+        if isinstance(e, Cast):
+            from .schema_provider import TYPE_KIND
+
+            return TYPE_KIND.get(e.target_type, "n")
+        if isinstance(e, Literal):
+            return {"int": "i", "float": "f", "string": "s",
+                    "bool": "b"}.get(e.type, "n")
+        if isinstance(e, FunctionCall) and e.name in (
+                "upper", "lower", "concat", "substr", "substring", "trim",
+                "replace", "split_part", "regexp_replace", "md5", "sha256"):
+            return "s"
+        return "n"
+
+    # -- aggregates --------------------------------------------------------
+
+    def _plan_aggregate(self, sel: Select, planned: Planned) -> Planned:
+        schema = planned.schema
+        items = self._expand_items(sel, schema)
+
+        # resolve GROUP BY: ordinals, window functions, aliases
+        window = None
+        grouped_by_window = False  # GROUP BY the window col of a windowed input
+        group_exprs: List[Tuple[str, Expr]] = []
+        for ge in sel.group_by:
+            e = ge
+            if isinstance(e, Literal) and e.type == "int":
+                name, e = items[e.value - 1]
+            elif isinstance(e, ColumnRef) and e.qualifier is None:
+                matched = [n for n, ie in items
+                           if n == e.name.lower()]
+                if matched:
+                    e = dict(items)[matched[0]]
+                name = _expr_name(ge, 0)
+            else:
+                name = _expr_name(ge, len(group_exprs))
+            if isinstance(e, FunctionCall):
+                w = _window_from_call(e)
+                if w is not None:
+                    if window is not None and w != window:
+                        raise SqlPlanError("multiple windows in GROUP BY")
+                    window = w
+                    continue
+            if isinstance(e, ColumnRef):
+                try:
+                    if schema.resolve(e)[0] == "window":
+                        # re-aggregation keyed by the upstream window (q5's
+                        # MaxBids: GROUP BY window): key on window_end and
+                        # carry window_start through as a dependent key
+                        grouped_by_window = True
+                        group_exprs.append(("window_end",
+                                            ColumnRef("window_end")))
+                        group_exprs.append(("window_start",
+                                            ColumnRef("window_start")))
+                        continue
+                except SqlCompileError:
+                    pass
+            group_exprs.append((name, e))
+
+        # map group expressions to their materialized key columns so that
+        # post-aggregation references (e.g. `auction.id` appearing in SELECT)
+        # resolve to the key column instead of the pre-agg schema
+        group_repr = {repr(e): name for name, e in group_exprs}
+
+        def sub_group(e: Expr) -> Expr:
+            if repr(e) in group_repr:
+                return ColumnRef(group_repr[repr(e)])
+            if isinstance(e, BinaryOp):
+                return BinaryOp(e.op, sub_group(e.left), sub_group(e.right))
+            if isinstance(e, UnaryOp):
+                return UnaryOp(e.op, sub_group(e.operand))
+            if isinstance(e, Cast):
+                return Cast(sub_group(e.operand), e.target_type)
+            if isinstance(e, FunctionCall) and e.name not in AGG_NAMES:
+                return FunctionCall(e.name, [sub_group(a) for a in e.args],
+                                    e.distinct)
+            return e
+
+        # collect aggregates from items (+ having), rewrite exprs
+        collector = AggCollector()
+        post_items: List[Tuple[str, Expr]] = []
+        window_item_names: List[str] = []
+        for name, expr in items:
+            expr = sub_group(expr)
+            if isinstance(expr, FunctionCall) and _window_from_call(expr):
+                window_item_names.append(name)
+                continue
+            if isinstance(expr, ColumnRef):
+                try:
+                    if schema.resolve(expr)[0] == "window":
+                        window_item_names.append(name)
+                        continue
+                except SqlCompileError:
+                    pass
+            post_items.append((name, collector.rewrite(expr)))
+        having_rewritten = (collector.rewrite(sub_group(sel.having))
+                            if sel.having is not None else None)
+
+        # materialize group keys + agg inputs (pre-projection)
+        pre_compiled: List[Tuple[str, Compiled]] = []
+        key_cols: List[str] = []
+        key_kinds: Dict[str, str] = {}
+        for name, e in group_exprs:
+            col = name
+            pre_compiled.append((col, compile_scalar(e, schema)))
+            key_cols.append(col)
+            key_kinds[col] = self._infer_kind(e, schema)
+
+        aggs: List[AggSpec] = []
+        post_fixups: Dict[str, Tuple[str, str]] = {}  # out -> (sum_col, cnt_col)
+        int_outputs: List[str] = []
+        needs_generic = isinstance(window, SessionWindow)
+        for j, fc in enumerate(collector.aggs):
+            out = f"__agg{j}"
+            arg = fc.args[0] if fc.args else None
+            if fc.distinct:
+                needs_generic = True
+                col = f"__ain{j}"
+                pre_compiled.append((col, compile_scalar(arg, schema)))
+                aggs.append(AggSpec(AggKind.COUNT_DISTINCT, col, out))
+                int_outputs.append(out)
+                continue
+            if fc.name == "count":
+                if arg is None or isinstance(arg, Star):
+                    aggs.append(AggSpec(AggKind.COUNT, None, out))
+                    int_outputs.append(out)
+                else:
+                    c = compile_scalar(arg, schema)
+                    col = f"__ain{j}"
+                    pre_compiled.append((col, self._mask_indicator(c)))
+                    aggs.append(AggSpec(AggKind.SUM, col, out))
+                    int_outputs.append(out)
+                continue
+            c = compile_scalar(arg, schema)
+            col = f"__ain{j}"
+            kind = AggKind[fc.name.upper()]
+            fill = {"sum": 0.0, "avg": 0.0, "min": float("inf"),
+                    "max": float("-inf")}[fc.name]
+            pre_compiled.append((col, self._mask_fill(c, fill)))
+            aggs.append(AggSpec(kind, col, out))
+
+        pre_fn = _wrap_record(pre_compiled, [])
+        pre_host = any(c.needs_host for _, c in pre_compiled)
+        pname = f"agg_input_{self._next_id()}"
+        stream = (planned.stream.udf(pre_fn, name=pname) if pre_host
+                  else planned.stream.map(pre_fn, name=pname))
+
+        # key + window operator
+        if key_cols:
+            stream = stream.key_by(*key_cols)
+        else:
+            stream = stream.global_key()
+
+        if window is None:
+            stream = stream.non_window_aggregate(DEFAULT_UPDATING_TTL, aggs)
+            post_updating = True
+        else:
+            post_updating = False
+            if needs_generic:
+                stream = stream.window(window, aggs)
+            elif isinstance(window, TumblingWindow):
+                stream = stream.tumbling_aggregate(window.width_micros, aggs)
+            elif isinstance(window, SlidingWindow):
+                stream = stream.sliding_aggregate(window.width_micros,
+                                                  window.slide_micros, aggs)
+            else:
+                stream = stream.window(window, aggs)
+
+        # post-projection schema: keys + window + agg outputs
+        mid_schema = Schema(window=(window is not None or grouped_by_window))
+        for col in key_cols:
+            mid_schema.columns[col] = key_kinds.get(col, "n")
+        for j, a in enumerate(aggs):
+            mid_schema.columns[a.output] = (
+                "i" if a.output in int_outputs else "f")
+        windowed_out = window is not None or grouped_by_window
+        if windowed_out:
+            mid_schema.columns["window_start"] = "t"
+            mid_schema.columns["window_end"] = "t"
+            mid_schema.window_names = set(window_item_names) | {"window"}
+
+        post_compiled: List[Tuple[str, Compiled]] = []
+        out_schema = Schema(window=windowed_out,
+                            window_names=set(window_item_names) | (
+                                {"window"} if windowed_out else set()))
+        passthrough: List[str] = []
+        if windowed_out:
+            passthrough.extend(["window_start", "window_end"])
+            out_schema.columns["window_start"] = "t"
+            out_schema.columns["window_end"] = "t"
+        for name, e in post_items:
+            c = compile_scalar(e, mid_schema)
+            cast_int = (isinstance(e, ColumnRef) and e.qualifier is None
+                        and e.name in int_outputs)
+            if cast_int:
+                c = self._cast_int(c)
+            post_compiled.append((name, c))
+            out_schema.columns[name] = self._infer_kind(e, mid_schema) \
+                if not cast_int else "i"
+        if post_updating:
+            from ..types import UPDATE_OP_COLUMN
+
+            passthrough.append(UPDATE_OP_COLUMN)
+
+        post_fn = _wrap_record(post_compiled, passthrough)
+        post_host = any(c.needs_host for _, c in post_compiled)
+        pname2 = f"agg_project_{self._next_id()}"
+        stream = (stream.udf(post_fn, name=pname2) if post_host
+                  else stream.map(post_fn, name=pname2))
+        planned2 = Planned(stream, out_schema)
+        if having_rewritten is not None:
+            having_schema = out_schema.clone()
+            for j in range(len(aggs)):
+                having_schema.columns.setdefault(f"__agg{j}", "f")
+            # HAVING may reference agg placeholders not projected; re-project
+            # them through by compiling against mid_schema on the agg output
+            planned2 = self._filter(planned2, having_rewritten, "having")
+        return planned2
+
+    @staticmethod
+    def _mask_indicator(c: Compiled) -> Compiled:
+        def fn(env):
+            import jax.numpy as jnp
+
+            v, m = c.fn(env)
+            if m is None:
+                base = jnp.ones_like(jnp.asarray(v), dtype=jnp.float32) \
+                    if hasattr(v, "shape") else 1.0
+                return base, None
+            return jnp.asarray(m).astype(jnp.float32), None
+
+        return Compiled(fn, c.needs_host, c.sql)
+
+    @staticmethod
+    def _mask_fill(c: Compiled, fill: float) -> Compiled:
+        def fn(env):
+            import jax.numpy as jnp
+
+            v, m = c.fn(env)
+            if m is None:
+                return v, None
+            return jnp.where(m, v, fill), None
+
+        return Compiled(fn, c.needs_host, c.sql)
+
+    @staticmethod
+    def _normalize_key(c: Compiled) -> Compiled:
+        def fn(env):
+            import jax.numpy as jnp
+
+            v, m = c.fn(env)
+            arr = np.asarray(v) if isinstance(v, np.ndarray) else v
+            if isinstance(arr, np.ndarray) and arr.dtype == object:
+                return v, m
+            return jnp.asarray(v).astype(jnp.float32), m
+
+        return Compiled(fn, c.needs_host, c.sql)
+
+    @staticmethod
+    def _cast_int(c: Compiled) -> Compiled:
+        def fn(env):
+            import jax.numpy as jnp
+
+            v, m = c.fn(env)
+            return jnp.asarray(v).astype(jnp.int64), m
+
+        return Compiled(fn, c.needs_host, c.sql)
+
+    # -- TopN --------------------------------------------------------------
+
+    def _plan_top_n(self, sel: Select, planned: Planned) -> Planned:
+        """ORDER BY ... LIMIT n over a windowed stream -> per-window TopN
+        (the reference's window-TopN rewrite, optimizations.rs:293-501)."""
+        if not planned.schema.window:
+            raise SqlPlanError(
+                "ORDER BY/LIMIT requires a windowed input in streaming SQL")
+        item = sel.order_by[0]
+        if not isinstance(item.expr, ColumnRef):
+            raise SqlPlanError("ORDER BY expression must be a column")
+        col = item.expr.name.lower()
+        if not item.desc:
+            raise SqlPlanError("streaming TopN requires ORDER BY ... DESC")
+        # partition per window instance: handled inside TopN by window_end
+        stream = planned.stream._chain(LogicalOperator(
+            OpKind.TUMBLING_TOP_N, f"topn_{self._next_id()}",
+            spec=__import__(
+                "arroyo_tpu.graph.logical", fromlist=["TopNSpec"]
+            ).TopNSpec(width_micros=1, max_elements=sel.limit,
+                       sort_column=col, partition_cols=())))
+        return Planned(stream, planned.schema)
+
+    # -- joins -------------------------------------------------------------
+
+    def _plan_join(self, j: Join, prog: Program,
+                   scope: Dict[str, Planned]) -> Planned:
+        left = self._plan_table_ref(j.left, prog, scope)
+        right = self._plan_table_ref(j.right, prog, scope)
+
+        if j.on is None:
+            raise SqlPlanError("JOIN requires an ON clause")
+        pairs = self._split_on(j.on, left.schema, right.schema)
+
+        window_join = False
+        lkeys: List[Expr] = []
+        rkeys: List[Expr] = []
+        for le, re_ in pairs:
+            lw = self._is_window_ref(le, left.schema)
+            rw = self._is_window_ref(re_, right.schema)
+            if lw and rw:
+                window_join = True
+                lkeys.append(ColumnRef("window_end"))
+                rkeys.append(ColumnRef("window_end"))
+            else:
+                lkeys.append(le)
+                rkeys.append(re_)
+
+        # numeric join keys normalize to float32 so that e.g. an int64 COUNT
+        # equi-joins against a float aggregate (both sides hash identically)
+        lpre = [(f"__jk{i}", self._normalize_key(compile_scalar(e, left.schema)))
+                for i, e in enumerate(lkeys)]
+        rpre = [(f"__jk{i}", self._normalize_key(compile_scalar(e, right.schema)))
+                for i, e in enumerate(rkeys)]
+        lcols = [c for c in left.schema.columns if not c.startswith("__")]
+        rcols = [c for c in right.schema.columns if not c.startswith("__")]
+        lstream = left.stream.map(_wrap_record(lpre, lcols),
+                                  name=f"join_lkey_{self._next_id()}")
+        rstream = right.stream.map(_wrap_record(rpre, rcols),
+                                   name=f"join_rkey_{self._next_id()}")
+        jcols = [f"__jk{i}" for i in range(len(lkeys))]
+        lstream = lstream.key_by(*jcols)
+        rstream = rstream.key_by(*jcols)
+
+        kind = JoinType[j.kind.name]
+        if window_join:
+            out = lstream.window_join(rstream, InstantWindow(),
+                                      name=f"window_join_{self._next_id()}")
+        else:
+            out = lstream.join_with_expiration(
+                rstream, DEFAULT_JOIN_TTL, DEFAULT_JOIN_TTL, kind,
+                name=f"join_{self._next_id()}")
+
+        schema = Schema(aliases=left.schema.aliases | right.schema.aliases)
+        for c in lcols:
+            schema.columns[c] = left.schema.columns[c]
+        for c in rcols:
+            name = c if c not in schema.columns else f"r_{c}"
+            schema.columns[name] = right.schema.columns[c]
+        schema.structs = {**right.schema.structs, **left.schema.structs}
+        if left.schema.window and right.schema.window:
+            schema.window = True
+            schema.window_names = (left.schema.window_names
+                                   | right.schema.window_names | {"window"})
+        return Planned(out, schema)
+
+    def _split_on(self, on: Expr, ls: Schema, rs: Schema
+                  ) -> List[Tuple[Expr, Expr]]:
+        conjuncts: List[Expr] = []
+
+        def flatten(e: Expr):
+            if isinstance(e, BinaryOp) and e.op == "and":
+                flatten(e.left)
+                flatten(e.right)
+            else:
+                conjuncts.append(e)
+
+        flatten(on)
+        pairs: List[Tuple[Expr, Expr]] = []
+        for c in conjuncts:
+            if not (isinstance(c, BinaryOp) and c.op == "="):
+                raise SqlPlanError(f"JOIN ON supports equality only, got {c!r}")
+            a, b = c.left, c.right
+            if self._belongs(a, ls) and self._belongs(b, rs):
+                pairs.append((a, b))
+            elif self._belongs(b, ls) and self._belongs(a, rs):
+                pairs.append((b, a))
+            else:
+                raise SqlPlanError(
+                    f"cannot attribute join condition {c!r} to sides")
+        return pairs
+
+    def _belongs(self, e: Expr, schema: Schema) -> bool:
+        try:
+            compile_scalar(e, schema)
+            return True
+        except SqlCompileError:
+            if self._is_window_ref(e, schema):
+                return True
+            return False
+
+    @staticmethod
+    def _is_window_ref(e: Expr, schema: Schema) -> bool:
+        if isinstance(e, ColumnRef):
+            try:
+                return schema.resolve(e)[0] == "window"
+            except SqlCompileError:
+                return False
+        return False
+
+
+def plan_sql(sql: str, provider: Optional[SchemaProvider] = None,
+             parallelism: int = 1) -> Program:
+    return Planner(provider).plan(sql, parallelism)

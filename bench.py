@@ -1,0 +1,77 @@
+"""Benchmark: Nexmark q5 (hot items — sliding-window count + windowed max
+join) end-to-end through the SQL-planned engine on the available accelerator.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference publishes no numbers (BASELINE.md) — its README
+claims "millions of events per second", so vs_baseline normalizes to 1M
+events/sec (vs_baseline = events_per_sec / 1e6).
+"""
+
+import json
+import os
+import sys
+import time
+
+NUM_EVENTS = int(os.environ.get("BENCH_EVENTS", 2_000_000))
+BATCH = int(os.environ.get("BENCH_BATCH", 65536))
+
+
+Q5 = """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark', event_rate = '1000000',
+  num_events = '{n}', rate_limited = 'false', batch_size = '{b}'
+);
+WITH bids as (SELECT bid.auction as auction, bid.datetime as datetime
+    FROM nexmark where bid is not null)
+SELECT AuctionBids.auction as auction, AuctionBids.num as num
+FROM (
+  SELECT B1.auction, HOP(INTERVAL '2' SECOND, INTERVAL '10' SECOND)
+         as window, count(*) AS num
+  FROM bids B1 GROUP BY 1, 2
+) AS AuctionBids
+JOIN (
+  SELECT max(num) AS maxn, window
+  FROM (
+    SELECT count(*) AS num,
+           HOP(INTERVAL '2' SECOND, INTERVAL '10' SECOND) AS window
+    FROM bids B2 GROUP BY B2.auction, 2
+  ) AS CountBids
+  GROUP BY 2
+) AS MaxBids
+ON AuctionBids.num = MaxBids.maxn and AuctionBids.window = MaxBids.window
+"""
+
+
+def main() -> None:
+    from arroyo_tpu.connectors.memory import clear_sink, sink_output
+    from arroyo_tpu.engine.engine import LocalRunner
+    from arroyo_tpu.sql import plan_sql
+
+    os.environ.setdefault("BATCH_SIZE", str(BATCH))
+
+    sql = Q5.format(n=NUM_EVENTS, b=BATCH)
+    # warmup: compile all kernels on a small stream
+    clear_sink("results")
+    LocalRunner(plan_sql(sql.replace(str(NUM_EVENTS), "100000", 1))).run()
+
+    clear_sink("results")
+    prog = plan_sql(sql)
+    t0 = time.perf_counter()
+    LocalRunner(prog).run()
+    dt = time.perf_counter() - t0
+    outs = sink_output("results")
+    n_out = sum(len(b) for b in outs)
+    assert n_out > 0, "q5 produced no output"
+
+    eps = NUM_EVENTS / dt
+    print(json.dumps({
+        "metric": "nexmark_q5_events_per_sec",
+        "value": round(eps, 1),
+        "unit": "events/sec",
+        "vs_baseline": round(eps / 1_000_000.0, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,10 +1,16 @@
 """Test configuration: force JAX onto a virtual 8-device CPU mesh so sharding
 tests run without TPU hardware (the driver dry-runs the multi-chip path the
-same way)."""
+same way).
+
+NOTE: if the axon TPU tunnel is flaky, run tests with the axon plugin
+disabled entirely (its sitecustomize registration is env-gated):
+
+    env -u PALLAS_AXON_POOL_IPS python -m pytest tests/ -q
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # tests always run on the CPU mesh
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

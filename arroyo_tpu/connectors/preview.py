@@ -1,0 +1,58 @@
+"""Preview (grpc) sink: streams results to the controller, which fans them
+out to SubscribeToOutput subscribers — the reference's GrpcSink feeding the
+console's output pane (arroyo-worker/src/connectors/sinks/mod.rs:11-80)."""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, Optional
+
+from ..config import config
+from ..engine.context import Context
+from ..engine.operator import Operator
+from ..network.data_plane import _encode_batch
+from ..rpc.transport import RpcClient
+from ..types import Batch
+from .registry import ConnectorMeta, register_connector
+
+logger = logging.getLogger(__name__)
+
+
+class PreviewSink(Operator):
+    def __init__(self, cfg: Dict[str, Any]):
+        super().__init__("preview_sink")
+        self.controller_addr = cfg.get("controller_addr") or \
+            config().controller_addr.replace("http://", "")
+        self.client: Optional[RpcClient] = None
+
+    async def on_start(self, ctx: Context) -> None:
+        self.client = RpcClient(self.controller_addr, "ControllerGrpc")
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        try:
+            await self.client.call("SendSinkData", {
+                "job_id": ctx.task_info.job_id,
+                "operator_id": ctx.task_info.operator_id,
+                "batch": _encode_batch(batch),
+                "done": False,
+            })
+        except Exception as e:
+            logger.warning("preview sink send failed: %s", e)
+
+    async def on_close(self, ctx: Context) -> None:
+        try:
+            await self.client.call("SendSinkData", {
+                "job_id": ctx.task_info.job_id,
+                "operator_id": ctx.task_info.operator_id,
+                "batch": b"", "done": True,
+            })
+            await self.client.close()
+        except Exception:
+            pass
+
+
+register_connector(ConnectorMeta(
+    name="preview",
+    description="stream results to the controller (console output pane)",
+    sink_factory=PreviewSink,
+))

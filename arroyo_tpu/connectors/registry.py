@@ -78,7 +78,7 @@ def _ensure_builtin() -> None:
     if _loaded:
         return
     _loaded = True
-    from . import impulse, single_file, blackhole, memory, nexmark  # noqa: F401
+    from . import impulse, single_file, blackhole, memory, nexmark, preview  # noqa: F401
     for mod in ("filesystem", "http_connectors", "kafka", "websocket_connector"):
         try:
             __import__(f"arroyo_tpu.connectors.{mod}")

@@ -1,0 +1,456 @@
+"""ControllerServer: the control plane — job submission, scheduling,
+supervision, checkpoint coordination, recovery.
+
+Folds together the reference's controller pieces:
+* gRPC service + job registry (arroyo-controller/src/lib.rs)
+* Scheduling state: slots = max operator parallelism, round-robin slot
+  packing, wait-for-registration (states/scheduling.rs:44-290)
+* JobController supervision: 30s heartbeat timeout, periodic checkpoints,
+  epoch bookkeeping, two-phase commit, cleanup (job_controller/mod.rs)
+* CheckpointState aggregation of per-subtask events into a job-level
+  checkpoint record (checkpointer.rs:67-410)
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import cloudpickle as pickle
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ..config import config
+from ..graph.logical import Program
+from ..rpc.transport import RpcClient, RpcServer
+from ..state.backend import ParquetBackend
+from ..types import now_micros
+from .scheduler import InProcessScheduler, Scheduler
+from .state_machine import JobState, StateMachine
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class WorkerInfo:
+    worker_id: str
+    rpc_address: str
+    data_address: str
+    slots: int
+    last_heartbeat: float = field(default_factory=time.monotonic)
+    client: Optional[RpcClient] = None
+    finished: bool = False
+
+
+@dataclass
+class CheckpointTracker:
+    """Aggregates per-subtask checkpoint completions for one epoch
+    (CheckpointState, checkpointer.rs:186-410)."""
+
+    epoch: int
+    n_subtasks: int
+    completed: Set[Tuple[str, int]] = field(default_factory=set)
+    has_committing: bool = False
+    started: float = field(default_factory=time.monotonic)
+
+    @property
+    def done(self) -> bool:
+        return len(self.completed) >= self.n_subtasks
+
+
+class Job:
+    def __init__(self, job_id: str, program: Program,
+                 checkpoint_url: str, parallelism: int):
+        self.job_id = job_id
+        self.program = program
+        self.checkpoint_url = checkpoint_url
+        self.parallelism = parallelism
+        self.fsm = StateMachine(job_id)
+        self.workers: Dict[str, WorkerInfo] = {}
+        self.epoch = 0
+        self.min_epoch = 0
+        self.trackers: Dict[int, CheckpointTracker] = {}
+        self.last_successful_epoch: Optional[int] = None
+        self.n_subtasks = sum(n.parallelism for n in program.nodes())
+        self.finished_tasks: Set[Tuple[str, int]] = set()
+        self.failure: Optional[str] = None
+        self.supervisor: Optional[asyncio.Task] = None
+        self.stop_requested = False
+
+    @property
+    def slots_needed(self) -> int:
+        return max(n.parallelism for n in self.program.nodes())
+
+
+class ControllerServer:
+    def __init__(self, scheduler: Optional[Scheduler] = None,
+                 host: str = "127.0.0.1"):
+        self.scheduler = scheduler or InProcessScheduler()
+        self.host = host
+        self.rpc = RpcServer()
+        self.jobs: Dict[str, Job] = {}
+        self.addr: Optional[str] = None
+        self.sink_subscribers: Dict[str, List[asyncio.Queue]] = {}
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self, port: int = 0) -> str:
+        self.rpc.add_service("ControllerGrpc", {
+            "RegisterWorker": self._register_worker,
+            "Heartbeat": self._heartbeat,
+            "TaskStarted": self._task_started,
+            "TaskCheckpointEvent": self._task_ckpt_event,
+            "TaskCheckpointCompleted": self._task_ckpt_completed,
+            "TaskFinished": self._task_finished,
+            "TaskFailed": self._task_failed,
+            "WorkerFinished": self._worker_finished,
+            "WorkerError": self._worker_error,
+            "SendSinkData": self._send_sink_data,
+        }, stream_methods={"SubscribeToOutput": self._subscribe_output})
+        p = await self.rpc.start(self.host, port)
+        self.addr = f"{self.host}:{p}"
+        return self.addr
+
+    async def stop(self) -> None:
+        for job in self.jobs.values():
+            if job.supervisor:
+                job.supervisor.cancel()
+        await self.rpc.stop()
+
+    # -- job API (what arroyo-api calls via gRPC/DB) ----------------------
+
+    async def submit_job(self, program: Program, job_id: Optional[str] = None,
+                         checkpoint_url: Optional[str] = None,
+                         n_workers: int = 1,
+                         restore: bool = False) -> str:
+        job_id = job_id or f"job-{uuid.uuid4().hex[:8]}"
+        job = Job(job_id, program,
+                  checkpoint_url or config().checkpoint_url,
+                  max(n.parallelism for n in program.nodes()))
+        self.jobs[job_id] = job
+        job.supervisor = asyncio.ensure_future(
+            self._drive(job, n_workers, restore))
+        return job_id
+
+    async def stop_job(self, job_id: str, checkpoint: bool = True) -> None:
+        job = self.jobs[job_id]
+        job.stop_requested = True
+        if job.fsm.state == JobState.RUNNING:
+            if checkpoint:
+                job.fsm.transition(JobState.CHECKPOINT_STOPPING)
+                await self._trigger_checkpoint(job, then_stop=True)
+            else:
+                job.fsm.transition(JobState.STOPPING)
+                await self._broadcast_workers(job, "StopExecution",
+                                              {"job_id": job_id,
+                                               "stop_mode": "graceful"})
+
+    async def rescale_job(self, job_id: str,
+                          overrides: Dict[str, int]) -> None:
+        """Rescaling path (states/rescaling.rs): checkpoint-stop, update
+        parallelism, reschedule with state re-sharded by key range."""
+        job = self.jobs[job_id]
+        job.fsm.transition(JobState.RESCALING)
+        await self._trigger_checkpoint(job, then_stop=True)
+        await self._await_workers_finished(job, timeout=30)
+        job.program.update_parallelism(overrides)
+        job.n_subtasks = sum(n.parallelism for n in job.program.nodes())
+        job.workers.clear()
+        job.finished_tasks.clear()
+        job.fsm.transition(JobState.SCHEDULING)
+        await self._schedule(job, n_workers=len(
+            self.scheduler.workers_for_job(job_id)) or 1, restore=True)
+        job.fsm.transition(JobState.RUNNING)
+
+    def job_state(self, job_id: str) -> JobState:
+        return self.jobs[job_id].fsm.state
+
+    async def wait_for_state(self, job_id: str, *states: JobState,
+                             timeout: float = 60.0) -> JobState:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            s = self.jobs[job_id].fsm.state
+            if s in states or s.terminal:
+                return s
+            await asyncio.sleep(0.05)
+        raise TimeoutError(
+            f"job {job_id} did not reach {states} (now "
+            f"{self.jobs[job_id].fsm.state})")
+
+    # -- driving the FSM ---------------------------------------------------
+
+    async def _drive(self, job: Job, n_workers: int, restore: bool) -> None:
+        try:
+            job.fsm.transition(JobState.COMPILING)
+            errors = job.program.validate()
+            if errors:
+                job.fsm.fail("; ".join(errors))
+                return
+            job.fsm.transition(JobState.SCHEDULING)
+            await self.scheduler.start_workers(
+                job.job_id, self.addr, n_workers,
+                max(1, (job.slots_needed + n_workers - 1) // n_workers))
+            await self._schedule(job, n_workers, restore)
+            job.fsm.transition(JobState.RUNNING)
+            await self._supervise(job)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            logger.exception("job %s driver failed", job.job_id)
+            if not job.fsm.state.terminal:
+                job.fsm.fail(str(e))
+
+    async def _schedule(self, job: Job, n_workers: int, restore: bool) -> None:
+        # wait for registrations to satisfy the slot requirement
+        # (scheduling.rs:255-290; reference timeout 10min, ours shorter)
+        deadline = time.monotonic() + 60
+        while sum(w.slots for w in job.workers.values()) < job.slots_needed:
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"workers did not register enough slots for {job.job_id}")
+            await asyncio.sleep(0.05)
+
+        restore_epoch = None
+        if restore:
+            restore_epoch = self._find_restore_epoch(job)
+
+        assignments = self._compute_assignments(job)
+        tasks_payload = [
+            {"operator_id": op, "subtask_index": idx, "worker_id": w}
+            for (op, idx), w in assignments.items()]
+        addrs = {w.worker_id: w.data_address for w in job.workers.values()}
+        program_bytes = pickle.dumps(job.program)
+        for w in job.workers.values():
+            await w.client.call("StartExecution", {
+                "job_id": job.job_id,
+                "program": program_bytes,
+                "tasks": tasks_payload,
+                "restore_epoch": restore_epoch,
+                "worker_data_addrs": addrs,
+                "checkpoint_url": job.checkpoint_url,
+            }, timeout=30)
+        if restore_epoch is not None:
+            job.epoch = restore_epoch
+            job.last_successful_epoch = restore_epoch
+
+    def _compute_assignments(self, job: Job) -> Dict[Tuple[str, int], str]:
+        """Round-robin slot packing (scheduling.rs:52-75)."""
+        slots: List[str] = []
+        for w in sorted(job.workers.values(), key=lambda w: w.worker_id):
+            slots.extend([w.worker_id] * w.slots)
+        out: Dict[Tuple[str, int], str] = {}
+        for node in job.program.nodes():
+            for idx in range(node.parallelism):
+                out[(node.operator_id, idx)] = slots[idx % len(slots)]
+        return out
+
+    def _find_restore_epoch(self, job: Job) -> Optional[int]:
+        """Last checkpoint whose job-level metadata marks it complete."""
+        backend = ParquetBackend.for_url(job.checkpoint_url)
+        best = None
+        for f in backend.storage.list(f"{job.job_id}/checkpoints"):
+            if f.endswith("/metadata.json") and "checkpoint-" in f:
+                part = f.split("checkpoint-")[1].split("/")[0]
+                try:
+                    meta = json.loads(backend.storage.get(f))
+                    if meta.get("complete"):
+                        ep = int(part)
+                        best = ep if best is None or ep > best else best
+                except Exception:
+                    continue
+        return best
+
+    async def _supervise(self, job: Job) -> None:
+        """JobController::progress (job_controller/mod.rs:460-584)."""
+        cfg = config()
+        last_ckpt = time.monotonic()
+        while True:
+            await asyncio.sleep(0.1)
+            state = job.fsm.state
+            if state.terminal:
+                return
+            # all workers finished?
+            if job.workers and all(w.finished for w in job.workers.values()):
+                if state == JobState.RUNNING:
+                    job.fsm.transition(JobState.FINISHED)
+                elif state in (JobState.CHECKPOINT_STOPPING,
+                               JobState.STOPPING):
+                    job.fsm.transition(JobState.STOPPED)
+                return
+            if state != JobState.RUNNING:
+                continue
+            # task failure -> recovery
+            if job.failure is not None:
+                err = job.failure
+                job.failure = None
+                await self._recover(job, err)
+                continue
+            # heartbeat timeout (30s)
+            now = time.monotonic()
+            for w in job.workers.values():
+                if (not w.finished
+                        and now - w.last_heartbeat > cfg.heartbeat_timeout_secs):
+                    await self._recover(
+                        job, f"worker {w.worker_id} heartbeat timeout")
+                    break
+            # periodic checkpoints
+            if now - last_ckpt >= cfg.checkpoint_interval_secs:
+                last_ckpt = now
+                await self._trigger_checkpoint(job)
+
+    async def _recover(self, job: Job, error: str) -> None:
+        """Running -> Recovering -> Scheduling -> Running (states/mod.rs
+        :196-202, recovering.rs)."""
+        logger.warning("job %s recovering: %s", job.job_id, error)
+        if not job.fsm.try_recover(error):
+            await self.scheduler.stop_workers(job.job_id, force=True)
+            return
+        n_workers = max(len(job.workers), 1)
+        await self._broadcast_workers(job, "StopExecution", {
+            "job_id": job.job_id, "stop_mode": "immediate"}, ignore_errors=True)
+        await self.scheduler.stop_workers(job.job_id, force=True)
+        job.workers.clear()
+        job.finished_tasks.clear()
+        job.trackers.clear()
+        job.fsm.transition(JobState.SCHEDULING)
+        await self.scheduler.start_workers(
+            job.job_id, self.addr, n_workers,
+            max(1, (job.slots_needed + n_workers - 1) // n_workers))
+        await self._schedule(job, n_workers, restore=True)
+        job.fsm.transition(JobState.RUNNING)
+
+    async def _trigger_checkpoint(self, job: Job,
+                                  then_stop: bool = False) -> None:
+        job.epoch += 1
+        job.trackers[job.epoch] = CheckpointTracker(job.epoch, job.n_subtasks)
+        await self._broadcast_workers(job, "Checkpoint", {
+            "job_id": job.job_id, "epoch": job.epoch,
+            "min_epoch": job.min_epoch, "timestamp": now_micros(),
+            "then_stop": then_stop, "is_commit": False})
+
+    async def _broadcast_workers(self, job: Job, method: str, payload: Dict,
+                                 ignore_errors: bool = False) -> None:
+        for w in job.workers.values():
+            if w.finished:
+                continue
+            try:
+                await w.client.call(method, payload)
+            except Exception as e:
+                if not ignore_errors:
+                    raise
+                logger.debug("broadcast %s to %s failed: %s", method,
+                             w.worker_id, e)
+
+    async def _await_workers_finished(self, job: Job, timeout: float) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if all(w.finished for w in job.workers.values()):
+                return
+            await asyncio.sleep(0.05)
+
+    # -- ControllerGrpc handlers ------------------------------------------
+
+    async def _register_worker(self, req: Dict) -> Dict:
+        job = self.jobs.get(req["job_id"])
+        if job is None:
+            return {"error": "unknown job"}
+        w = WorkerInfo(req["worker_id"], req["rpc_address"],
+                       req["data_address"], req["slots"])
+        w.client = RpcClient(w.rpc_address, "WorkerGrpc")
+        job.workers[w.worker_id] = w
+        return {}
+
+    async def _heartbeat(self, req: Dict) -> Dict:
+        job = self.jobs.get(req["job_id"])
+        if job and req["worker_id"] in job.workers:
+            job.workers[req["worker_id"]].last_heartbeat = time.monotonic()
+        return {}
+
+    async def _task_started(self, req: Dict) -> Dict:
+        return {}
+
+    async def _task_ckpt_event(self, req: Dict) -> Dict:
+        return {}
+
+    async def _task_ckpt_completed(self, req: Dict) -> Dict:
+        job = self.jobs.get(req["job_id"])
+        if job is None:
+            return {}
+        tracker = job.trackers.get(req["epoch"])
+        if tracker is None:
+            tracker = job.trackers.setdefault(
+                req["epoch"], CheckpointTracker(req["epoch"], job.n_subtasks))
+        tracker.completed.add((req["operator_id"], req["subtask"]))
+        tracker.has_committing |= bool(req.get("has_committing_data"))
+        if tracker.done:
+            await self._finalize_checkpoint(job, tracker)
+        return {}
+
+    async def _finalize_checkpoint(self, job: Job,
+                                   tracker: CheckpointTracker) -> None:
+        backend = ParquetBackend.for_url(job.checkpoint_url)
+        backend.storage.put(
+            f"{job.job_id}/checkpoints/checkpoint-{tracker.epoch:07d}/"
+            "metadata.json",
+            json.dumps({
+                "complete": True, "epoch": tracker.epoch,
+                "n_subtasks": tracker.n_subtasks,
+                "time": now_micros(),
+            }).encode())
+        job.last_successful_epoch = tracker.epoch
+        del job.trackers[tracker.epoch]
+        # two-phase commit for sinks with commit behavior
+        if tracker.has_committing:
+            await self._broadcast_workers(
+                job, "Commit", {"job_id": job.job_id, "epoch": tracker.epoch},
+                ignore_errors=True)
+        # epoch cleanup: keep the last N checkpoints (mod.rs:30, 388-394)
+        keep = config().checkpoints_to_keep
+        min_epoch = max(tracker.epoch - keep + 1, 0)
+        if min_epoch > job.min_epoch:
+            job.min_epoch = min_epoch
+            backend.cleanup_before(job.job_id, min_epoch)
+
+    async def _task_finished(self, req: Dict) -> Dict:
+        job = self.jobs.get(req["job_id"])
+        if job:
+            job.finished_tasks.add((req["operator_id"], req["subtask"]))
+        return {}
+
+    async def _task_failed(self, req: Dict) -> Dict:
+        job = self.jobs.get(req["job_id"])
+        if job:
+            job.failure = (f"{req['operator_id']}-{req['subtask']}: "
+                           f"{req.get('error', '')}")
+        return {}
+
+    async def _worker_finished(self, req: Dict) -> Dict:
+        job = self.jobs.get(req["job_id"])
+        if job and req["worker_id"] in job.workers:
+            job.workers[req["worker_id"]].finished = True
+        return {}
+
+    async def _worker_error(self, req: Dict) -> Dict:
+        job = self.jobs.get(req["job_id"])
+        if job:
+            job.failure = req.get("error", "worker error")
+        return {}
+
+    async def _send_sink_data(self, req: Dict) -> Dict:
+        for q in self.sink_subscribers.get(req["job_id"], []):
+            await q.put(req)
+        return {}
+
+    async def _subscribe_output(self, req: Dict):
+        q: asyncio.Queue = asyncio.Queue()
+        self.sink_subscribers.setdefault(req["job_id"], []).append(q)
+        try:
+            while True:
+                item = await q.get()
+                yield item
+                if item.get("done"):
+                    return
+        finally:
+            self.sink_subscribers[req["job_id"]].remove(q)

@@ -1,0 +1,107 @@
+"""Schedulers: how worker processes get started
+(/root/reference/arroyo-controller/src/schedulers/mod.rs trait Scheduler
+:47-68 — start_workers, stop_workers, workers_for_job).
+
+* :class:`InProcessScheduler` — workers as asyncio tasks in the controller
+  process (still real gRPC + TCP over loopback); the test/dev default, the
+  analog of the reference's single-process mode.
+* :class:`ProcessScheduler` — spawns ``python -m arroyo_tpu.worker.server``
+  subprocesses (schedulers/mod.rs:77-233).
+* Kubernetes/TPU-pod scheduling (kubernetes.rs analog): round 2 — slots map
+  to TPU chips per SURVEY §2 #34.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class Scheduler:
+    async def start_workers(self, job_id: str, controller_addr: str,
+                            n_workers: int, slots_per_worker: int) -> None:
+        raise NotImplementedError
+
+    async def stop_workers(self, job_id: str, force: bool = False) -> None:
+        raise NotImplementedError
+
+    def workers_for_job(self, job_id: str) -> List[str]:
+        raise NotImplementedError
+
+
+class InProcessScheduler(Scheduler):
+    def __init__(self) -> None:
+        self._tasks: Dict[str, List[asyncio.Task]] = {}
+        self._servers: Dict[str, List] = {}
+
+    async def start_workers(self, job_id, controller_addr, n_workers,
+                            slots_per_worker):
+        from ..worker.server import WorkerServer
+
+        tasks, servers = [], []
+        for _ in range(n_workers):
+            w = WorkerServer(controller_addr, job_id, slots_per_worker)
+
+            async def run(w=w):
+                await w.start()
+                await w.wait_done()
+
+            tasks.append(asyncio.ensure_future(run()))
+            servers.append(w)
+        self._tasks[job_id] = self._tasks.get(job_id, []) + tasks
+        self._servers[job_id] = self._servers.get(job_id, []) + servers
+
+    async def stop_workers(self, job_id, force=False):
+        for w in self._servers.pop(job_id, []):
+            try:
+                await w.shutdown()
+            except Exception:
+                pass
+        for t in self._tasks.pop(job_id, []):
+            t.cancel()
+
+    def workers_for_job(self, job_id):
+        return [w.worker_id for w in self._servers.get(job_id, [])]
+
+
+class ProcessScheduler(Scheduler):
+    """One OS process per worker (16 slots/node default in the reference)."""
+
+    def __init__(self) -> None:
+        self._procs: Dict[str, List[subprocess.Popen]] = {}
+
+    async def start_workers(self, job_id, controller_addr, n_workers,
+                            slots_per_worker):
+        procs = []
+        for _ in range(n_workers):
+            env = dict(os.environ)
+            env.update({
+                "CONTROLLER_ADDR": controller_addr,
+                "JOB_ID": job_id,
+                "TASK_SLOTS": str(slots_per_worker),
+                "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+            })
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "arroyo_tpu.worker.server"], env=env))
+        self._procs[job_id] = self._procs.get(job_id, []) + procs
+
+    async def stop_workers(self, job_id, force=False):
+        for p in self._procs.pop(job_id, []):
+            if force:
+                p.kill()
+            else:
+                p.terminate()
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def workers_for_job(self, job_id):
+        return [f"pid-{p.pid}" for p in self._procs.get(job_id, [])
+                if p.poll() is None]

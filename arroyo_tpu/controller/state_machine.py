@@ -1,0 +1,102 @@
+"""Per-job controller state machine — analog of the reference's typed FSM
+(/root/reference/arroyo-controller/src/states/mod.rs:162-237, 503-549):
+
+Created -> Compiling -> Scheduling -> Running
+    -> {CheckpointStopping, Stopping, Recovering, Rescaling, Finishing}
+    -> {Stopped, Finished, Failed}
+
+with bounded restarts (10) and the healthy-after-2-minutes reset policy
+(states/running.rs:17-21)."""
+
+from __future__ import annotations
+
+import time
+from enum import Enum
+from typing import Callable, List, Optional
+
+
+class JobState(Enum):
+    CREATED = "Created"
+    COMPILING = "Compiling"
+    SCHEDULING = "Scheduling"
+    RUNNING = "Running"
+    CHECKPOINT_STOPPING = "CheckpointStopping"
+    STOPPING = "Stopping"
+    RECOVERING = "Recovering"
+    RESCALING = "Rescaling"
+    FINISHING = "Finishing"
+    STOPPED = "Stopped"
+    FINISHED = "Finished"
+    FAILED = "Failed"
+
+    @property
+    def terminal(self) -> bool:
+        return self in (JobState.STOPPED, JobState.FINISHED, JobState.FAILED)
+
+
+VALID_TRANSITIONS = {
+    JobState.CREATED: {JobState.COMPILING, JobState.FAILED},
+    JobState.COMPILING: {JobState.SCHEDULING, JobState.FAILED},
+    JobState.SCHEDULING: {JobState.RUNNING, JobState.FAILED,
+                          JobState.STOPPING, JobState.RECOVERING},
+    JobState.RUNNING: {JobState.CHECKPOINT_STOPPING, JobState.STOPPING,
+                       JobState.RECOVERING, JobState.RESCALING,
+                       JobState.FINISHING, JobState.FINISHED,
+                       JobState.FAILED},
+    JobState.CHECKPOINT_STOPPING: {JobState.STOPPING, JobState.STOPPED,
+                                   JobState.FAILED},
+    JobState.STOPPING: {JobState.STOPPED, JobState.FAILED},
+    JobState.RECOVERING: {JobState.SCHEDULING, JobState.FAILED},
+    JobState.RESCALING: {JobState.SCHEDULING, JobState.FAILED},
+    JobState.FINISHING: {JobState.FINISHED, JobState.FAILED},
+}
+
+MAX_RESTARTS = 10  # states/running.rs:17-21
+HEALTHY_AFTER_SECS = 120.0
+
+
+class StateMachine:
+    def __init__(self, job_id: str,
+                 on_transition: Optional[Callable[[JobState, JobState], None]] = None):
+        self.job_id = job_id
+        self.state = JobState.CREATED
+        self.restarts = 0
+        self.running_since: Optional[float] = None
+        self.history: List[tuple] = [(time.time(), JobState.CREATED)]
+        self.failure_message: Optional[str] = None
+        self.on_transition = on_transition
+
+    def transition(self, to: JobState) -> None:
+        if self.state.terminal:
+            raise ValueError(f"job {self.job_id} is terminal ({self.state})")
+        if to not in VALID_TRANSITIONS.get(self.state, set()):
+            raise ValueError(
+                f"invalid transition {self.state.value} -> {to.value}")
+        prev = self.state
+        self.state = to
+        self.history.append((time.time(), to))
+        if to == JobState.RUNNING:
+            # healthy-run restart counter reset
+            if (self.running_since is not None
+                    and time.time() - self.running_since > HEALTHY_AFTER_SECS):
+                self.restarts = 0
+            self.running_since = time.time()
+        if self.on_transition:
+            self.on_transition(prev, to)
+
+    def try_recover(self, error: str) -> bool:
+        """Returns True if a restart is allowed; transitions accordingly."""
+        self.restarts += 1
+        if self.restarts > MAX_RESTARTS:
+            self.fail(f"too many restarts ({self.restarts}): {error}")
+            return False
+        self.transition(JobState.RECOVERING)
+        return True
+
+    def fail(self, message: str) -> None:
+        self.failure_message = message
+        prev = self.state
+        self.state = JobState.FAILED
+        self.history.append((time.time(), JobState.FAILED))
+        if self.on_transition:
+            self.on_transition(prev, JobState.FAILED)

@@ -54,7 +54,15 @@ class Engine:
     def __init__(self, program: Program, job_id: str = "local-job",
                  run_id: str = "0",
                  backend: Optional[BackingStore] = None,
-                 restore_epoch: Optional[int] = None):
+                 restore_epoch: Optional[int] = None,
+                 assignments: Optional[Dict[Tuple[str, int], str]] = None,
+                 my_worker_id: Optional[str] = None,
+                 worker_data_addrs: Optional[Dict[str, str]] = None,
+                 network: Optional[Any] = None):
+        """``assignments`` maps (operator_id, subtask_idx) -> worker_id; when
+        given with ``my_worker_id``, only this worker's subtasks are built and
+        cross-worker edges ride the network data plane (``network`` must be a
+        NetworkManager, ``worker_data_addrs`` maps worker_id -> host:port)."""
         errors = program.validate()
         if errors:
             raise ValueError("; ".join(errors))
@@ -63,9 +71,23 @@ class Engine:
         self.run_id = run_id
         self.backend = backend if backend is not None else InMemoryBackend()
         self.restore_epoch = restore_epoch
+        self.assignments = assignments
+        self.my_worker_id = my_worker_id
+        self.worker_data_addrs = worker_data_addrs or {}
+        self.network = network
         self.control_resp: asyncio.Queue = asyncio.Queue()
         self.subtasks: Dict[Tuple[str, int], SubtaskHandle] = {}
         self.resps: List[ControlResp] = []  # responses drained so far
+
+    def _is_mine(self, op_id: str, idx: int) -> bool:
+        if self.assignments is None:
+            return True
+        return self.assignments.get((op_id, idx)) == self.my_worker_id
+
+    def _worker_of(self, op_id: str, idx: int) -> Optional[str]:
+        if self.assignments is None:
+            return None
+        return self.assignments.get((op_id, idx))
 
     @staticmethod
     def for_local(program: Program, job_id: str = "local-job",
@@ -92,6 +114,25 @@ class Engine:
                 queues[quad] = asyncio.Queue(maxsize=qsize)
             return queues[quad]
 
+        def out_queue(quad: Tuple[str, int, str, int]) -> OutQueue:
+            """Local queue or remote network sender for an outgoing edge."""
+            _, _, dst_op, dst_idx = quad
+            w = self._worker_of(dst_op, dst_idx)
+            if w is None or w == self.my_worker_id:
+                return OutQueue(queue_for(quad))
+            addr = self.worker_data_addrs[w]
+            return OutQueue(sender=self.network.remote_sender(addr, quad))
+
+        def in_queue(quad: Tuple[str, int, str, int]) -> asyncio.Queue:
+            """Local queue for an incoming edge; remote sources are demuxed
+            into it by the network listener."""
+            src_op, src_idx, _, _ = quad
+            q = queue_for(quad)
+            w = self._worker_of(src_op, src_idx)
+            if w is not None and w != self.my_worker_id:
+                self.network.register_in_edge(quad, q)
+            return q
+
         # construct subtasks in topo order
         for op_id in self.program.topo_order():
             node: StreamNode = self.program.node(op_id)
@@ -100,6 +141,8 @@ class Engine:
             in_edges = list(g.in_edges(op_id, data=True))
 
             for idx in range(parallelism):
+                if not self._is_mine(op_id, idx):
+                    continue
                 task_info = TaskInfo(self.job_id, op_id, node.operator.name,
                                      idx, parallelism)
 
@@ -114,14 +157,14 @@ class Engine:
                         # (src i -> every dst j with j % src_par == i,
                         # round-robined per batch by the Collector)
                         if dst_par > parallelism:
-                            group = [OutQueue(queue_for((op_id, idx, dst, j)))
+                            group = [out_queue((op_id, idx, dst, j))
                                      for j in range(dst_par)
                                      if j % parallelism == idx]
                         else:
-                            group = [OutQueue(queue_for((op_id, idx, dst,
-                                                         idx % dst_par)))]
+                            group = [out_queue((op_id, idx, dst,
+                                                idx % dst_par))]
                     else:
-                        group = [OutQueue(queue_for((op_id, idx, dst, j)))
+                        group = [out_queue((op_id, idx, dst, j))
                                  for j in range(dst_par)]
                     edge_groups.append(group)
 
@@ -134,15 +177,15 @@ class Engine:
                     side = 1 if typ == EdgeType.SHUFFLE_JOIN_RIGHT else 0
                     if typ == EdgeType.FORWARD:
                         if parallelism > src_par:
-                            inputs.append((side, queue_for(
+                            inputs.append((side, in_queue(
                                 (src, idx % src_par, op_id, idx))))
                         else:
                             for j in range(src_par):
                                 if j % parallelism == idx:
-                                    inputs.append((side, queue_for((src, j, op_id, idx))))
+                                    inputs.append((side, in_queue((src, j, op_id, idx))))
                     else:
                         for j in range(src_par):
-                            inputs.append((side, queue_for((src, j, op_id, idx))))
+                            inputs.append((side, in_queue((src, j, op_id, idx))))
 
                 operator = build_operator(node.operator)
                 store = StateStore(task_info, self.backend, self.restore_epoch)

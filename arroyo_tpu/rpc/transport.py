@@ -1,0 +1,130 @@
+"""msgpack-over-grpc transport for the control plane.
+
+The reference uses tonic-generated stubs; grpcio-tools is not in this image,
+so services are wired with grpc *generic handlers*: each method is an async
+function taking/returning msgpack-serializable dicts, registered under the
+same fully-qualified method names as rpc/proto/rpc.proto.  Messages stay
+dicts (the proto file is the schema contract)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, AsyncIterator, Callable, Dict, Optional
+
+import grpc
+import msgpack
+
+logger = logging.getLogger(__name__)
+
+
+def _ser(obj: Any) -> bytes:
+    return msgpack.packb(obj, use_bin_type=True)
+
+
+def _deser(data: bytes) -> Any:
+    return msgpack.unpackb(data, raw=False)
+
+
+class RpcServer:
+    """grpc.aio server hosting one or more msgpack services."""
+
+    def __init__(self) -> None:
+        self._services: Dict[str, Dict[str, Callable]] = {}
+        self._streams: Dict[str, Dict[str, Callable]] = {}
+        self.server: Optional[grpc.aio.Server] = None
+        self.port: Optional[int] = None
+
+    def add_service(self, service: str, methods: Dict[str, Callable],
+                    stream_methods: Optional[Dict[str, Callable]] = None
+                    ) -> None:
+        """methods: name -> async fn(request_dict) -> response_dict;
+        stream_methods: name -> async gen fn(request_dict) -> yields dicts."""
+        self._services[service] = methods
+        self._streams[service] = stream_methods or {}
+
+    async def start(self, host: str = "0.0.0.0", port: int = 0) -> int:
+        self.server = grpc.aio.server()
+
+        outer = self
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                # method path: /package.Service/Method
+                path = handler_call_details.method
+                try:
+                    _, svc, method = path.split("/")
+                except ValueError:
+                    return None
+                svc = svc.rsplit(".", 1)[-1]
+                methods = outer._services.get(svc, {})
+                streams = outer._streams.get(svc, {})
+                if method in methods:
+                    fn = methods[method]
+
+                    async def unary(request, context):
+                        try:
+                            return _ser(await fn(_deser(request)))
+                        except Exception as e:  # surface as grpc error
+                            logger.exception("rpc %s failed", path)
+                            await context.abort(
+                                grpc.StatusCode.INTERNAL, str(e))
+
+                    return grpc.unary_unary_rpc_method_handler(
+                        unary, request_deserializer=lambda b: b,
+                        response_serializer=lambda b: b)
+                if method in streams:
+                    gen = streams[method]
+
+                    async def streaming(request, context):
+                        async for item in gen(_deser(request)):
+                            yield _ser(item)
+
+                    return grpc.unary_stream_rpc_method_handler(
+                        streaming, request_deserializer=lambda b: b,
+                        response_serializer=lambda b: b)
+                return None
+
+        self.server.add_generic_rpc_handlers((Handler(),))
+        self.port = self.server.add_insecure_port(f"{host}:{port}")
+        await self.server.start()
+        return self.port
+
+    async def stop(self, grace: float = 0.5) -> None:
+        if self.server is not None:
+            await self.server.stop(grace)
+
+
+class RpcClient:
+    """Client for one msgpack service on one endpoint."""
+
+    def __init__(self, addr: str, service: str,
+                 package: str = "arroyo_tpu.rpc"):
+        self.addr = addr
+        self.service = service
+        self.package = package
+        self.channel = grpc.aio.insecure_channel(addr)
+
+    async def call(self, method: str, request: Optional[Dict] = None,
+                   timeout: float = 10.0) -> Any:
+        path = f"/{self.package}.{self.service}/{method}"
+        fn = self.channel.unary_unary(
+            path, request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        resp = await fn(_ser(request or {}), timeout=timeout)
+        return _deser(resp)
+
+    async def stream(self, method: str, request: Optional[Dict] = None
+                     ) -> AsyncIterator[Any]:
+        path = f"/{self.package}.{self.service}/{method}"
+        fn = self.channel.unary_stream(
+            path, request_serializer=lambda b: b,
+            response_deserializer=lambda b: b)
+        async for item in fn(_ser(request or {})):
+            yield _deser(item)
+
+    async def close(self) -> None:
+        await self.channel.close()
+
+    async def wait_ready(self, timeout: float = 10.0) -> None:
+        await asyncio.wait_for(self.channel.channel_ready(), timeout)

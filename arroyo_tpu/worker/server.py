@@ -1,0 +1,205 @@
+"""WorkerServer: the worker process main — registers with the controller,
+hosts the engine for its assigned subtasks, relays control responses, and
+heartbeats (analog of /root/reference/arroyo-worker/src/lib.rs:252-670).
+
+Serves WorkerGrpc {StartExecution, Checkpoint, Commit, StopExecution,
+JobFinished, LoadCompactedData} (lib.rs:489-670) over the msgpack transport
+and opens the TCP data plane for cross-worker edges."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import cloudpickle as pickle
+import uuid
+from typing import Any, Dict, Optional, Tuple
+
+from ..config import config
+from ..engine.engine import Engine, RunningEngine
+from ..network.data_plane import NetworkManager
+from ..rpc.transport import RpcClient, RpcServer
+from ..state.backend import ParquetBackend
+from ..types import CheckpointBarrier, ControlMessage, ControlResp, StopMode, now_micros
+
+logger = logging.getLogger(__name__)
+
+
+class WorkerServer:
+    def __init__(self, controller_addr: str, job_id: str,
+                 slots: Optional[int] = None,
+                 worker_id: Optional[str] = None,
+                 host: str = "127.0.0.1"):
+        self.controller_addr = controller_addr
+        self.job_id = job_id
+        self.slots = slots or config().task_slots
+        self.worker_id = worker_id or f"worker-{uuid.uuid4().hex[:8]}"
+        self.host = host
+        self.network = NetworkManager()
+        self.rpc = RpcServer()
+        self.controller = RpcClient(controller_addr, "ControllerGrpc")
+        self.engine: Optional[Engine] = None
+        self.running: Optional[RunningEngine] = None
+        self._relay_task: Optional[asyncio.Task] = None
+        self._hb_task: Optional[asyncio.Task] = None
+        self._done = asyncio.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        data_port = await self.network.open_listener(self.host)
+        self.rpc.add_service("WorkerGrpc", {
+            "StartExecution": self._start_execution,
+            "Checkpoint": self._checkpoint,
+            "Commit": self._commit,
+            "StopExecution": self._stop_execution,
+            "JobFinished": self._job_finished,
+            "LoadCompactedData": self._load_compacted,
+        })
+        rpc_port = await self.rpc.start(self.host)
+        await self.controller.wait_ready()
+        await self.controller.call("RegisterWorker", {
+            "worker_id": self.worker_id,
+            "job_id": self.job_id,
+            "rpc_address": f"{self.host}:{rpc_port}",
+            "data_address": f"{self.host}:{data_port}",
+            "slots": self.slots,
+            "run_id": "0",
+        })
+        self._hb_task = asyncio.ensure_future(self._heartbeat_loop())
+        logger.info("worker %s registered (rpc=%s data=%s)",
+                    self.worker_id, rpc_port, data_port)
+
+    async def wait_done(self) -> None:
+        await self._done.wait()
+
+    async def shutdown(self) -> None:
+        for t in (self._hb_task, self._relay_task):
+            if t is not None:
+                t.cancel()
+        await self.network.close()
+        await self.rpc.stop()
+        await self.controller.close()
+        self._done.set()
+
+    async def _heartbeat_loop(self) -> None:
+        interval = config().heartbeat_interval_secs
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                await self.controller.call("Heartbeat", {
+                    "worker_id": self.worker_id, "job_id": self.job_id,
+                    "time": now_micros()})
+            except Exception as e:
+                logger.warning("heartbeat failed: %s", e)
+
+    # -- WorkerGrpc handlers ----------------------------------------------
+
+    async def _start_execution(self, req: Dict) -> Dict:
+        program = pickle.loads(req["program"])
+        assignments = {
+            (t["operator_id"], t["subtask_index"]): t["worker_id"]
+            for t in req["tasks"]}
+        addrs = dict(req.get("worker_data_addrs") or {})
+        for wid, addr in addrs.items():
+            if wid != self.worker_id:
+                await self.network.connect(addr)
+        backend = ParquetBackend.for_url(
+            req.get("checkpoint_url") or config().checkpoint_url)
+        self.engine = Engine(
+            program, self.job_id, backend=backend,
+            restore_epoch=req.get("restore_epoch"),
+            assignments=assignments, my_worker_id=self.worker_id,
+            worker_data_addrs=addrs, network=self.network)
+        self.running = self.engine.start()
+        self._relay_task = asyncio.ensure_future(self._relay_loop())
+        return {}
+
+    async def _relay_loop(self) -> None:
+        """Forward engine ControlResps to the controller (the reference's
+        control thread, arroyo-worker/src/lib.rs:369-487)."""
+        n_tasks = len(self.engine.subtasks)
+        finished = 0
+        while finished < n_tasks:
+            resp: ControlResp = await self.engine.control_resp.get()
+            try:
+                await self._relay_one(resp)
+            except Exception as e:
+                logger.warning("relay to controller failed: %s", e)
+            if resp.kind in ("task_finished", "task_failed"):
+                finished += 1
+        try:
+            await self.controller.call("WorkerFinished", {
+                "worker_id": self.worker_id, "job_id": self.job_id})
+        except Exception as e:
+            logger.warning("WorkerFinished failed: %s", e)
+
+    async def _relay_one(self, resp: ControlResp) -> None:
+        base = {"job_id": self.job_id, "operator_id": resp.operator_id,
+                "subtask": resp.task_index}
+        if resp.kind == "task_started":
+            await self.controller.call("TaskStarted",
+                                       base | {"worker_id": self.worker_id})
+        elif resp.kind == "checkpoint_event":
+            ev = resp.checkpoint_event
+            await self.controller.call("TaskCheckpointEvent", base | {
+                "epoch": ev.checkpoint_epoch,
+                "event_type": ev.event_type.value, "time": ev.time})
+        elif resp.kind == "checkpoint_completed":
+            m = resp.subtask_metadata
+            await self.controller.call("TaskCheckpointCompleted", base | {
+                "epoch": m.epoch, "bytes": m.bytes,
+                "watermark": m.watermark, "start_time": m.start_time,
+                "finish_time": m.finish_time,
+                "has_committing_data": bool(m.committing_data)})
+        elif resp.kind == "task_finished":
+            await self.controller.call("TaskFinished", base)
+        elif resp.kind == "task_failed":
+            await self.controller.call("TaskFailed",
+                                       base | {"error": resp.error or ""})
+
+    async def _checkpoint(self, req: Dict) -> Dict:
+        assert self.running is not None
+        barrier = CheckpointBarrier(req["epoch"], req.get("min_epoch", 0),
+                                    req.get("timestamp", now_micros()),
+                                    req.get("then_stop", False))
+        # barriers are injected at sources only (§3.3)
+        for q in self.running.source_controls():
+            await q.put(ControlMessage.checkpoint(barrier))
+        return {}
+
+    async def _commit(self, req: Dict) -> Dict:
+        assert self.running is not None
+        await self.running.commit(req["epoch"])
+        return {}
+
+    async def _stop_execution(self, req: Dict) -> Dict:
+        if self.running is not None:
+            mode = StopMode(req.get("stop_mode", "graceful"))
+            await self.running.stop(mode)
+        return {}
+
+    async def _job_finished(self, req: Dict) -> Dict:
+        asyncio.ensure_future(self.shutdown())
+        return {}
+
+    async def _load_compacted(self, req: Dict) -> Dict:
+        return {}  # compaction hot-swap: round 2
+
+
+async def run_worker(controller_addr: str, job_id: str,
+                     slots: Optional[int] = None) -> None:
+    w = WorkerServer(controller_addr, job_id, slots)
+    await w.start()
+    await w.wait_done()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(run_worker(
+        os.environ["CONTROLLER_ADDR"], os.environ["JOB_ID"],
+        int(os.environ.get("TASK_SLOTS", "16"))))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,191 @@
+"""Distributed control/data plane tests: a real controller + workers over
+loopback gRPC and the TCP data plane — the analog of the reference's integ
+suite (integ/src/main.rs) plus worker-level network tests
+(network_manager.rs:310-427)."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from arroyo_tpu import AggKind, AggSpec, Stream
+from arroyo_tpu.controller.controller import ControllerServer
+from arroyo_tpu.controller.scheduler import InProcessScheduler
+from arroyo_tpu.controller.state_machine import JobState, StateMachine
+from arroyo_tpu.network.data_plane import (
+    NetworkManager,
+    decode_message,
+    encode_message,
+)
+from arroyo_tpu.types import Batch, Message, Watermark
+
+
+def test_state_machine_transitions():
+    sm = StateMachine("j1")
+    sm.transition(JobState.COMPILING)
+    sm.transition(JobState.SCHEDULING)
+    sm.transition(JobState.RUNNING)
+    with pytest.raises(ValueError):
+        sm.transition(JobState.SCHEDULING)  # invalid from RUNNING
+    assert sm.try_recover("boom")
+    assert sm.state == JobState.RECOVERING
+    sm.transition(JobState.SCHEDULING)
+    sm.transition(JobState.RUNNING)
+    # exceed restart budget
+    for _ in range(20):
+        if not sm.try_recover("again"):
+            break
+        sm.transition(JobState.SCHEDULING)
+        sm.transition(JobState.RUNNING)
+    assert sm.state == JobState.FAILED
+
+
+def test_message_codec_roundtrip():
+    b = Batch(np.array([1, 2], dtype=np.int64),
+              {"x": np.array([10, 20], dtype=np.int64),
+               "s": np.array(["a", "b"], dtype=object)}).with_key(["x"])
+    for msg in [Message.record(b), Message.wm(Watermark.event_time(42)),
+                Message.wm(Watermark.idle()), Message.stop(),
+                Message.end_of_data()]:
+        kind, payload = encode_message(msg)
+        out = decode_message(kind, payload)
+        assert out.kind == msg.kind
+        if msg.batch is not None:
+            np.testing.assert_array_equal(out.batch.timestamp, b.timestamp)
+            np.testing.assert_array_equal(out.batch.key_hash, b.key_hash)
+            assert list(out.batch.columns["s"]) == ["a", "b"]
+
+
+def test_network_loopback(run_async):
+    """Frame a batch through a real socket (network_manager.rs:310-427)."""
+
+    async def scenario():
+        nm_in = NetworkManager()
+        q: asyncio.Queue = asyncio.Queue()
+        quad = ("op1", 0, "op2", 1)
+        nm_in.register_in_edge(quad, q)
+        port = await nm_in.open_listener("127.0.0.1")
+
+        nm_out = NetworkManager()
+        await nm_out.connect(f"127.0.0.1:{port}")
+        send = nm_out.remote_sender(f"127.0.0.1:{port}", quad)
+
+        b = Batch(np.arange(100, dtype=np.int64),
+                  {"v": np.arange(100, dtype=np.int64)})
+        await send(Message.record(b))
+        await send(Message.wm(Watermark.event_time(7)))
+        m1 = await asyncio.wait_for(q.get(), 5)
+        m2 = await asyncio.wait_for(q.get(), 5)
+        await nm_out.close()
+        await nm_in.close()
+        return m1, m2
+
+    m1, m2 = run_async(scenario())
+    assert len(m1.batch) == 100
+    assert m2.watermark.time == 7
+
+
+@pytest.mark.parametrize("n_workers", [1, 2])
+def test_cluster_pipeline(tmp_path, n_workers):
+    """Submit a pipeline to a real controller; workers execute it across
+    processes-worth of isolation (in-process scheduler, real gRPC + TCP),
+    including a cross-worker shuffle; verify output and FINISHED state."""
+    out_path = tmp_path / "out.jsonl"
+
+    async def scenario():
+        ctrl = ControllerServer(InProcessScheduler())
+        await ctrl.start()
+        prog = (
+            Stream.source("impulse", {"event_rate": 0.0, "message_count": 2000,
+                                      "event_time_interval_micros": 1000,
+                                      "batch_size": 100}, parallelism=2)
+            .watermark(max_lateness_micros=0)
+            .map(lambda c: {"counter": c["counter"],
+                            "bucket": c["counter"] % 5}, name="b")
+            .key_by("bucket")
+            .tumbling_aggregate(
+                200 * 1000, [AggSpec(AggKind.COUNT, None, "cnt")],
+                parallelism=2)
+            .sink("single_file", {"path": str(out_path)}, parallelism=1)
+        )
+        job_id = await ctrl.submit_job(
+            prog, checkpoint_url=f"file://{tmp_path}/ckpt",
+            n_workers=n_workers)
+        state = await ctrl.wait_for_state(job_id, JobState.FINISHED,
+                                          timeout=60)
+        await ctrl.scheduler.stop_workers(job_id)
+        await ctrl.stop()
+        return state
+
+    state = asyncio.run(scenario())
+    assert state == JobState.FINISHED
+    rows = [json.loads(l) for l in open(out_path)]
+    assert sum(r["cnt"] for r in rows) == 2000
+
+
+def test_cluster_checkpoint_and_stop(tmp_path):
+    """Periodic checkpoints complete at the job level; graceful stop with
+    checkpoint reaches STOPPED; restart restores and finishes the stream."""
+    out_path = tmp_path / "out.jsonl"
+    ckpt_url = f"file://{tmp_path}/ckpt"
+
+    def build():
+        return (
+            Stream.source("impulse", {"event_rate": 20_000.0,
+                                      "message_count": 30_000,
+                                      "event_time_interval_micros": 1000,
+                                      "batch_size": 256})
+            .watermark(max_lateness_micros=0)
+            .map(lambda c: {"counter": c["counter"],
+                            "bucket": c["counter"] % 3}, name="b")
+            .key_by("bucket")
+            .tumbling_aggregate(100 * 1000,
+                                [AggSpec(AggKind.COUNT, None, "cnt")])
+            .sink("single_file", {"path": str(out_path)})
+        )
+
+    async def run1():
+        import arroyo_tpu.config as cfg
+
+        cfg.reset_config()
+        ctrl = ControllerServer(InProcessScheduler())
+        await ctrl.start()
+        job_id = await ctrl.submit_job(build(), job_id="ckpt-stop-job",
+                                       checkpoint_url=ckpt_url)
+        await ctrl.wait_for_state(job_id, JobState.RUNNING, timeout=30)
+        # force an early checkpoint, then stop-with-checkpoint
+        job = ctrl.jobs[job_id]
+        await asyncio.sleep(0.4)
+        await ctrl._trigger_checkpoint(job)
+        # wait until that epoch completes at the job level
+        for _ in range(200):
+            if job.last_successful_epoch:
+                break
+            await asyncio.sleep(0.05)
+        assert job.last_successful_epoch, "checkpoint never completed"
+        await ctrl.stop_job(job_id, checkpoint=True)
+        state = await ctrl.wait_for_state(job_id, JobState.STOPPED,
+                                          timeout=30)
+        epoch = job.last_successful_epoch
+        await ctrl.scheduler.stop_workers(job_id)
+        await ctrl.stop()
+        return state, epoch
+
+    state, epoch = asyncio.run(run1())
+    assert state == JobState.STOPPED and epoch >= 1
+
+    async def run2():
+        ctrl = ControllerServer(InProcessScheduler())
+        await ctrl.start()
+        job_id = await ctrl.submit_job(build(), job_id="ckpt-stop-job",
+                                       checkpoint_url=ckpt_url, restore=True)
+        state = await ctrl.wait_for_state(job_id, JobState.FINISHED,
+                                          timeout=60)
+        await ctrl.scheduler.stop_workers(job_id)
+        await ctrl.stop()
+        return state
+
+    assert asyncio.run(run2()) == JobState.FINISHED
+    rows = [json.loads(l) for l in open(out_path)]
+    assert sum(r["cnt"] for r in rows) == 30_000

@@ -9,12 +9,24 @@ disabled entirely (its sitecustomize registration is env-gated):
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # tests always run on the CPU mesh
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+if "jax" in sys.modules:
+    # The axon sitecustomize plugin imports jax at interpreter start, before
+    # this conftest runs — env vars alone are then too late. The backend
+    # itself is created lazily, so flipping the config here still wins.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    assert jax.default_backend() == "cpu" and len(jax.devices()) == 8, (
+        "axon plugin initialized a JAX backend before conftest could force "
+        "the 8-device CPU mesh; run with `env -u PALLAS_AXON_POOL_IPS`")
 
 import asyncio  # noqa: E402
 

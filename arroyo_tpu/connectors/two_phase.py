@@ -1,0 +1,123 @@
+"""TwoPhaseCommitter — exactly-once sink protocol.
+
+Analog of the reference's ``trait TwoPhaseCommitter`` (/root/reference/
+arroyo-worker/src/connectors/two_phase_committer.rs:39-61): a sink buffers
+writes, and at each checkpoint barrier produces *pre-commit* data that is
+persisted with the snapshot (table write-behavior CommitWrites).  Once the
+controller has sealed the whole checkpoint it sends a Commit control message
+and the sink finalizes the pre-committed work (finish multipart uploads,
+commit the kafka transaction, rename staged files).  On restore, un-committed
+pre-commits from the restored epoch are re-committed before processing
+resumes — giving exactly-once output.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..engine.context import Context
+from ..engine.operator import Operator
+from ..state.tables import (
+    TableDescriptor,
+    TableType,
+    WriteBehavior,
+)
+from ..types import Batch, CheckpointBarrier
+
+# Reserved table names, mirroring the reference's single-char convention:
+# 'r' — recovery state (committer-internal, restored on restart)
+# 'p' — pre-commit data (CommitWrites: surfaced to the controller)
+RECOVERY_TABLE = "r"
+PRECOMMIT_TABLE = "p"
+
+
+class TwoPhaseCommitterSink(Operator):
+    """Base class for exactly-once sinks.  Subclasses implement the four
+    committer hooks (two_phase_committer.rs:39-61):
+
+    - ``committer_init(ctx)`` — open connections, restore from
+      ``recovery_state`` (may be None).
+    - ``insert_batch(batch, ctx)`` — buffer/stage a batch of rows.
+    - ``committer_checkpoint(epoch, stopping, ctx) -> (recovery, pre_commits)``
+      — flush staged data to its pre-committed location; return committer
+      recovery state plus a dict of pre-commit entries.
+    - ``committer_commit(epoch, pre_commits, ctx)`` — atomically finalize.
+    """
+
+    def tables(self) -> List[TableDescriptor]:
+        return [
+            TableDescriptor(RECOVERY_TABLE, TableType.GLOBAL,
+                            "two-phase committer recovery state"),
+            TableDescriptor(PRECOMMIT_TABLE, TableType.GLOBAL,
+                            "pre-commit data awaiting the commit phase",
+                            write_behavior=WriteBehavior.COMMIT_WRITES),
+        ]
+
+    # -- committer hooks (override) -----------------------------------
+
+    async def committer_init(self, recovery_state: Optional[Any],
+                             ctx: Context) -> None:
+        pass
+
+    async def insert_batch(self, batch: Batch, ctx: Context) -> None:
+        raise NotImplementedError
+
+    async def committer_checkpoint(
+            self, epoch: int, stopping: bool,
+            ctx: Context) -> Tuple[Any, Dict[str, Any]]:
+        raise NotImplementedError
+
+    async def committer_commit(self, epoch: int, pre_commits: Dict[str, Any],
+                               ctx: Context) -> None:
+        raise NotImplementedError
+
+    # -- Operator plumbing (final) ------------------------------------
+
+    async def committer_post_restore(self, ctx: Context) -> None:
+        """Called after restored pre-commits have been re-committed; safe
+        point to garbage-collect staged artifacts that no pre-commit
+        references (they belong to an epoch that never sealed)."""
+        pass
+
+    # -- Operator plumbing (final) ------------------------------------
+
+    async def on_start(self, ctx: Context) -> None:
+        # Pre-commit entries are keyed by epoch so a commit for epoch N can
+        # never finalize epoch N+1's still-unsealed work (the reference keys
+        # committing state by checkpoint id, checkpointer.rs:83-110).
+        pre = ctx.state.get_global_keyed_state(PRECOMMIT_TABLE)
+        rec = ctx.state.get_global_keyed_state(RECOVERY_TABLE)
+        await self.committer_init(rec.get("state"), ctx)
+        if ctx.state.restore_epoch is not None:
+            # Re-commit anything pre-committed before the crash: the
+            # controller guarantees the restored checkpoint was fully sealed,
+            # so these writes belong to it and must become visible
+            # (scheduling.rs:300-510 loads committing state on restore).
+            for epoch, pending in sorted(pre.get_all().items()):
+                if pending:
+                    await self.committer_commit(epoch, pending, ctx)
+                pre.remove(epoch)
+        await self.committer_post_restore(ctx)
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        await self.insert_batch(batch, ctx)
+
+    async def pre_checkpoint(self, barrier: CheckpointBarrier, ctx: Context) -> None:
+        recovery, pre_commits = await self.committer_checkpoint(
+            barrier.epoch, barrier.then_stop, ctx)
+        rec = ctx.state.get_global_keyed_state(RECOVERY_TABLE)
+        rec.insert("state", recovery)
+        if pre_commits:
+            pre = ctx.state.get_global_keyed_state(PRECOMMIT_TABLE)
+            pre.insert(barrier.epoch, pre_commits)
+
+    def has_pending_commits(self, ctx: Context) -> bool:
+        return len(ctx.state.get_global_keyed_state(PRECOMMIT_TABLE)) > 0
+
+    async def handle_commit(self, epoch: int, ctx: Context) -> None:
+        pre = ctx.state.get_global_keyed_state(PRECOMMIT_TABLE)
+        for e, pending in sorted(pre.get_all().items()):
+            if e <= epoch:
+                if pending:
+                    await self.committer_commit(e, pending, ctx)
+                pre.remove(e)

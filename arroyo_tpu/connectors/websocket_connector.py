@@ -1,0 +1,79 @@
+"""Websocket source (/root/reference/arroyo-worker/src/connectors/
+websocket.rs): connects to a ws:// endpoint, optionally sends a subscription
+message, and emits every received text/binary frame through the Format layer.
+No exactly-once replay is possible (the stream is ephemeral), matching the
+reference's semantics — state records only a monotonically increasing count
+for observability."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from pydantic import BaseModel
+
+from ..config import config
+from ..engine.context import Context
+from ..engine.operator import SourceFinishType, SourceOperator
+from ..formats import make_format
+from ..state.tables import TableDescriptor, global_table
+from ..types import StopMode
+from .registry import ConnectorMeta, register_connector
+
+
+class WebsocketConfig(BaseModel):
+    endpoint: str
+    subscription_message: Optional[str] = None
+    format: str = "json"
+
+
+class WebsocketSource(SourceOperator):
+    def __init__(self, cfg: Dict[str, Any]):
+        super().__init__("websocket_source")
+        self.cfg = WebsocketConfig(**cfg)
+        self.fmt = make_format(self.cfg.format)
+
+    def tables(self) -> List[TableDescriptor]:
+        return [global_table("w", "websocket message count")]
+
+    async def run(self, ctx: Context) -> SourceFinishType:
+        if ctx.task_info.task_index != 0:
+            return SourceFinishType.FINAL
+        import websockets
+
+        state = ctx.state.get_global_keyed_state("w")
+        count = state.get("messages") or 0
+        runner = getattr(ctx, "_runner", None)
+        batch_size = config().target_batch_size
+        pending: List[bytes] = []
+
+        async with websockets.connect(self.cfg.endpoint) as ws:
+            if self.cfg.subscription_message:
+                await ws.send(self.cfg.subscription_message)
+            while True:
+                try:
+                    msg = await ws.recv()
+                except websockets.ConnectionClosedOK:
+                    break
+                pending.append(msg if isinstance(msg, bytes) else msg.encode())
+                count += 1
+                if len(pending) >= batch_size:
+                    await ctx.collect(self.fmt.batch(pending))
+                    pending = []
+                    state.insert("messages", count)
+                if runner is not None:
+                    cm = await runner.poll_source_control()
+                    if cm is not None and cm.kind == "stop":
+                        if pending:
+                            await ctx.collect(self.fmt.batch(pending))
+                        return (SourceFinishType.GRACEFUL
+                                if cm.stop_mode != StopMode.IMMEDIATE
+                                else SourceFinishType.IMMEDIATE)
+        if pending:
+            await ctx.collect(self.fmt.batch(pending))
+            state.insert("messages", count)
+        return SourceFinishType.FINAL
+
+
+register_connector(ConnectorMeta(
+    name="websocket", description="websocket subscription source",
+    source_factory=WebsocketSource, config_model=WebsocketConfig))

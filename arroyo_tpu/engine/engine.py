@@ -313,7 +313,12 @@ class LocalRunner:
                 while True:
                     await asyncio.sleep(checkpoint_interval_secs)
                     epoch[0] += 1
-                    await running.checkpoint(epoch[0])
+                    e = epoch[0]
+                    await running.checkpoint(e)
+                    # act as the mini-controller: once the epoch is sealed,
+                    # drive the commit phase so two-phase sinks finalize
+                    if await running.wait_for_checkpoint(e):
+                        await running.commit(e)
 
             ticker = asyncio.ensure_future(tick())
         try:

@@ -249,11 +249,35 @@ class TaskRunner:
                     except asyncio.QueueEmpty:
                         break
 
+        await self._await_pending_commit()
         await self.operator.on_close(self.ctx)
         if then_stop or stop_mode is not None:
             await self.ctx.broadcast(Message.stop())
         else:
             await self.ctx.broadcast(Message.end_of_data())
+
+    async def _await_pending_commit(self, timeout: float = 30.0) -> None:
+        """A two-phase sink whose pre-commits were sealed by the final
+        (possibly then_stop) checkpoint must not exit before the controller's
+        Commit arrives — otherwise the last epoch's output is never
+        finalized (the reference parks sink tasks until Commit,
+        job_controller/mod.rs:326-371)."""
+        has_pending = getattr(self.operator, "has_pending_commits", None)
+        if has_pending is None or not has_pending(self.ctx):
+            return
+        try:
+            while True:
+                cm = await asyncio.wait_for(self.control_rx.get(),
+                                            timeout=timeout)
+                if cm.kind == "commit":
+                    await self.operator.handle_commit(cm.epoch, self.ctx)
+                    if not has_pending(self.ctx):
+                        return
+        except asyncio.TimeoutError:
+            logger.warning(
+                "task %s closed with uncommitted pre-commits (no Commit "
+                "within %.0fs); they will be re-committed on restore",
+                self.task_info.task_id, timeout)
 
     async def _advance_watermark(self, wm: int) -> None:
         # fire expired event-time timers first (macro lib.rs:738-753)

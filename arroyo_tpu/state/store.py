@@ -22,6 +22,7 @@ from .tables import (
     TableDescriptor,
     TableType,
     TimeKeyMap,
+    WriteBehavior,
 )
 
 
@@ -143,5 +144,17 @@ class StateStore:
                     desc, entries=table.snapshot(),
                     deletes=self._pending_deletes.get(name))
         self._pending_deletes.clear()
-        return self.backend.write_subtask_checkpoint(
+        meta = self.backend.write_subtask_checkpoint(
             self.task_info, epoch, snaps, watermark)
+        # Tables with CommitWrites behavior surface their snapshot to the
+        # controller so it can drive the second commit phase
+        # (arroyo-controller/src/job_controller/checkpointer.rs:83-110).
+        committing = {
+            name: {k: v for _ts, k, v in (snap.entries or [])}
+            for name, snap in snaps.items()
+            if self.descriptors[name].write_behavior == WriteBehavior.COMMIT_WRITES
+            and snap.entries
+        }
+        if committing:
+            meta.committing_data = committing
+        return meta

@@ -190,6 +190,12 @@ class GlobalKeyedState:
     def get_all(self) -> Dict[Any, Any]:
         return dict(self._data)
 
+    def remove(self, key: Any) -> None:
+        self._data.pop(key, None)
+
+    def clear(self) -> None:
+        self._data.clear()
+
     def snapshot(self) -> List[Tuple[int, Any, Any]]:
         return [(0, k, v) for k, v in self._data.items()]
 

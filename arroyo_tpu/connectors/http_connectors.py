@@ -26,10 +26,15 @@ from .registry import ConnectorMeta, register_connector
 
 
 def _parse_headers(raw: Optional[str]) -> Dict[str, str]:
-    """'K1: v1,K2: v2' header string, as the reference's connector configs."""
+    """'K1: v1,K2: v2' header string, as the reference's connector configs.
+
+    Splits only on commas that start a new ``Name:`` pair, so header values
+    containing commas (Accept lists, dates) survive intact."""
+    import re
+
     out: Dict[str, str] = {}
     if raw:
-        for part in raw.split(","):
+        for part in re.split(r",(?=\s*[A-Za-z0-9-]+\s*:)", raw):
             if ":" in part:
                 k, v = part.split(":", 1)
                 out[k.strip()] = v.strip()

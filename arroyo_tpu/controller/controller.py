@@ -406,6 +406,31 @@ class ControllerServer:
             await self._broadcast_workers(
                 job, "Commit", {"job_id": job.job_id, "epoch": tracker.epoch},
                 ignore_errors=True)
+        # compaction every COMPACT_EVERY epochs (mod.rs:30-31, 388-394):
+        # merge per-subtask gen-0 files into key-range-partitioned gen-1
+        # files, then tell workers to hot-swap (LoadCompactedData)
+        compact_every = config().compact_every
+        if (compact_every and tracker.epoch % compact_every == 0
+                and hasattr(backend, "compact_operator")):
+            loop = asyncio.get_running_loop()
+            ckpt_dir = backend.checkpoint_dir(job.job_id, tracker.epoch) + "/"
+            op_ids = set()
+            for f in backend.storage.list(ckpt_dir):
+                part = f[len(ckpt_dir):].split("/", 1)[0]
+                if part.startswith("operator-"):
+                    op_ids.add(part[len("operator-"):])
+            for op_id in sorted(op_ids):
+                # sync parquet I/O off the controller's event loop
+                result = await loop.run_in_executor(
+                    None, backend.compact_operator, job.job_id, op_id,
+                    tracker.epoch)
+                if result["to_load"]:
+                    await self._broadcast_workers(
+                        job, "LoadCompactedData",
+                        {"job_id": job.job_id, "epoch": tracker.epoch,
+                         "operator_id": op_id, "files": result["to_load"],
+                         "dropped": result["to_drop"]},
+                        ignore_errors=True)
         # epoch cleanup: keep the last N checkpoints (mod.rs:30, 388-394)
         keep = config().checkpoints_to_keep
         min_epoch = max(tracker.epoch - keep + 1, 0)

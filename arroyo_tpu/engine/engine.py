@@ -276,6 +276,11 @@ class RunningEngine:
         for q in self.sink_controls():
             await q.put(ControlMessage.commit(epoch))
 
+    async def load_compacted(self, operator_id: str, payload) -> None:
+        """Deliver a compaction hot-swap notice to one operator's subtasks."""
+        for q in self.operator_controls().get(operator_id, []):
+            await q.put(ControlMessage("load_compacted", compacted=payload))
+
     async def join(self) -> List[ControlResp]:
         """Wait for all subtasks to finish; drain + return control responses."""
         tasks = [h.task for h in self.engine.subtasks.values() if h.task]

@@ -65,6 +65,13 @@ class Operator:
         """Second phase of two-phase commit (sinks only)."""
         pass
 
+    async def handle_load_compacted(self, payload: Any, ctx: Context) -> None:
+        """Compaction hot-swap notice (ControlMessage::LoadCompacted): the
+        operator's checkpoint files were merged into a compacted generation.
+        Live state is in memory/HBM, so the default is a no-op; operators
+        that lazily page state from checkpoint files override this."""
+        pass
+
     async def on_close(self, ctx: Context) -> None:
         """Called when all inputs have finished, before EndOfData propagates."""
         pass

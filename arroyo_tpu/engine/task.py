@@ -159,6 +159,9 @@ class TaskRunner:
         if cm.kind == "commit":
             await self.operator.handle_commit(cm.epoch, self.ctx)
             return cm
+        if cm.kind == "load_compacted":
+            await self.operator.handle_load_compacted(cm.compacted, self.ctx)
+            return cm
         return cm  # stop etc: source loop decides
 
     # -- processor -------------------------------------------------------
@@ -190,6 +193,9 @@ class TaskRunner:
                     cm = get_control.result()
                     if cm.kind == "commit":
                         await self.operator.handle_commit(cm.epoch, self.ctx)
+                    elif cm.kind == "load_compacted":
+                        await self.operator.handle_load_compacted(
+                            cm.compacted, self.ctx)
                     elif cm.kind == "stop" and cm.stop_mode == StopMode.IMMEDIATE:
                         return
                 if get_merged not in done:
@@ -273,6 +279,9 @@ class TaskRunner:
                     await self.operator.handle_commit(cm.epoch, self.ctx)
                     if not has_pending(self.ctx):
                         return
+                elif cm.kind == "stop" and cm.stop_mode == StopMode.IMMEDIATE:
+                    # abandon the wait: pre-commits re-commit on restore
+                    return
         except asyncio.TimeoutError:
             logger.warning(
                 "task %s closed with uncommitted pre-commits (no Commit "

@@ -47,30 +47,31 @@ def rows_to_columns(rows: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
     cols: Dict[str, np.ndarray] = {}
     for k in names:
         vs = [r.get(k) for r in rows]
-        arr = np.array(vs)
-        if arr.dtype == object or arr.dtype.kind in "OU":
-            has_none = any(v is None for v in vs)
-            if all(v is None for v in vs):
-                arr = np.array(vs, dtype=object)  # untyped: keep the Nones
-            elif not has_none and all(isinstance(v, bool) for v in vs):
-                arr = np.array(vs, dtype=bool)
-            elif not has_none:
+        # Dispatch on the *JSON* types, never by attempted coercion: a column
+        # of digit strings ("01234") must stay a string column.
+        present = [v for v in vs if v is not None]
+        has_none = len(present) < len(vs)
+        if not present:
+            arr = np.array(vs, dtype=object)  # untyped: keep the Nones
+        elif all(isinstance(v, bool) for v in present):
+            arr = (np.array(vs, dtype=object) if has_none
+                   else np.array(vs, dtype=bool))
+        elif all(isinstance(v, int) and not isinstance(v, bool)
+                 for v in present):
+            if has_none:
+                arr = np.array([np.nan if v is None else v for v in vs],
+                               dtype=np.float64)
+            else:
                 try:
                     arr = np.array(vs, dtype=np.int64)
-                except (ValueError, TypeError, OverflowError):
-                    try:
-                        arr = np.array(vs, dtype=np.float64)
-                    except (ValueError, TypeError):
-                        arr = np.array(vs, dtype=object)
-            else:
-                # columns with missing fields: float (None -> NaN) if every
-                # present value is numeric, else object keeping the Nones
-                try:
-                    arr = np.array(
-                        [np.nan if v is None else v for v in vs],
-                        dtype=np.float64)
-                except (ValueError, TypeError):
+                except OverflowError:
                     arr = np.array(vs, dtype=object)
+        elif all(isinstance(v, (int, float)) and not isinstance(v, bool)
+                 for v in present):
+            arr = np.array([np.nan if v is None else v for v in vs],
+                           dtype=np.float64)
+        else:
+            arr = np.array(vs, dtype=object)
         cols[k] = arr
     return cols
 

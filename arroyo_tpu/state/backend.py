@@ -73,8 +73,12 @@ class BackingStore:
         raise NotImplementedError
 
     def restore_subtask(
-        self, task: TaskInfo, epoch: int, table_names: Sequence[str]
+        self, task: TaskInfo, epoch: int,
+        tables: Sequence[TableDescriptor],
     ) -> Dict[str, TableSnapshot]:
+        """Restore the given tables; non-GLOBAL tables are filtered to the
+        restoring task's key range, GLOBAL tables are merged across all
+        subtasks unfiltered (global_keyed_map.rs semantics)."""
         raise NotImplementedError
 
     def restore_watermark(self, task: TaskInfo, epoch: int) -> Optional[int]:
@@ -290,10 +294,99 @@ class ParquetBackend(BackingStore):
         )
         return meta
 
+    @classmethod
+    def compacted_file(cls, job_id: str, epoch: int, operator_id: str,
+                       safe_table: str, partition: int) -> str:
+        return (f"{cls.operator_dir(job_id, epoch, operator_id)}/"
+                f"compacted-{safe_table}-p{partition:03d}.parquet")
+
+    @classmethod
+    def compaction_marker(cls, job_id: str, epoch: int,
+                          operator_id: str) -> str:
+        return f"{cls.operator_dir(job_id, epoch, operator_id)}/compaction.json"
+
+    # -- compaction (parquet.rs:451-560) -----------------------------------
+
+    def compact_operator(self, job_id: str, operator_id: str, epoch: int,
+                         n_partitions: int = 1) -> Dict[str, List[str]]:
+        """Merge an operator's per-subtask gen-0 checkpoint files into
+        ``n_partitions`` key-range-partitioned gen-1 files, applying delete
+        tombstones (``compact_operator``, parquet.rs:509-560).
+
+        Returns ``{"to_load": [new files], "to_drop": [replaced files]}``;
+        the marker makes restore prefer the compacted generation, and the
+        replaced gen-0 files are deleted afterwards.
+        """
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from ..types import server_for_hash_array
+
+        op_dir = self.operator_dir(job_id, epoch, operator_id)
+        by_table: Dict[str, List[str]] = {}
+        for f in self.storage.list(op_dir):
+            base = f.rsplit("/", 1)[-1]
+            if base.startswith("table-") and base.endswith(".parquet"):
+                safe = base[len("table-"):].rsplit("-", 1)[0]
+                by_table.setdefault(safe, []).append(f)
+
+        to_load: List[str] = []
+        to_drop: List[str] = []
+        marker: Dict[str, Any] = {"tables": {}, "n_partitions": n_partitions}
+        for safe, files in sorted(by_table.items()):
+            cols: Dict[str, list] = {"key_hash": [], "timestamp": [],
+                                     "key": [], "value": [], "operation": []}
+            for f in sorted(files):
+                t = pq.read_table(io.BytesIO(self.storage.get(f)))
+                cols["key_hash"].append(t.column("key_hash").to_numpy())
+                cols["timestamp"].append(t.column("timestamp").to_numpy())
+                cols["key"].extend(t.column("key").to_pylist())
+                cols["value"].extend(t.column("value").to_pylist())
+                cols["operation"].append(t.column("operation").to_numpy())
+            kh = np.concatenate(cols["key_hash"]) if cols["key_hash"] else np.array([], np.uint64)
+            ts = np.concatenate(cols["timestamp"]) if cols["timestamp"] else np.array([], np.int64)
+            ops = np.concatenate(cols["operation"]) if cols["operation"] else np.array([], np.int8)
+            keys, values = cols["key"], cols["value"]
+            # Apply tombstones: a DeleteKey removes every insert of that key
+            # within the (self-contained) epoch; the tombstone itself is then
+            # dropped from the compacted generation.
+            deleted = {k for k, op in zip(keys, ops) if op == OP_DELETE_KEY}
+            live = [i for i in range(len(keys))
+                    if ops[i] != OP_DELETE_KEY and keys[i] not in deleted]
+            part_of = server_for_hash_array(kh, n_partitions) if len(kh) else kh
+            new_files = []
+            for p in range(n_partitions):
+                idx = [i for i in live if int(part_of[i]) == p]
+                if not idx:
+                    continue
+                table = pa.table({
+                    "key_hash": pa.array(kh[idx], type=pa.uint64()),
+                    "timestamp": pa.array(ts[idx], type=pa.int64()),
+                    "key": pa.array([keys[i] for i in idx], type=pa.binary()),
+                    "value": pa.array([values[i] for i in idx], type=pa.binary()),
+                    "operation": pa.array(ops[idx], type=pa.int8()),
+                })
+                buf = io.BytesIO()
+                pq.write_table(table, buf, compression="zstd")
+                path = self.compacted_file(job_id, epoch, operator_id, safe, p)
+                self.storage.put(path, buf.getvalue())
+                new_files.append(path)
+            marker["tables"][safe] = {"files": new_files, "replaced": files}
+            to_load.extend(new_files)
+            to_drop.extend(files)
+        # The marker commits the swap: restore prefers the compacted
+        # generation from this point, so dropping gen-0 files is safe.
+        self.storage.put(self.compaction_marker(job_id, epoch, operator_id),
+                         json.dumps(marker).encode())
+        for f in to_drop:
+            self.storage.delete_if_present(f)
+        return {"to_load": to_load, "to_drop": to_drop}
+
     # -- restore -----------------------------------------------------------
 
     def restore_subtask(
-        self, task: TaskInfo, epoch: int, table_names: Sequence[str]
+        self, task: TaskInfo, epoch: int,
+        tables: Sequence[TableDescriptor],
     ) -> Dict[str, TableSnapshot]:
         import pyarrow.parquet as pq
 
@@ -303,13 +396,28 @@ class ParquetBackend(BackingStore):
         # by the restoring task's key range (parquet.rs:194-218): this is what
         # makes rescale-by-key-range work.
         files = self.storage.list(op_dir)
-        for name in table_names:
+        compacted: Dict[str, List[str]] = {}
+        marker_path = self.compaction_marker(task.job_id, epoch,
+                                             task.operator_id)
+        if self.storage.exists(marker_path):
+            marker = json.loads(self.storage.get(marker_path))
+            compacted = {safe: info["files"]
+                         for safe, info in marker["tables"].items()}
+        for desc in tables:
+            name = desc.name
             safe = name if name.isalnum() else f"t{ord(name[0]):02x}"
             prefix = f"table-{safe}-"
+            if safe in compacted:
+                # compacted generation supersedes gen-0 subtask files
+                table_files = list(compacted[safe])
+            else:
+                table_files = [
+                    f for f in files
+                    if f.rsplit("/", 1)[-1].startswith(prefix)
+                    and f.endswith(".parquet")]
             snaps: List[TableSnapshot] = []
-            for f in files:
-                base = f.rsplit("/", 1)[-1]
-                if not (base.startswith(prefix) and base.endswith(".parquet")):
+            for f in table_files:
+                if not self.storage.exists(f):
                     continue
                 data = self.storage.get(f)
                 table = pq.read_table(io.BytesIO(data))
@@ -319,7 +427,7 @@ class ParquetBackend(BackingStore):
                     table.column("key").to_pylist(),
                     table.column("value").to_pylist(),
                     table.column("operation").to_numpy(),
-                    TableDescriptor(name, TableType.KEYED),
+                    desc,
                     task.key_range,
                 ))
             if snaps:
@@ -376,7 +484,7 @@ class InMemoryBackend(BackingStore):
             subtask_index=task.task_index,
             start_time=0, finish_time=0, bytes=0, watermark=watermark)
 
-    def restore_subtask(self, task, epoch, table_names):
+    def restore_subtask(self, task, epoch, table_descs):
         """Mirrors ParquetBackend semantics: merge all subtasks' snapshots and
         filter non-global tables by the restoring task's key range."""
         import copy
@@ -386,7 +494,8 @@ class InMemoryBackend(BackingStore):
         for (job, ep, op, _idx), (tables, _wm) in sorted(self._store.items()):
             if job != task.job_id or ep != epoch or op != task.operator_id:
                 continue
-            for name in table_names:
+            for desc in table_descs:
+                name = desc.name
                 if name not in tables:
                     continue
                 snap = copy.deepcopy(tables[name])

@@ -107,7 +107,7 @@ class StateStore:
         if self.restore_epoch is None:
             return None
         snaps = self.backend.restore_subtask(self.task_info, self.restore_epoch,
-                                             [name])
+                                             [self.descriptors[name]])
         return snaps.get(name)
 
     def _maybe_restore(self, name: str, table: Any) -> None:

@@ -184,7 +184,14 @@ class WorkerServer:
         return {}
 
     async def _load_compacted(self, req: Dict) -> Dict:
-        return {}  # compaction hot-swap: round 2
+        # Hot-swap compacted checkpoint files (LoadCompactedData,
+        # arroyo-worker/src/lib.rs:602-631): forward to the operator's tasks.
+        if self.running is not None:
+            await self.running.load_compacted(
+                req.get("operator_id", ""),
+                {"epoch": req.get("epoch"), "files": req.get("files", []),
+                 "dropped": req.get("dropped", [])})
+        return {}
 
 
 async def run_worker(controller_addr: str, job_id: str,

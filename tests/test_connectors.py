@@ -295,34 +295,36 @@ def test_kafka_transactional_sink_read_committed():
 # ---------------------------------------------------------------------------
 
 
-@pytest.fixture
-def aiohttp_server_factory():
-    """Runs an aiohttp app on an ephemeral port inside the test's loop."""
-    import aiohttp.web as web
+class _AiohttpServers:
+    """Runs aiohttp apps on ephemeral ports inside the test's own event
+    loop; tests must ``await srv.cleanup()`` before their loop closes."""
 
-    servers = []
+    def __init__(self):
+        self._runners = []
 
-    async def start(app):
+    async def start(self, app):
+        import aiohttp.web as web
+
         runner = web.AppRunner(app)
         await runner.setup()
         site = web.TCPSite(runner, "127.0.0.1", 0)
         await site.start()
         port = site._server.sockets[0].getsockname()[1]
-        servers.append(runner)
+        self._runners.append(runner)
         return f"http://127.0.0.1:{port}"
 
-    yield start
-
-    async def cleanup():
-        for r in servers:
+    async def cleanup(self):
+        for r in self._runners:
             await r.cleanup()
-
-    # cleanup happens inside the test loop via addfinalizer pattern; tests
-    # call their own asyncio.run so we just drop refs here
-    servers.clear()
+        self._runners.clear()
 
 
-def test_polling_http_source(aiohttp_server_factory):
+@pytest.fixture
+def aiohttp_servers():
+    return _AiohttpServers()
+
+
+def test_polling_http_source(aiohttp_servers):
     import aiohttp.web as web
 
     count = {"n": 0}
@@ -334,7 +336,7 @@ def test_polling_http_source(aiohttp_server_factory):
     async def run():
         app = web.Application()
         app.router.add_get("/poll", handler)
-        base = await aiohttp_server_factory(app)
+        base = await aiohttp_servers.start(app)
 
         clear_sink("http1")
         prog = (Stream.source("polling_http", {
@@ -343,14 +345,17 @@ def test_polling_http_source(aiohttp_server_factory):
                 .sink("memory", {"name": "http1"}))
         eng = Engine.for_local(prog, "poll-job")
         running = eng.start()
-        await running.join()
+        try:
+            await running.join()
+        finally:
+            await aiohttp_servers.cleanup()
 
     asyncio.run(run())
     rows = Batch.concat(sink_output("http1"))
     assert rows.columns["n"].tolist() == [1, 2, 3, 4, 5]
 
 
-def test_sse_source(aiohttp_server_factory):
+def test_sse_source(aiohttp_servers):
     import aiohttp.web as web
 
     async def sse_handler(request):
@@ -368,7 +373,7 @@ def test_sse_source(aiohttp_server_factory):
     async def run():
         app = web.Application()
         app.router.add_get("/events", sse_handler)
-        base = await aiohttp_server_factory(app)
+        base = await aiohttp_servers.start(app)
 
         clear_sink("sse1")
         prog = (Stream.source("sse", {"endpoint": f"{base}/events",
@@ -376,14 +381,17 @@ def test_sse_source(aiohttp_server_factory):
                 .sink("memory", {"name": "sse1"}))
         eng = Engine.for_local(prog, "sse-job")
         running = eng.start()
-        await running.join()
+        try:
+            await running.join()
+        finally:
+            await aiohttp_servers.cleanup()
 
     asyncio.run(run())
     rows = Batch.concat(sink_output("sse1"))
     assert rows.columns["i"].tolist() == list(range(10))
 
 
-def test_webhook_sink(aiohttp_server_factory):
+def test_webhook_sink(aiohttp_servers):
     import aiohttp.web as web
 
     received = []
@@ -395,7 +403,7 @@ def test_webhook_sink(aiohttp_server_factory):
     async def run():
         app = web.Application()
         app.router.add_post("/hook", hook)
-        base = await aiohttp_server_factory(app)
+        base = await aiohttp_servers.start(app)
 
         prog = (Stream.source("impulse", {"event_rate": 0.0,
                                           "message_count": 20,
@@ -404,7 +412,10 @@ def test_webhook_sink(aiohttp_server_factory):
                 .sink("webhook", {"endpoint": f"{base}/hook"}))
         eng = Engine.for_local(prog, "hook-job")
         running = eng.start()
-        await running.join()
+        try:
+            await running.join()
+        finally:
+            await aiohttp_servers.cleanup()
 
     asyncio.run(run())
     assert sorted(r["counter"] for r in received) == list(range(20))
@@ -480,7 +491,7 @@ def test_then_stop_checkpoint_commits_before_close(tmp_path):
     assert not list(out.glob(".staging/*")), "staged parts not promoted"
 
 
-def test_sse_reconnect_resumes_with_last_event_id(aiohttp_server_factory):
+def test_sse_reconnect_resumes_with_last_event_id(aiohttp_servers):
     import aiohttp.web as web
 
     attempts = []
@@ -504,14 +515,17 @@ def test_sse_reconnect_resumes_with_last_event_id(aiohttp_server_factory):
     async def run():
         app = web.Application()
         app.router.add_get("/events", sse_handler)
-        base = await aiohttp_server_factory(app)
+        base = await aiohttp_servers.start(app)
 
         clear_sink("sse2")
         prog = (Stream.source("sse", {"endpoint": f"{base}/events"})
                 .sink("memory", {"name": "sse2"}))
         eng = Engine.for_local(prog, "sse2-job")
         running = eng.start()
-        await running.join()
+        try:
+            await running.join()
+        finally:
+            await aiohttp_servers.cleanup()
 
     asyncio.run(run())
     rows = Batch.concat(sink_output("sse2"))

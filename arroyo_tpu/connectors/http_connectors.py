@@ -34,7 +34,10 @@ def _parse_headers(raw: Optional[str]) -> Dict[str, str]:
 
     out: Dict[str, str] = {}
     if raw:
-        for part in re.split(r",(?=\s*[A-Za-z0-9-]+\s*:)", raw):
+        # lookahead covers the full RFC 7230 token charset (underscores,
+        # dots, ...), not just alphanumerics-and-dash
+        for part in re.split(
+                r",(?=\s*[!#$%&'*+.^_`|~0-9A-Za-z-]+\s*:)", raw):
             if ":" in part:
                 k, v = part.split(":", 1)
                 out[k.strip()] = v.strip()

@@ -54,7 +54,10 @@ def rows_to_columns(rows: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
         if not present:
             arr = np.array(vs, dtype=object)  # untyped: keep the Nones
         elif all(isinstance(v, bool) for v in present):
-            arr = (np.array(vs, dtype=object) if has_none
+            # nullable bool -> float with NaN, so numeric consumers
+            # (aggregation inputs, jnp.asarray) keep working
+            arr = (np.array([np.nan if v is None else float(v) for v in vs],
+                            dtype=np.float64) if has_none
                    else np.array(vs, dtype=bool))
         elif all(isinstance(v, int) and not isinstance(v, bool)
                  for v in present):

@@ -242,6 +242,13 @@ class KeyedBinState:
             self._grow_ring(self.max_bin - self.min_bin + 1)
 
         slots = self._lookup_or_insert(key_hash)
+
+        # additive aggregates route through the Pallas MXU scatter (one-hot
+        # matmul) instead of XLA's serial scatter; min/max stay on XLA
+        if self._use_pallas():
+            self._update_pallas(slots, bins_abs, live, agg_inputs, n)
+            return
+
         npad = _bucket(n, floor=256)
         slots_p = np.zeros(npad, dtype=np.int32)
         slots_p[:n] = slots
@@ -260,6 +267,39 @@ class KeyedBinState:
         self.values, self.counts = kernel(
             self.values, self.counts, jnp.asarray(slots_p),
             jnp.asarray(bins_p), jnp.asarray(vals), jnp.asarray(valid))
+
+    def _use_pallas(self) -> bool:
+        from .pallas_kernels import LANES, pallas_enabled
+
+        if not pallas_enabled():
+            return False
+        if not all(k in ("sum", "avg", "count") for k in self.kinds):
+            return False
+        # packed width P = 2 channels (hi/lo) x (aggs + count) x B lanes;
+        # the kernel holds [CHUNK, P] + [TILE_C, P] f32 blocks in VMEM, so
+        # wide rings (long window / short slide) must fall back to XLA
+        P = 2 * (len(self.aggs) + 1) * self.B
+        return ((P + LANES - 1) // LANES) * LANES <= 1024
+
+    def _update_pallas(self, slots: np.ndarray, bins_abs: np.ndarray,
+                       live: np.ndarray, agg_inputs: Dict[str, np.ndarray],
+                       n: int) -> None:
+        from .pallas_kernels import (active_capacity, pad_batch,
+                                     update_bin_state)
+
+        weights = np.zeros((len(self.aggs) + 1, n), dtype=np.float32)
+        weights[0] = 1.0  # counts channel
+        for i, a in enumerate(self.aggs):
+            if a.kind == AggKind.COUNT or a.column is None:
+                weights[i + 1] = 1.0
+            else:
+                weights[i + 1] = agg_inputs[a.column].astype(np.float32)
+        weights[:, ~live] = 0.0
+        s, b, w = pad_batch(slots.astype(np.int32),
+                            (bins_abs % self.B).astype(np.int32), weights)
+        c_act = active_capacity(self.next_slot, self.C)
+        self.values, self.counts = update_bin_state(
+            self.values, self.counts, s, b, w, c_act, self.B)
 
     def _grow_ring(self, needed: int) -> None:
         """Rare: data spans more bins than the ring; re-layout host-side."""

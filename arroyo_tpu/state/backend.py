@@ -109,10 +109,17 @@ def _serialize_rows(
             values.append(pickle.dumps(v, protocol=4))
             ops.append(OP_INSERT)
     if snapshot.deletes:
+        # a tombstone whose key was re-inserted before this checkpoint is
+        # superseded by the live entry; writing both into one epoch file
+        # would make order-blind readers (compaction) drop the live row
+        live_keys = set(keys)
         for k in snapshot.deletes:
+            kb = pickle.dumps(k, protocol=4)
+            if kb in live_keys:
+                continue
             key_hashes.append(key_hash_of(k))
             timestamps.append(0)
-            keys.append(pickle.dumps(k, protocol=4))
+            keys.append(kb)
             values.append(b"")
             ops.append(OP_DELETE_KEY)
     if snapshot.batch is not None and len(snapshot.batch):
@@ -323,6 +330,15 @@ class ParquetBackend(BackingStore):
         from ..types import server_for_hash_array
 
         op_dir = self.operator_dir(job_id, epoch, operator_id)
+        marker_path = self.compaction_marker(job_id, epoch, operator_id)
+        if self.storage.exists(marker_path):
+            # already compacted (retry / double invocation): the gen-0 files
+            # are gone, so rebuilding would write an empty marker and orphan
+            # the compacted generation — return the existing swap instead
+            marker = json.loads(self.storage.get(marker_path))
+            return {"to_load": [f for info in marker["tables"].values()
+                               for f in info["files"]],
+                    "to_drop": []}
         by_table: Dict[str, List[str]] = {}
         for f in self.storage.list(op_dir):
             base = f.rsplit("/", 1)[-1]
@@ -418,7 +434,11 @@ class ParquetBackend(BackingStore):
             snaps: List[TableSnapshot] = []
             for f in table_files:
                 if not self.storage.exists(f):
-                    continue
+                    # a file named by the compaction marker must exist;
+                    # restoring without it would silently lose its key range
+                    raise FileNotFoundError(
+                        f"checkpoint file listed in compaction marker is "
+                        f"missing: {f}")
                 data = self.storage.get(f)
                 table = pq.read_table(io.BytesIO(data))
                 snaps.append(_deserialize_rows(

@@ -1,0 +1,4 @@
+"""REST API (arroyo-api analog): HTTP server, routes, sqlite metadata."""
+
+from .http import HttpError, HttpServer, Request, Response, Router  # noqa: F401
+from .rest import ApiServer  # noqa: F401

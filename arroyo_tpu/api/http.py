@@ -1,0 +1,223 @@
+"""Minimal asyncio HTTP/1.1 server with routing, JSON bodies, and SSE.
+
+Plays the role axum plays for the reference's REST API
+(/root/reference/arroyo-api/src/rest.rs:93-126) — no third-party web
+framework is available in this image, and the surface we need (JSON CRUD
+routes + one server-sent-events stream) is small enough to own.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import re
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Awaitable, Callable, Dict, Optional
+from urllib.parse import parse_qsl, urlsplit
+
+
+class HttpError(Exception):
+    def __init__(self, status: int, message: str):
+        super().__init__(message)
+        self.status = status
+        self.message = message
+
+
+@dataclass
+class Request:
+    method: str
+    path: str
+    query: Dict[str, str]
+    headers: Dict[str, str]
+    body: bytes
+    params: Dict[str, str] = field(default_factory=dict)
+
+    def json(self) -> Any:
+        if not self.body:
+            return {}
+        try:
+            return json.loads(self.body)
+        except json.JSONDecodeError as e:
+            raise HttpError(400, f"invalid JSON body: {e}")
+
+
+@dataclass
+class Response:
+    status: int = 200
+    body: bytes = b""
+    content_type: str = "application/json"
+    headers: Dict[str, str] = field(default_factory=dict)
+
+    @classmethod
+    def json(cls, obj: Any, status: int = 200) -> "Response":
+        return cls(status=status, body=json.dumps(obj).encode())
+
+
+class SseResponse:
+    """Handler return value that streams server-sent events."""
+
+    def __init__(self, events: AsyncIterator[Dict[str, Any]]):
+        self.events = events
+
+
+Handler = Callable[[Request], Awaitable[Any]]
+
+_STATUS_TEXT = {200: "OK", 201: "Created", 204: "No Content",
+                400: "Bad Request", 404: "Not Found", 405: "Method Not "
+                "Allowed", 409: "Conflict", 422: "Unprocessable Entity",
+                500: "Internal Server Error"}
+
+
+class Router:
+    def __init__(self) -> None:
+        # method -> list of (compiled path regex, handler)
+        self.routes: Dict[str, list] = {}
+
+    def route(self, method: str, pattern: str, handler: Handler) -> None:
+        # '/v1/pipelines/{id}/jobs' -> named groups
+        rx = re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern)
+        self.routes.setdefault(method.upper(), []).append(
+            (re.compile(f"^{rx}$"), handler))
+
+    def get(self, p: str):
+        return lambda h: (self.route("GET", p, h), h)[1]
+
+    def post(self, p: str):
+        return lambda h: (self.route("POST", p, h), h)[1]
+
+    def patch(self, p: str):
+        return lambda h: (self.route("PATCH", p, h), h)[1]
+
+    def delete(self, p: str):
+        return lambda h: (self.route("DELETE", p, h), h)[1]
+
+    def match(self, method: str, path: str):
+        for rx, handler in self.routes.get(method.upper(), []):
+            m = rx.match(path)
+            if m:
+                return handler, m.groupdict()
+        # distinguish 404 from 405 for better errors
+        for routes in self.routes.values():
+            for rx, _ in routes:
+                if rx.match(path):
+                    return None, {"__status__": "405"}
+        return None, {"__status__": "404"}
+
+
+class HttpServer:
+    def __init__(self, router: Router):
+        self.router = router
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self._server = await asyncio.start_server(self._handle, host, port)
+        return self._server.sockets[0].getsockname()[1]
+
+    async def stop(self) -> None:
+        if self._server:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _handle(self, reader: asyncio.StreamReader,
+                      writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                line = await reader.readline()
+                if not line or line in (b"\r\n", b"\n"):
+                    break
+                parts = line.decode("latin1").strip().split(" ")
+                if len(parts) < 2:
+                    break
+                method, target = parts[0], parts[1]
+                headers: Dict[str, str] = {}
+                while True:
+                    h = await reader.readline()
+                    if not h or h in (b"\r\n", b"\n"):
+                        break
+                    k, _, v = h.decode("latin1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                try:
+                    length = int(headers.get("content-length", "0"))
+                    if length < 0:
+                        raise ValueError("negative content-length")
+                except ValueError:
+                    self._write_response(writer, Response.json(
+                        {"error": "invalid Content-Length"}, 400))
+                    await writer.drain()
+                    break
+                body = await reader.readexactly(length) if length else b""
+                split = urlsplit(target)
+                req = Request(method=method, path=split.path,
+                              query=dict(parse_qsl(split.query)),
+                              headers=headers, body=body)
+                keep_alive = await self._dispatch(req, writer)
+                if not keep_alive:
+                    break
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            try:
+                writer.close()
+                await writer.wait_closed()
+            except (ConnectionError, OSError):
+                pass
+
+    async def _dispatch(self, req: Request,
+                        writer: asyncio.StreamWriter) -> bool:
+        handler, params = self.router.match(req.method, req.path)
+        if handler is None:
+            status = int(params.get("__status__", "404"))
+            self._write_response(writer, Response.json(
+                {"error": _STATUS_TEXT[status]}, status))
+            await writer.drain()
+            return True
+        req.params = params
+        try:
+            result = await handler(req)
+        except HttpError as e:
+            self._write_response(
+                writer, Response.json({"error": e.message}, e.status))
+            await writer.drain()
+            return True
+        except Exception:
+            traceback.print_exc()
+            self._write_response(writer, Response.json(
+                {"error": "internal server error"}, 500))
+            await writer.drain()
+            return True
+
+        if isinstance(result, SseResponse):
+            await self._stream_sse(result, writer)
+            return False  # SSE exhausts the connection
+        if not isinstance(result, Response):
+            result = Response.json(result)
+        self._write_response(writer, result)
+        await writer.drain()
+        return True
+
+    def _write_response(self, writer: asyncio.StreamWriter,
+                        resp: Response) -> None:
+        text = _STATUS_TEXT.get(resp.status, "Unknown")
+        head = [f"HTTP/1.1 {resp.status} {text}",
+                f"content-type: {resp.content_type}",
+                f"content-length: {len(resp.body)}"]
+        for k, v in resp.headers.items():
+            head.append(f"{k}: {v}")
+        head.append("\r\n")
+        writer.write("\r\n".join(head).encode() + resp.body)
+
+    async def _stream_sse(self, sse: SseResponse,
+                          writer: asyncio.StreamWriter) -> None:
+        writer.write(b"HTTP/1.1 200 OK\r\n"
+                     b"content-type: text/event-stream\r\n"
+                     b"cache-control: no-cache\r\n"
+                     b"connection: close\r\n\r\n")
+        await writer.drain()
+        try:
+            async for event in sse.events:
+                payload = json.dumps(event).encode()
+                writer.write(b"data: " + payload + b"\n\n")
+                await writer.drain()
+        except (ConnectionError, asyncio.CancelledError):
+            pass

@@ -1,0 +1,458 @@
+"""REST API mirroring the reference's arroyo-api surface.
+
+Route shape follows /root/reference/arroyo-api/src/rest.rs:93-126:
+pipelines CRUD + validate (pipelines.rs:316-700), job listing with errors
+and checkpoint details (jobs.rs:213-542), output tailing as server-sent
+events over the controller's SubscribeToOutput stream (jobs.rs:465+,
+rpc.proto:186), connection-table CRUD with connector schema validation
+(connection_tables.rs), and the connector catalog (connectors.rs).
+
+Postgres is replaced by sqlite (stdlib) — the API owns pipeline/job
+metadata rows, the controller owns runtime state, exactly as in the
+reference where the API writes rows the controller's db-poll picks up.
+Here submission calls the controller directly (same process model as
+LocalRunner deployments); the controller remains the single source of
+truth for live job state.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import time
+import uuid
+from typing import Any, AsyncIterator, Dict, Optional
+
+from ..connectors.registry import list_connectors, validate_config
+from ..controller.controller import ControllerServer
+from ..controller.state_machine import JobState
+from ..sql import Planner, SchemaProvider, SqlPlanError
+from ..sql.compiler import SqlCompileError
+from .http import HttpError, HttpServer, Request, Router, SseResponse
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS pipelines (
+    id TEXT PRIMARY KEY,
+    name TEXT NOT NULL,
+    query TEXT NOT NULL,
+    parallelism INTEGER NOT NULL DEFAULT 1,
+    created_at REAL NOT NULL,
+    stopped INTEGER NOT NULL DEFAULT 0
+);
+CREATE TABLE IF NOT EXISTS jobs (
+    id TEXT PRIMARY KEY,
+    pipeline_id TEXT NOT NULL REFERENCES pipelines(id),
+    created_at REAL NOT NULL
+);
+CREATE TABLE IF NOT EXISTS job_log (
+    id INTEGER PRIMARY KEY AUTOINCREMENT,
+    job_id TEXT NOT NULL,
+    ts REAL NOT NULL,
+    level TEXT NOT NULL,
+    message TEXT NOT NULL
+);
+CREATE TABLE IF NOT EXISTS connection_tables (
+    id TEXT PRIMARY KEY,
+    name TEXT UNIQUE NOT NULL,
+    connector TEXT NOT NULL,
+    table_type TEXT NOT NULL,
+    config TEXT NOT NULL,
+    created_at REAL NOT NULL
+);
+"""
+
+
+class ApiServer:
+    """The arroyo-api equivalent: REST over a controller + sqlite."""
+
+    def __init__(self, controller: ControllerServer,
+                 db_path: str = ":memory:"):
+        self.controller = controller
+        self.db = sqlite3.connect(db_path)
+        self.db.row_factory = sqlite3.Row
+        self.db.executescript(_SCHEMA)
+        self.router = Router()
+        self._register_routes()
+        self.http = HttpServer(self.router)
+        self.port: Optional[int] = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self.port = await self.http.start(host, port)
+        return self.port
+
+    async def stop(self) -> None:
+        await self.http.stop()
+        self.db.close()
+
+    # -- planning ----------------------------------------------------------
+
+    def _plan(self, query: str, parallelism: int):
+        provider = SchemaProvider()
+        self._install_connection_tables(provider)
+        try:
+            return Planner(provider).plan(query,
+                                          query_parallelism=parallelism)
+        except (SqlPlanError, SqlCompileError, ValueError, KeyError) as e:
+            raise HttpError(400, f"SQL error: {e}")
+
+    def _install_connection_tables(self, provider: SchemaProvider) -> None:
+        """Saved connection tables become CREATE TABLEs the planner sees."""
+        from ..sql.ast_nodes import CreateTable
+
+        for row in self.db.execute("SELECT * FROM connection_tables"):
+            cfg = json.loads(row["config"])
+            with_opts = {"connector": row["connector"], **{
+                k: str(v) for k, v in cfg.items() if v is not None}}
+            provider.add_create_table(CreateTable(
+                name=row["name"], columns=[], with_options=with_opts))
+
+    # -- routes ------------------------------------------------------------
+
+    def _register_routes(self) -> None:
+        r = self.router
+
+        @r.get("/api/v1/ping")
+        async def ping(req: Request):
+            return {"pong": True}
+
+        # ---- pipelines (pipelines.rs:316-700) ----
+
+        @r.post("/v1/pipelines/validate")
+        async def validate_pipeline(req: Request):
+            body = req.json()
+            query = body.get("query")
+            if not query:
+                raise HttpError(400, "missing 'query'")
+            prog = self._plan(query, int(body.get("parallelism", 1)))
+            return {"graph": _graph_json(prog)}
+
+        @r.post("/v1/pipelines")
+        async def create_pipeline(req: Request):
+            body = req.json()
+            name, query = body.get("name"), body.get("query")
+            if not name or not query:
+                raise HttpError(400, "missing 'name' or 'query'")
+            parallelism = int(body.get("parallelism", 1))
+            prog = self._plan(query, parallelism)
+            pipeline_id = f"pl_{uuid.uuid4().hex[:12]}"
+            job_id = f"job_{uuid.uuid4().hex[:8]}"
+            now = time.time()
+            with self.db:
+                self.db.execute(
+                    "INSERT INTO pipelines (id, name, query, parallelism, "
+                    "created_at) VALUES (?,?,?,?,?)",
+                    (pipeline_id, name, query, parallelism, now))
+                self.db.execute(
+                    "INSERT INTO jobs (id, pipeline_id, created_at) "
+                    "VALUES (?,?,?)", (job_id, pipeline_id, now))
+            await self.controller.submit_job(prog, job_id=job_id)
+            return {"id": pipeline_id, "name": name,
+                    "jobs": [{"id": job_id}],
+                    "graph": _graph_json(prog)}
+
+        @r.get("/v1/pipelines")
+        async def list_pipelines(req: Request):
+            rows = self.db.execute(
+                "SELECT * FROM pipelines ORDER BY created_at").fetchall()
+            return {"data": [self._pipeline_json(row) for row in rows]}
+
+        @r.get("/v1/pipelines/{id}")
+        async def get_pipeline(req: Request):
+            return self._pipeline_json(self._pipeline_row(req.params["id"]))
+
+        @r.patch("/v1/pipelines/{id}")
+        async def patch_pipeline(req: Request):
+            row = self._pipeline_row(req.params["id"])
+            body = req.json()
+            stop = body.get("stop")
+            for job in self._job_rows(row["id"]):
+                jid = job["id"]
+                if stop in ("checkpoint", "graceful", "immediate"):
+                    if jid in self.controller.jobs:
+                        await self.controller.stop_job(
+                            jid, checkpoint=(stop == "checkpoint"))
+                    with self.db:
+                        self.db.execute(
+                            "UPDATE pipelines SET stopped = 1 WHERE id = ?",
+                            (row["id"],))
+                if "parallelism" in body:
+                    if jid in self.controller.jobs:
+                        overrides = {
+                            n.operator_id: int(body["parallelism"])
+                            for n in self.controller.jobs[jid]
+                            .program.nodes()}
+                        await self.controller.rescale_job(jid, overrides)
+                    with self.db:
+                        self.db.execute(
+                            "UPDATE pipelines SET parallelism = ? "
+                            "WHERE id = ?",
+                            (int(body["parallelism"]), row["id"]))
+            return self._pipeline_json(self._pipeline_row(row["id"]))
+
+        @r.delete("/v1/pipelines/{id}")
+        async def delete_pipeline(req: Request):
+            row = self._pipeline_row(req.params["id"])
+            for job in self._job_rows(row["id"]):
+                jid = job["id"]
+                if jid in self.controller.jobs:
+                    state = self.controller.job_state(jid)
+                    if not state.terminal:
+                        await self.controller.stop_job(jid,
+                                                       checkpoint=False)
+                        try:
+                            await self.controller.wait_for_state(
+                                jid, JobState.STOPPED, JobState.FINISHED,
+                                timeout=15)
+                        except TimeoutError:
+                            raise HttpError(
+                                409, "job did not stop in time; retry")
+            with self.db:
+                self.db.execute(
+                    "DELETE FROM job_log WHERE job_id IN "
+                    "(SELECT id FROM jobs WHERE pipeline_id = ?)",
+                    (row["id"],))
+                self.db.execute("DELETE FROM jobs WHERE pipeline_id = ?",
+                                (row["id"],))
+                self.db.execute("DELETE FROM pipelines WHERE id = ?",
+                                (row["id"],))
+            return {"deleted": row["id"]}
+
+        @r.get("/v1/pipelines/{id}/jobs")
+        async def pipeline_jobs(req: Request):
+            row = self._pipeline_row(req.params["id"])
+            return {"data": [self._job_json(j)
+                             for j in self._job_rows(row["id"])]}
+
+        # ---- jobs (jobs.rs:213-542) ----
+
+        @r.get("/v1/jobs")
+        async def list_jobs(req: Request):
+            rows = self.db.execute(
+                "SELECT * FROM jobs ORDER BY created_at").fetchall()
+            return {"data": [self._job_json(j) for j in rows]}
+
+        @r.get("/v1/pipelines/{pid}/jobs/{jid}/errors")
+        async def job_errors(req: Request):
+            rows = self.db.execute(
+                "SELECT * FROM job_log WHERE job_id = ? AND level = "
+                "'error' ORDER BY id", (req.params["jid"],)).fetchall()
+            errors = [{"created_at": r["ts"], "message": r["message"]}
+                      for r in rows]
+            job = self.controller.jobs.get(req.params["jid"])
+            if job is not None and job.failure:
+                errors.append({"created_at": None, "message": job.failure})
+            return {"data": errors}
+
+        @r.get("/v1/pipelines/{pid}/jobs/{jid}/checkpoints")
+        async def job_checkpoints(req: Request):
+            job = self.controller.jobs.get(req.params["jid"])
+            if job is None:
+                raise HttpError(404, "no such job")
+            data = []
+            for epoch, tr in sorted(job.trackers.items()):
+                data.append({
+                    "epoch": epoch,
+                    "backend": job.checkpoint_url,
+                    "finished": tr.done,
+                    "subtasks_completed": len(tr.completed),
+                    "subtasks_total": tr.n_subtasks,
+                })
+            return {"data": data,
+                    "last_successful_epoch": job.last_successful_epoch}
+
+        @r.get("/v1/pipelines/{pid}/jobs/{jid}/operator_metric_groups")
+        async def operator_metrics(req: Request):
+            """Per-operator throughput metrics (metrics.rs:42-60 queries
+            prometheus rate(arroyo_worker_*); here the registry is
+            in-process, so the API scrapes it directly)."""
+            from ..obs import metrics as m
+
+            jid = req.params["jid"]
+            groups: Dict[str, Dict[str, Any]] = {}
+            for fam in m.REGISTRY.collect():
+                if not fam.name.startswith("arroyo_worker_"):
+                    continue
+                for s in fam.samples:
+                    if s.name.endswith(("_created",)):
+                        continue
+                    if s.labels.get("job_id") not in ("", jid):
+                        continue
+                    op = s.labels.get("operator_id", "")
+                    g = groups.setdefault(op, {"operator_id": op,
+                                               "metrics": {}})
+                    key = f"{s.name}[{s.labels.get('subtask_idx', '0')}]"
+                    g["metrics"][key] = s.value
+            return {"data": sorted(groups.values(),
+                                   key=lambda g: g["operator_id"])}
+
+        @r.get("/v1/pipelines/{pid}/jobs/{jid}/output")
+        async def job_output(req: Request):
+            jid = req.params["jid"]
+            if jid not in self.controller.jobs:
+                raise HttpError(404, "no such job")
+            return SseResponse(self._tail_output(jid))
+
+        # ---- connectors & connection tables ----
+
+        @r.get("/v1/connectors")
+        async def connectors(req: Request):
+            return {"data": [{
+                "id": m.name, "name": m.name,
+                "source": m.supports_source, "sink": m.supports_sink,
+                "description": m.description,
+            } for m in list_connectors()]}
+
+        @r.post("/v1/connection_tables")
+        async def create_connection_table(req: Request):
+            body = req.json()
+            for f in ("name", "connector", "config"):
+                if f not in body:
+                    raise HttpError(400, f"missing '{f}'")
+            try:
+                cfg = validate_config(body["connector"], body["config"])
+            except KeyError:
+                raise HttpError(400,
+                                f"unknown connector {body['connector']!r}")
+            except Exception as e:
+                raise HttpError(422, f"invalid config: {e}")
+            tid = f"ct_{uuid.uuid4().hex[:12]}"
+            try:
+                with self.db:
+                    self.db.execute(
+                        "INSERT INTO connection_tables (id, name, "
+                        "connector, table_type, config, created_at) "
+                        "VALUES (?,?,?,?,?,?)",
+                        (tid, body["name"], body["connector"],
+                         body.get("table_type", "source"),
+                         json.dumps(cfg), time.time()))
+            except sqlite3.IntegrityError:
+                raise HttpError(409,
+                                f"table {body['name']!r} already exists")
+            return {"id": tid, "name": body["name"],
+                    "connector": body["connector"], "config": cfg}
+
+        @r.post("/v1/connection_tables/test")
+        async def test_connection_table(req: Request):
+            body = req.json()
+            try:
+                validate_config(body.get("connector", ""),
+                                body.get("config", {}))
+            except KeyError:
+                return {"ok": False,
+                        "error": f"unknown connector "
+                                 f"{body.get('connector')!r}"}
+            except Exception as e:
+                return {"ok": False, "error": str(e)}
+            return {"ok": True}
+
+        @r.get("/v1/connection_tables")
+        async def list_connection_tables(req: Request):
+            rows = self.db.execute(
+                "SELECT * FROM connection_tables ORDER BY created_at"
+            ).fetchall()
+            return {"data": [{
+                "id": row["id"], "name": row["name"],
+                "connector": row["connector"],
+                "table_type": row["table_type"],
+                "config": json.loads(row["config"]),
+            } for row in rows]}
+
+        @r.delete("/v1/connection_tables/{id}")
+        async def delete_connection_table(req: Request):
+            cur = self.db.execute(
+                "DELETE FROM connection_tables WHERE id = ?",
+                (req.params["id"],))
+            self.db.commit()
+            if cur.rowcount == 0:
+                raise HttpError(404, "no such connection table")
+            return {"deleted": req.params["id"]}
+
+    # -- helpers -----------------------------------------------------------
+
+    def _pipeline_row(self, pid: str) -> sqlite3.Row:
+        row = self.db.execute("SELECT * FROM pipelines WHERE id = ?",
+                              (pid,)).fetchone()
+        if row is None:
+            raise HttpError(404, f"no pipeline {pid!r}")
+        return row
+
+    def _job_rows(self, pid: str):
+        return self.db.execute(
+            "SELECT * FROM jobs WHERE pipeline_id = ? ORDER BY created_at",
+            (pid,)).fetchall()
+
+    def _pipeline_json(self, row: sqlite3.Row) -> Dict[str, Any]:
+        return {"id": row["id"], "name": row["name"],
+                "query": row["query"], "parallelism": row["parallelism"],
+                "stopped": bool(row["stopped"]),
+                "created_at": row["created_at"],
+                "jobs": [self._job_json(j)
+                         for j in self._job_rows(row["id"])]}
+
+    def _job_json(self, row: sqlite3.Row) -> Dict[str, Any]:
+        jid = row["id"]
+        job = self.controller.jobs.get(jid)
+        state = job.fsm.state.value if job else "Created"
+        return {"id": jid, "pipeline_id": row["pipeline_id"],
+                "state": state,
+                "created_at": row["created_at"],
+                "failure_message": job.failure if job else None,
+                "checkpoint_epoch": (job.last_successful_epoch
+                                     if job else None)}
+
+    async def _tail_output(self, job_id: str) -> AsyncIterator[Dict]:
+        """Bridge the controller's in-process output subscription to SSE
+        (the reference proxies controller SubscribeToOutput the same way,
+        jobs.rs:465+)."""
+        import asyncio
+
+        q: asyncio.Queue = asyncio.Queue()
+        subs = self.controller.sink_subscribers.setdefault(job_id, [])
+        subs.append(q)
+        try:
+            while True:
+                try:
+                    item = await asyncio.wait_for(q.get(), timeout=0.5)
+                except asyncio.TimeoutError:
+                    # a job that finished before (or without) a done event
+                    # must still terminate the stream
+                    job = self.controller.jobs.get(job_id)
+                    if job is None or job.fsm.state.terminal:
+                        yield {"job_id": job_id, "rows": [], "done": True}
+                        return
+                    continue
+                yield _sink_event_json(item)
+                if item.get("done"):
+                    return
+        finally:
+            subs.remove(q)
+
+
+def _sink_event_json(item: Dict[str, Any]) -> Dict[str, Any]:
+    """SendSinkData payloads carry a wire-encoded Batch; SSE clients get
+    plain JSON rows."""
+    out = {"job_id": item.get("job_id"),
+           "operator_id": item.get("operator_id"),
+           "done": bool(item.get("done"))}
+    data = item.get("batch")
+    rows = []
+    if data:
+        from ..formats import batch_to_rows
+        from ..network.data_plane import _decode_batch
+
+        rows = batch_to_rows(_decode_batch(data))
+    out["rows"] = rows
+    return out
+
+
+def _graph_json(prog) -> Dict[str, Any]:
+    """Pipeline DAG for the console (PipelineGraph in the REST types)."""
+    return {
+        "nodes": [{"operator_id": n.operator_id,
+                   "description": n.operator.name,
+                   "parallelism": n.parallelism}
+                  for n in prog.nodes()],
+        "edges": [{"src": u, "dst": v,
+                   "edge_type": prog.edge(u, v).typ.value}
+                  for u, v in prog.graph.edges],
+    }

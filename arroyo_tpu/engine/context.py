@@ -219,9 +219,11 @@ class Context:
         state_store: Any = None,
         control_tx: Optional[asyncio.Queue] = None,
         restore_watermark: Optional[int] = None,
+        metrics: Optional[Any] = None,
     ):
         self.task_info = task_info
         self.collector = collector
+        self.metrics = metrics if metrics is not None else collector.metrics
         self.watermarks = WatermarkHolder(max(n_inputs, 1))
         self.counter = CheckpointCounter(max(n_inputs, 1))
         self.timers = TimerHeap()

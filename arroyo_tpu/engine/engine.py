@@ -190,10 +190,14 @@ class Engine:
                 operator = build_operator(node.operator)
                 store = StateStore(task_info, self.backend, self.restore_epoch)
                 restore_wm = store.restore_watermark() if self.restore_epoch else None
-                ctx = Context(task_info, Collector(edge_groups),
+                from ..obs.metrics import TaskMetrics
+
+                metrics = TaskMetrics(task_info)
+                ctx = Context(task_info, Collector(edge_groups, metrics),
                               n_inputs=len(inputs), state_store=store,
                               control_tx=self.control_resp,
-                              restore_watermark=restore_wm)
+                              restore_watermark=restore_wm,
+                              metrics=metrics)
                 control_rx: asyncio.Queue = asyncio.Queue()
                 runner = TaskRunner(task_info, operator, ctx, inputs,
                                     control_rx, self.control_resp)

@@ -203,6 +203,8 @@ class TaskRunner:
                 idx, side, msg = get_merged.result()
 
                 if msg.kind == MessageKind.RECORD:
+                    if self.ctx.metrics is not None:
+                        self.ctx.metrics.messages_recv.inc(len(msg.batch))
                     await self.operator.process_batch(msg.batch, self.ctx, side)
                 elif msg.kind == MessageKind.WATERMARK:
                     advanced = self.ctx.observe_watermark(idx, msg.watermark)

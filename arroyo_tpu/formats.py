@@ -54,10 +54,10 @@ def rows_to_columns(rows: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
         if not present:
             arr = np.array(vs, dtype=object)  # untyped: keep the Nones
         elif all(isinstance(v, bool) for v in present):
-            # nullable bool -> float with NaN, so numeric consumers
-            # (aggregation inputs, jnp.asarray) keep working
-            arr = (np.array([np.nan if v is None else float(v) for v in vs],
-                            dtype=np.float64) if has_none
+            # nullable bool stays a bool-typed (object) column so sinks
+            # emit true/false consistently whether or not the batch had a
+            # null; numeric consumers coerce via coerce_float
+            arr = (np.array(vs, dtype=object) if has_none
                    else np.array(vs, dtype=bool))
         elif all(isinstance(v, int) and not isinstance(v, bool)
                  for v in present):
@@ -89,6 +89,15 @@ def batch_from_rows(rows: Sequence[Dict[str, Any]],
     else:
         ts = np.full(len(rows), now_micros(), dtype=np.int64)
     return Batch(ts, cols)
+
+
+def coerce_float(arr: np.ndarray, dtype=np.float32) -> np.ndarray:
+    """Numeric view of a column for aggregation inputs: None (in object
+    columns from nullable JSON) becomes NaN instead of raising."""
+    if arr.dtype == object:
+        return np.array([np.nan if v is None else float(v) for v in arr],
+                        dtype=dtype)
+    return arr.astype(dtype)
 
 
 def batch_to_rows(batch: Batch) -> List[Dict[str, Any]]:

@@ -42,6 +42,9 @@ from ..types import (
 
 logger = logging.getLogger(__name__)
 
+BYTES_SENT = "arroyo_worker_bytes_sent"
+BYTES_RECV = "arroyo_worker_bytes_recv"
+
 MAGIC = 0xA770_10CB
 KIND_DATA = 0
 KIND_CONTROL = 1
@@ -147,7 +150,8 @@ class NetworkManager:
     connection per remote worker (NetworkManager::{open_listener, connect,
     start}, network_manager.rs:221-307)."""
 
-    def __init__(self) -> None:
+    def __init__(self, job_id: str = "") -> None:
+        self.job_id = job_id
         self.senders: Dict[Quad, asyncio.Queue] = {}
         self.server: Optional[asyncio.AbstractServer] = None
         self.port: Optional[int] = None
@@ -155,6 +159,23 @@ class NetworkManager:
         self._out_locks: Dict[str, asyncio.Lock] = {}
         self._in_writers: list = []  # accepted connections, closed on close()
         self._pending: Dict[Quad, list] = {}  # frames ahead of registration
+        # labeled prometheus children resolved once per quad, off hot path
+        self._byte_counters: Dict[Tuple[str, str, int], Any] = {}
+
+    def _bytes_counter(self, name: str, op_id: str, idx: int):
+        """Wire-byte accounting with the reference's metric names and task
+        labels (arroyo-types/src/lib.rs:736-737)."""
+        key = (name, op_id, idx)
+        child = self._byte_counters.get(key)
+        if child is None:
+            from ..obs.metrics import _counter
+
+            child = _counter(name, "serialized bytes on the data "
+                             "plane").labels(
+                job_id=self.job_id, operator_id=op_id,
+                subtask_idx=str(idx), operator_name=op_id)
+            self._byte_counters[key] = child
+        return child
 
     # -- receiving ---------------------------------------------------------
 
@@ -174,6 +195,8 @@ class NetworkManager:
                 if frame is None:
                     break
                 quad, kind, payload = frame
+                self._bytes_counter(BYTES_RECV, quad[2], quad[3]).inc(
+                    len(payload))
                 q = self.senders.get(quad)
                 if q is None:
                     # receiver engine not built yet: park the frame
@@ -208,9 +231,12 @@ class NetworkManager:
                       ) -> Callable[[Message], Awaitable[None]]:
         """An OutQueue-compatible async send fn for a remote edge."""
 
+        sent_counter = self._bytes_counter(BYTES_SENT, quad[0], quad[1])
+
         async def send(msg: Message) -> None:
             writer = self._out_writers[addr]
             kind, payload = encode_message(msg)
+            sent_counter.inc(len(payload))
             async with self._out_locks[addr]:
                 _write_frame(writer, quad, kind, payload)
                 await writer.drain()

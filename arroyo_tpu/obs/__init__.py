@@ -1,0 +1,11 @@
+"""Observability: logging, prometheus metrics, admin server, profiling.
+
+The arroyo-server-common + arroyo-metrics analog
+(/root/reference/arroyo-server-common/src/lib.rs:49-205,
+/root/reference/arroyo-metrics/src/lib.rs:9-50).
+"""
+
+from .logging_setup import init_logging  # noqa: F401
+from .metrics import (TaskMetrics, counter_for_task, gauge_for_task,  # noqa: F401
+                      render_metrics)
+from .admin import AdminServer  # noqa: F401

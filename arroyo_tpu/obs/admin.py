@@ -1,0 +1,53 @@
+"""Per-service admin HTTP server: /status /name /metrics /details
+(start_admin_server, arroyo-server-common/src/lib.rs:180-205).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+from ..api.http import HttpServer, Request, Response, Router
+from .metrics import render_metrics
+
+
+class AdminServer:
+    def __init__(self, service: str,
+                 details: Optional[Callable[[], Dict[str, Any]]] = None):
+        self.service = service
+        self.details_fn = details or (lambda: {})
+        self.started = time.time()
+        router = Router()
+
+        @router.get("/status")
+        async def status(req: Request):
+            return {"status": "ok", "service": f"arroyo-{self.service}",
+                    "uptime_s": time.time() - self.started}
+
+        @router.get("/name")
+        async def name(req: Request):
+            return Response(body=f"arroyo-{self.service}".encode(),
+                            content_type="text/plain")
+
+        @router.get("/metrics")
+        async def metrics(req: Request):
+            return Response(body=render_metrics(),
+                            content_type="text/plain; version=0.0.4")
+
+        @router.get("/details")
+        async def details(req: Request):
+            return {"service": f"arroyo-{self.service}",
+                    "pid": os.getpid(),
+                    "details": self.details_fn()}
+
+        self.http = HttpServer(router)
+        self.port: Optional[int] = None
+
+    async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        self.port = await self.http.start(host, port)
+        return self.port
+
+    async def stop(self) -> None:
+        await self.http.stop()

@@ -261,7 +261,9 @@ class KeyedBinState:
             if a.kind == AggKind.COUNT or a.column is None:
                 vals[i, :n] = 1.0
             else:
-                vals[i, :n] = agg_inputs[a.column].astype(np.float32)
+                from ..formats import coerce_float
+
+                vals[i, :n] = coerce_float(agg_inputs[a.column])
 
         kernel = _update_kernel(self.kinds, self.C, self.B, npad)
         self.values, self.counts = kernel(
@@ -293,7 +295,9 @@ class KeyedBinState:
             if a.kind == AggKind.COUNT or a.column is None:
                 weights[i + 1] = 1.0
             else:
-                weights[i + 1] = agg_inputs[a.column].astype(np.float32)
+                from ..formats import coerce_float
+
+                weights[i + 1] = coerce_float(agg_inputs[a.column])
         weights[:, ~live] = 0.0
         s, b, w = pad_batch(slots.astype(np.int32),
                             (bins_abs % self.B).astype(np.int32), weights)

@@ -332,13 +332,20 @@ class ParquetBackend(BackingStore):
         op_dir = self.operator_dir(job_id, epoch, operator_id)
         marker_path = self.compaction_marker(job_id, epoch, operator_id)
         if self.storage.exists(marker_path):
-            # already compacted (retry / double invocation): the gen-0 files
-            # are gone, so rebuilding would write an empty marker and orphan
-            # the compacted generation — return the existing swap instead
+            # already compacted (retry / double invocation): the marker is
+            # the committed swap, so never rebuild — but a crash between
+            # marker write and gen-0 deletion may have left the replaced
+            # files behind; finish that cleanup here
             marker = json.loads(self.storage.get(marker_path))
+            dropped = []
+            for info in marker["tables"].values():
+                for f in info.get("replaced", []):
+                    if self.storage.exists(f):
+                        self.storage.delete_if_present(f)
+                        dropped.append(f)
             return {"to_load": [f for info in marker["tables"].values()
                                for f in info["files"]],
-                    "to_drop": []}
+                    "to_drop": dropped}
         by_table: Dict[str, List[str]] = {}
         for f in self.storage.list(op_dir):
             base = f.rsplit("/", 1)[-1]

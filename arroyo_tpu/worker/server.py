@@ -35,7 +35,7 @@ class WorkerServer:
         self.slots = slots or config().task_slots
         self.worker_id = worker_id or f"worker-{uuid.uuid4().hex[:8]}"
         self.host = host
-        self.network = NetworkManager()
+        self.network = NetworkManager(job_id=job_id or "")
         self.rpc = RpcServer()
         self.controller = RpcClient(controller_addr, "ControllerGrpc")
         self.engine: Optional[Engine] = None

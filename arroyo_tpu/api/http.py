@@ -123,7 +123,13 @@ class HttpServer:
                       writer: asyncio.StreamWriter) -> None:
         try:
             while True:
-                line = await reader.readline()
+                try:
+                    line = await reader.readline()
+                except ValueError:  # request line over the 64 KiB limit
+                    self._write_response(writer, Response.json(
+                        {"error": "request line too long"}, 400))
+                    await writer.drain()
+                    break
                 if not line or line in (b"\r\n", b"\n"):
                     break
                 parts = line.decode("latin1").strip().split(" ")
@@ -131,12 +137,22 @@ class HttpServer:
                     break
                 method, target = parts[0], parts[1]
                 headers: Dict[str, str] = {}
+                bad_header = False
                 while True:
-                    h = await reader.readline()
+                    try:
+                        h = await reader.readline()
+                    except ValueError:  # oversized header
+                        bad_header = True
+                        break
                     if not h or h in (b"\r\n", b"\n"):
                         break
                     k, _, v = h.decode("latin1").partition(":")
                     headers[k.strip().lower()] = v.strip()
+                if bad_header:
+                    self._write_response(writer, Response.json(
+                        {"error": "header too long"}, 400))
+                    await writer.drain()
+                    break
                 try:
                     length = int(headers.get("content-length", "0"))
                     if length < 0:

@@ -167,26 +167,25 @@ class ApiServer:
             stop = body.get("stop")
             for job in self._job_rows(row["id"]):
                 jid = job["id"]
+                if (stop in ("checkpoint", "graceful", "immediate")
+                        and jid in self.controller.jobs):
+                    await self.controller.stop_job(
+                        jid, checkpoint=(stop == "checkpoint"))
+                if "parallelism" in body and jid in self.controller.jobs:
+                    overrides = {
+                        n.operator_id: int(body["parallelism"])
+                        for n in self.controller.jobs[jid].program.nodes()}
+                    await self.controller.rescale_job(jid, overrides)
+            # metadata updates apply once, jobs or not
+            with self.db:
                 if stop in ("checkpoint", "graceful", "immediate"):
-                    if jid in self.controller.jobs:
-                        await self.controller.stop_job(
-                            jid, checkpoint=(stop == "checkpoint"))
-                    with self.db:
-                        self.db.execute(
-                            "UPDATE pipelines SET stopped = 1 WHERE id = ?",
-                            (row["id"],))
+                    self.db.execute(
+                        "UPDATE pipelines SET stopped = 1 WHERE id = ?",
+                        (row["id"],))
                 if "parallelism" in body:
-                    if jid in self.controller.jobs:
-                        overrides = {
-                            n.operator_id: int(body["parallelism"])
-                            for n in self.controller.jobs[jid]
-                            .program.nodes()}
-                        await self.controller.rescale_job(jid, overrides)
-                    with self.db:
-                        self.db.execute(
-                            "UPDATE pipelines SET parallelism = ? "
-                            "WHERE id = ?",
-                            (int(body["parallelism"]), row["id"]))
+                    self.db.execute(
+                        "UPDATE pipelines SET parallelism = ? WHERE id = ?",
+                        (int(body["parallelism"]), row["id"]))
             return self._pipeline_json(self._pipeline_row(row["id"]))
 
         @r.delete("/v1/pipelines/{id}")

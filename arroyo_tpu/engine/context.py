@@ -192,10 +192,10 @@ class Collector:
                 await group[self._rr[gi] % n].send(Message.record(batch))
                 self._rr[gi] += 1
             else:
-                dest = server_for_hash_array(batch.key_hash, n)
-                order = np.argsort(dest, kind="stable")
-                sorted_dest = dest[order]
-                bounds = np.searchsorted(sorted_dest, np.arange(n + 1))
+                # one O(n) native pass: dest + stable order + bounds
+                from ..native import partition_route
+
+                _, order, bounds = partition_route(batch.key_hash, n)
                 for i in range(n):
                     lo, hi = bounds[i], bounds[i + 1]
                     if hi > lo:

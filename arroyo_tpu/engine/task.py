@@ -100,6 +100,13 @@ class TaskRunner:
             await self.ctx.report(ControlResp(
                 kind="task_failed", operator_id=self.task_info.operator_id,
                 task_index=self.task_info.task_index, error=str(e)))
+            # drain downstream so a local run can't deadlock waiting on
+            # inputs that will never end (the controller tears the job
+            # down in distributed mode; end_of_data is the local analog)
+            try:
+                await self.ctx.broadcast(Message.end_of_data())
+            except Exception:
+                pass
         finally:
             self.finished.set()
 

@@ -91,6 +91,30 @@ def batch_from_rows(rows: Sequence[Dict[str, Any]],
     return Batch(ts, cols)
 
 
+def coerce_object_col(v: np.ndarray):
+    """Lift an object-dtype nullable column into (typed values, validity).
+
+    JSON rows with missing bools/ints produce object arrays; device code
+    rejects object dtype, so Nones become the validity mask and the rest
+    gets its natural dtype (None fills: False / NaN).  Columns whose
+    non-null values aren't scalars (strings, lists) return unchanged with
+    mask None — those stay on the host path.
+    """
+    mask = np.fromiter((x is not None for x in v), bool, len(v))
+    sample = next((x for x in v if x is not None), None)
+    if sample is None:
+        return np.zeros(len(v), dtype=np.float32), mask
+    if isinstance(sample, bool):
+        vals = np.fromiter((x if x is not None else False for x in v),
+                           bool, len(v))
+        return vals, (None if mask.all() else mask)
+    if isinstance(sample, (int, float)):
+        vals = np.array([np.nan if x is None else float(x) for x in v],
+                        dtype=np.float64)
+        return vals, (None if mask.all() else mask)
+    return v, None
+
+
 def coerce_float(arr: np.ndarray, dtype=np.float32) -> np.ndarray:
     """Numeric view of a column for aggregation inputs: None (in object
     columns from nullable JSON) becomes NaN instead of raising."""

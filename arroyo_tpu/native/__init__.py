@@ -1,0 +1,169 @@
+"""ctypes bindings for the C++ host runtime library (native/src/host_ops.cpp).
+
+Loads ``libarroyo_host.so`` next to this file, building it from source on
+first use when a toolchain is available.  Every binding has a numpy
+fallback with identical semantics; ``HAVE_NATIVE`` reports which path is
+active and ``ARROYO_NATIVE=0`` forces the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+_SO = os.path.join(os.path.dirname(__file__), "libarroyo_host.so")
+_SRC_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _build() -> bool:
+    """Build the library, safe against concurrent workers: an exclusive
+    lockfile serializes builds, and make writes the final .so via the
+    compiler in one pass so a loader never sees a half-written file that
+    a racing builder produced under the lock."""
+    import fcntl
+
+    makefile = os.path.join(_SRC_DIR, "Makefile")
+    if not os.path.exists(makefile):
+        return False
+    lock_path = _SO + ".lock"
+    try:
+        with open(lock_path, "w") as lock:
+            fcntl.flock(lock, fcntl.LOCK_EX)
+            if os.path.exists(_SO):  # another process won the race
+                return True
+            tmp = _SO + f".tmp{os.getpid()}"
+            subprocess.run(
+                ["make", "-C", _SRC_DIR, f"OUT={tmp}"], check=True,
+                capture_output=True, timeout=120)
+            os.replace(tmp, _SO)  # atomic publish
+            return True
+    except (subprocess.SubprocessError, OSError) as e:
+        logger.warning("native build failed, using numpy fallbacks: %s", e)
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    if os.environ.get("ARROYO_NATIVE", "1") in ("0", "false", "no"):
+        return None
+    if not os.path.exists(_SO) and not _build():
+        return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError as e:  # stale/foreign-arch binary: rebuild once
+        logger.warning("reloading native lib after load failure: %s", e)
+        try:
+            os.unlink(_SO)
+        except OSError:
+            pass
+        if not _build():
+            return None
+        lib = ctypes.CDLL(_SO)
+
+    u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+    i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+
+    lib.arroyo_hash_u64.argtypes = [u64p, u64p, ctypes.c_int64]
+    lib.arroyo_hash_combine.argtypes = [u64p, u64p, ctypes.c_int64]
+    lib.arroyo_partition_route.argtypes = [
+        u64p, ctypes.c_int64, ctypes.c_int32, i32p, i64p, i64p]
+    lib.arroyo_assign_bins.argtypes = [
+        i64p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.c_int64, i32p, u8p,
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+    lib.arroyo_assign_bins.restype = ctypes.c_int64
+    return lib
+
+
+_lib = _load()
+HAVE_NATIVE = _lib is not None
+
+
+def hash_u64(x: np.ndarray) -> np.ndarray:
+    """splitmix64 finalizer; bit-identical to types.hash_u64."""
+    xs = np.ascontiguousarray(x, dtype=np.uint64)
+    if _lib is None:
+        from ..types import _py_hash_u64
+
+        return _py_hash_u64(xs)
+    out = np.empty_like(xs)
+    _lib.arroyo_hash_u64(xs, out, len(xs))
+    return out
+
+
+def hash_combine(acc: np.ndarray, h: np.ndarray) -> np.ndarray:
+    """acc = splitmix64(acc * 31 + h), elementwise; mutates a copy."""
+    a = np.ascontiguousarray(acc, dtype=np.uint64).copy()
+    hs = np.ascontiguousarray(h, dtype=np.uint64)
+    if _lib is None:
+        from ..types import _py_hash_u64
+
+        with np.errstate(over="ignore"):
+            return _py_hash_u64(a * np.uint64(31) + hs)
+    _lib.arroyo_hash_combine(a, hs, len(a))
+    return a
+
+
+def partition_route(key_hash: np.ndarray, n_parts: int
+                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(dest[n] i32, order[n] i64 stable by dest, bounds[n_parts+1] i64).
+
+    ``order[bounds[p]:bounds[p+1]]`` are the row indices destined for
+    shard ``p`` — one O(n) pass in native code vs argsort in numpy.
+    """
+    kh = np.ascontiguousarray(key_hash, dtype=np.uint64)
+    n = len(kh)
+    if _lib is None:
+        from ..types import server_for_hash_array
+
+        dest = server_for_hash_array(kh, n_parts).astype(np.int32)
+        order = np.argsort(dest, kind="stable").astype(np.int64)
+        bounds = np.searchsorted(
+            dest[order], np.arange(n_parts + 1)).astype(np.int64)
+        return dest, order, bounds
+    dest = np.empty(n, dtype=np.int32)
+    order = np.empty(n, dtype=np.int64)
+    bounds = np.empty(n_parts + 1, dtype=np.int64)
+    _lib.arroyo_partition_route(kh, n, n_parts, dest, order, bounds)
+    return dest, order, bounds
+
+
+def assign_bins(ts: np.ndarray, slide: int, ring: int,
+                threshold: Optional[int]
+                ) -> Tuple[np.ndarray, np.ndarray, int, Optional[int],
+                           Optional[int]]:
+    """Window-bin assignment + liveness: (bins i32, live bool, n_live,
+    abs_min, abs_max) where abs_* cover live rows only."""
+    t = np.ascontiguousarray(ts, dtype=np.int64)
+    n = len(t)
+    thr = -(2**63) if threshold is None else int(threshold)
+    if _lib is None:
+        abs_bins = t // slide
+        live = abs_bins >= thr
+        bins = (abs_bins % ring).astype(np.int32)
+        n_live = int(live.sum())
+        if n_live:
+            lo = int(abs_bins[live].min())
+            hi = int(abs_bins[live].max())
+        else:
+            lo = hi = None
+        return bins, live, n_live, lo, hi
+    bins = np.empty(n, dtype=np.int32)
+    live = np.empty(n, dtype=np.uint8)
+    lo = ctypes.c_int64()
+    hi = ctypes.c_int64()
+    n_live = _lib.arroyo_assign_bins(t, n, slide, ring, thr, bins, live,
+                                     ctypes.byref(lo), ctypes.byref(hi))
+    if n_live == 0:
+        return bins, live.astype(bool), 0, None, None
+    return bins, live.astype(bool), int(n_live), lo.value, hi.value

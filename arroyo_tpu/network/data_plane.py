@@ -42,8 +42,7 @@ from ..types import (
 
 logger = logging.getLogger(__name__)
 
-BYTES_SENT = "arroyo_worker_bytes_sent"
-BYTES_RECV = "arroyo_worker_bytes_recv"
+from ..obs.metrics import BYTES_RECV, BYTES_SENT  # noqa: E402
 
 MAGIC = 0xA770_10CB
 KIND_DATA = 0

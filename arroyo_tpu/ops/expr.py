@@ -71,7 +71,22 @@ class CompiledExpr:
         num_cols: Dict[str, np.ndarray] = {"__timestamp": batch.timestamp}
         host_cols: Dict[str, np.ndarray] = {}
         for k, v in batch.columns.items():
-            (num_cols if _is_device_dtype(v.dtype) else host_cols)[k] = v
+            if v.dtype == object:
+                # nullable scalar columns (bool/int with Nones) become a
+                # typed column + __mask_ validity so they can enter jit
+                from ..formats import coerce_object_col
+
+                vals, mask = coerce_object_col(v)
+                if vals.dtype != object:
+                    num_cols[k] = vals
+                    if mask is not None:
+                        num_cols["__mask_" + k] = mask
+                    continue
+                host_cols[k] = v
+            elif _is_device_dtype(v.dtype):
+                num_cols[k] = v
+            else:
+                host_cols[k] = v
 
         padded_cols = {
             k: np.concatenate([v, np.zeros(padded - n, dtype=v.dtype)])

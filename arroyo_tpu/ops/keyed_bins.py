@@ -221,39 +221,39 @@ class KeyedBinState:
         n = len(key_hash)
         if n == 0:
             return
-        bins_abs = timestamps // self.slide
         # a row in bin b feeds panes b..b+W-1; it is late (dropped) only when
         # all those panes already fired — matching the reference's
-        # drop-behind-watermark semantics
-        if self.last_fired_pane is not None:
-            threshold = self.last_fired_pane - self.W + 2
-            live = bins_abs >= threshold
-        else:
-            live = np.ones(n, dtype=bool)
-        if not live.any():
+        # drop-behind-watermark semantics.  Bin assignment + liveness +
+        # min/max run as one native pass (arroyo_assign_bins).
+        from ..native import assign_bins
+
+        threshold = (self.last_fired_pane - self.W + 2
+                     if self.last_fired_pane is not None else None)
+        bins_mod, live, n_live, lo, hi = assign_bins(
+            timestamps, self.slide, self.B, threshold)
+        if n_live == 0:
             return
-        lo = int(bins_abs[live].min())
         self.min_bin = lo if self.min_bin is None else min(self.min_bin, lo)
-        bmax = int(bins_abs.max())
-        self.max_bin = bmax if self.max_bin is None else max(self.max_bin, bmax)
+        self.max_bin = hi if self.max_bin is None else max(self.max_bin, hi)
         # ring capacity check: if new data spans too far ahead, fire nothing —
         # bins wrap only after panes are emitted and evicted; enforce window
         if self.max_bin - self.min_bin >= self.B:
             self._grow_ring(self.max_bin - self.min_bin + 1)
+            bins_mod = ((timestamps // self.slide) % self.B).astype(np.int32)
 
         slots = self._lookup_or_insert(key_hash)
 
         # additive aggregates route through the Pallas MXU scatter (one-hot
         # matmul) instead of XLA's serial scatter; min/max stay on XLA
         if self._use_pallas():
-            self._update_pallas(slots, bins_abs, live, agg_inputs, n)
+            self._update_pallas(slots, bins_mod, live, agg_inputs, n)
             return
 
         npad = _bucket(n, floor=256)
         slots_p = np.zeros(npad, dtype=np.int32)
         slots_p[:n] = slots
         bins_p = np.zeros(npad, dtype=np.int32)
-        bins_p[:n] = (bins_abs % self.B).astype(np.int32)
+        bins_p[:n] = bins_mod
         valid = np.zeros(npad, dtype=bool)
         valid[:n] = live
         vals = np.zeros((len(self.aggs), npad), dtype=np.float32)
@@ -283,7 +283,7 @@ class KeyedBinState:
         P = 2 * (len(self.aggs) + 1) * self.B
         return ((P + LANES - 1) // LANES) * LANES <= 1024
 
-    def _update_pallas(self, slots: np.ndarray, bins_abs: np.ndarray,
+    def _update_pallas(self, slots: np.ndarray, bins_mod: np.ndarray,
                        live: np.ndarray, agg_inputs: Dict[str, np.ndarray],
                        n: int) -> None:
         from .pallas_kernels import (active_capacity, pad_batch,
@@ -299,8 +299,7 @@ class KeyedBinState:
 
                 weights[i + 1] = coerce_float(agg_inputs[a.column])
         weights[:, ~live] = 0.0
-        s, b, w = pad_batch(slots.astype(np.int32),
-                            (bins_abs % self.B).astype(np.int32), weights)
+        s, b, w = pad_batch(slots.astype(np.int32), bins_mod, weights)
         c_act = active_capacity(self.next_slot, self.C)
         self.values, self.counts = update_bin_state(
             self.values, self.counts, s, b, w, c_act, self.B)

@@ -147,6 +147,12 @@ def _mask_and(a, b):
 LIKE_CACHE: Dict[str, Any] = {}
 
 
+def _coerce_object_col(v: np.ndarray):
+    from ..formats import coerce_object_col
+
+    return coerce_object_col(v)
+
+
 def _like_to_regex(pattern: str):
     if pattern not in LIKE_CACHE:
         rx = "^" + re.escape(pattern).replace("%", ".*").replace("_", ".") + "$"
@@ -184,10 +190,28 @@ class ExprCompiler:
                 # struct-field presence mask applies when the physical column
                 # came from a struct
                 sd = self._struct_of_field(target)
-                if sd is not None and sd.presence_col is not None:
-                    pc, pv = sd.presence_col, sd.presence_val
-                    return lambda env: (env[target], env[pc] == pv)
-                return lambda env: (env[target], None)
+                pcpv = ((sd.presence_col, sd.presence_val)
+                        if sd is not None and sd.presence_col is not None
+                        else None)
+                is_str = self.schema.is_string(target)
+
+                def load(env, _t=target, _p=pcpv, _s=is_str):
+                    v = env[_t]
+                    # in jit envs, object columns were pre-coerced by
+                    # CompiledExpr with their validity under __mask_<col>;
+                    # on host paths the raw object array is coerced here
+                    m = env.get("__mask_" + _t)
+                    if (not _s and isinstance(v, np.ndarray)
+                            and v.dtype == object):
+                        v, m2 = _coerce_object_col(v)
+                        m = m2 if m is None else (
+                            m if m2 is None else (m & m2))
+                    if _p is not None:
+                        pm = env[_p[0]] == _p[1]
+                        m = pm if m is None else (m & pm)
+                    return v, m
+
+                return load
             if kind == "struct":
                 sd = target
                 if sd.presence_col is None:

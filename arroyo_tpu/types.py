@@ -79,18 +79,29 @@ _SPLITMIX_C2 = np.uint64(0x94D049BB133111EB)
 _GOLDEN = np.uint64(0x9E3779B97F4A7C15)
 
 
+def _py_hash_u64(x: np.ndarray) -> np.ndarray:
+    """numpy splitmix64 — the reference implementation the native library
+    must match bit-for-bit (tests enforce parity)."""
+    with np.errstate(over="ignore"):
+        z = x.astype(np.uint64) + _GOLDEN
+        z = (z ^ (z >> np.uint64(30))) * _SPLITMIX_C1
+        z = (z ^ (z >> np.uint64(27))) * _SPLITMIX_C2
+        return z ^ (z >> np.uint64(31))
+
+
 def hash_u64(x: np.ndarray) -> np.ndarray:
     """splitmix64 finalizer over an integer array -> uint64 hashes.
 
     Used to spread integer keys uniformly over the u64 ring so that
     key-range sharding balances (the reference relies on ahash for the same
     property; exact hash values need only be internally consistent).
+    Dispatches to the C++ host library when loaded.
     """
-    with np.errstate(over="ignore"):
-        z = x.astype(np.uint64) + _GOLDEN
-        z = (z ^ (z >> np.uint64(30))) * _SPLITMIX_C1
-        z = (z ^ (z >> np.uint64(27))) * _SPLITMIX_C2
-        return z ^ (z >> np.uint64(31))
+    from . import native
+
+    if native.HAVE_NATIVE:
+        return native.hash_u64(np.asarray(x))
+    return _py_hash_u64(np.asarray(x))
 
 
 def hash_any_column(col: np.ndarray) -> np.ndarray:
@@ -107,11 +118,17 @@ def hash_any_column(col: np.ndarray) -> np.ndarray:
 
 def hash_columns(cols: Sequence[np.ndarray]) -> np.ndarray:
     """Combine multiple column hashes into one composite uint64 key hash."""
+    from . import native
+
     assert cols, "need at least one key column"
     acc = hash_any_column(cols[0])
+    if native.HAVE_NATIVE:
+        for c in cols[1:]:
+            acc = native.hash_combine(acc, hash_any_column(c))
+        return acc
     with np.errstate(over="ignore"):
         for c in cols[1:]:
-            acc = hash_u64(acc * np.uint64(31) + hash_any_column(c))
+            acc = _py_hash_u64(acc * np.uint64(31) + hash_any_column(c))
     return acc
 
 

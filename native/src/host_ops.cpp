@@ -1,0 +1,91 @@
+// Native host runtime ops for arroyo_tpu.
+//
+// The reference implements its entire host data plane in Rust; here the
+// Python host runtime offloads its per-batch hot loops to this library
+// (loaded via ctypes, with numpy-based fallbacks kept in sync — see
+// arroyo_tpu/native/__init__.py):
+//
+//  * splitmix64 key hashing (must match arroyo_tpu.types.hash_u64 bit-for-
+//    bit: sharding and checkpoint key ranges depend on it),
+//  * composite multi-column hash combining,
+//  * shuffle partition routing: key_hash -> destination shard, stable
+//    counting-sort order and per-destination bounds in one O(n) pass
+//    (replaces argsort+searchsorted in the collector fan-out; semantics of
+//    server_for_hash per arroyo-types/src/lib.rs:822-836),
+//  * event-time window-bin assignment fused with liveness filtering (the
+//    host half of the device bin-ring update).
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+static inline uint64_t splitmix64(uint64_t z) {
+    z += 0x9E3779B97F4A7C15ULL;
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+}
+
+// out[i] = splitmix64(in[i]); matches types.hash_u64
+void arroyo_hash_u64(const uint64_t* in, uint64_t* out, int64_t n) {
+    for (int64_t i = 0; i < n; i++) out[i] = splitmix64(in[i]);
+}
+
+// acc[i] = splitmix64(acc[i] * 31 + h[i]); matches types.hash_columns
+void arroyo_hash_combine(uint64_t* acc, const uint64_t* h, int64_t n) {
+    for (int64_t i = 0; i < n; i++)
+        acc[i] = splitmix64(acc[i] * 31ULL + h[i]);
+}
+
+// Key-range partition routing (server_for_hash semantics):
+//   dest[i]  = min(n_parts-1, kh[i] / (U64_MAX / n_parts))
+//   order    = stable permutation sorting rows by dest (counting sort)
+//   bounds   = [n_parts+1] prefix offsets into order per destination
+void arroyo_partition_route(const uint64_t* kh, int64_t n, int32_t n_parts,
+                            int32_t* dest, int64_t* order, int64_t* bounds) {
+    const uint64_t range = 0xFFFFFFFFFFFFFFFFULL / (uint64_t)n_parts;
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t d = kh[i] / range;
+        if (d >= (uint64_t)n_parts) d = n_parts - 1;
+        dest[i] = (int32_t)d;
+    }
+    // counting sort: stable, O(n + n_parts)
+    for (int32_t p = 0; p <= n_parts; p++) bounds[p] = 0;
+    for (int64_t i = 0; i < n; i++) bounds[dest[i] + 1]++;
+    for (int32_t p = 0; p < n_parts; p++) bounds[p + 1] += bounds[p];
+    int64_t* cursor = new int64_t[n_parts];
+    std::memcpy(cursor, bounds, n_parts * sizeof(int64_t));
+    for (int64_t i = 0; i < n; i++) order[cursor[dest[i]]++] = i;
+    delete[] cursor;
+}
+
+// Window-bin assignment for the keyed bin-ring update:
+//   bins[i] = (ts[i] / slide) % ring  for rows at or after the liveness
+//   threshold (min live absolute bin); dead rows get live[i] = 0.
+// Returns the number of live rows; fills abs_min/abs_max over live rows.
+int64_t arroyo_assign_bins(const int64_t* ts, int64_t n, int64_t slide,
+                           int64_t ring, int64_t threshold, /* INT64_MIN if none */
+                           int32_t* bins, uint8_t* live,
+                           int64_t* abs_min, int64_t* abs_max) {
+    int64_t lo = INT64_MAX, hi = INT64_MIN, count = 0;
+    for (int64_t i = 0; i < n; i++) {
+        // floor division (numpy // semantics), not C++ truncation
+        int64_t ab = ts[i] >= 0 ? ts[i] / slide
+                                : -((-ts[i] + slide - 1) / slide);
+        uint8_t ok = ab >= threshold;
+        live[i] = ok;
+        int64_t m = ab % ring;
+        bins[i] = (int32_t)(m < 0 ? m + ring : m);
+        if (ok) {
+            count++;
+            if (ab < lo) lo = ab;
+            if (ab > hi) hi = ab;
+        }
+    }
+    *abs_min = lo;
+    *abs_max = hi;
+    return count;
+}
+
+}  // extern "C"

@@ -239,3 +239,27 @@ def test_exec_nexmark_q5_shape():
     out = Batch.concat(outs)
     assert len(out) > 0
     assert np.all(out.columns["num"] >= 1)
+
+
+def test_exec_nullable_bool_predicate():
+    """Object-dtype nullable bool columns (JSON rows with missing fields)
+    must evaluate in predicates: None -> not matched, not a crash."""
+    from arroyo_tpu.sql.schema_provider import SchemaProvider
+    from arroyo_tpu.sql.planner import Planner
+    from arroyo_tpu.engine.engine import LocalRunner
+    from arroyo_tpu.connectors.memory import clear_sink, sink_output
+
+    provider = SchemaProvider()
+    n = 9
+    ts = np.arange(n, dtype=np.int64) * SEC
+    flag = np.array([True, False, None, True, None, False, True, True,
+                     None], dtype=object)
+    provider.add_memory_table("flags", {"flag": "b", "v": "i"}, [
+        Batch(ts, {"flag": flag,
+                   "v": np.arange(n, dtype=np.int64)})])
+    clear_sink("results")
+    prog = Planner(provider).plan(
+        "SELECT v FROM flags WHERE flag = TRUE")
+    LocalRunner(prog).run()
+    out = Batch.concat(sink_output("results"))
+    assert sorted(out.columns["v"].tolist()) == [0, 3, 6, 7]

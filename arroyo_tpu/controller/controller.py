@@ -271,6 +271,14 @@ class ControllerServer:
             state = job.fsm.state
             if state.terminal:
                 return
+            # task failure -> recovery; checked BEFORE the all-finished
+            # check so a failed task draining downstream (end_of_data on
+            # failure) can't race the job into FINISHED with partial output
+            if state == JobState.RUNNING and job.failure is not None:
+                err = job.failure
+                job.failure = None
+                await self._recover(job, err)
+                continue
             # all workers finished?
             if job.workers and all(w.finished for w in job.workers.values()):
                 if state == JobState.RUNNING:
@@ -280,12 +288,6 @@ class ControllerServer:
                     job.fsm.transition(JobState.STOPPED)
                 return
             if state != JobState.RUNNING:
-                continue
-            # task failure -> recovery
-            if job.failure is not None:
-                err = job.failure
-                job.failure = None
-                await self._recover(job, err)
                 continue
             # heartbeat timeout (30s)
             now = time.monotonic()
@@ -324,11 +326,38 @@ class ControllerServer:
     async def _trigger_checkpoint(self, job: Job,
                                   then_stop: bool = False) -> None:
         job.epoch += 1
+        # incomplete epochs that missed a worker can never finish; prune
+        # them so trackers don't accumulate over a long-running job
+        for e in [e for e in job.trackers
+                  if e <= job.epoch - 8 and not job.trackers[e].done]:
+            del job.trackers[e]
         job.trackers[job.epoch] = CheckpointTracker(job.epoch, job.n_subtasks)
-        await self._broadcast_workers(job, "Checkpoint", {
+        payload = {
             "job_id": job.job_id, "epoch": job.epoch,
             "min_epoch": job.min_epoch, "timestamp": now_micros(),
-            "then_stop": then_stop, "is_commit": False})
+            "then_stop": then_stop, "is_commit": False}
+        if not then_stop:
+            # a worker stalled in a long jit compile must not fail the
+            # driver: a periodic epoch that can't reach every worker simply
+            # never completes and a later one supersedes it; heartbeat
+            # timeout catches real deaths
+            await self._broadcast_workers(job, "Checkpoint", payload,
+                                          ignore_errors=True)
+            return
+        try:
+            await self._broadcast_workers(job, "Checkpoint", payload)
+        except Exception as e:
+            # a stop-checkpoint that can't reach every worker must still
+            # stop the job: fall back to a plain graceful stop (the final
+            # state is simply not snapshotted, as with stop(checkpoint
+            # =False))
+            logger.warning(
+                "job %s stop-checkpoint broadcast failed (%s); falling "
+                "back to graceful stop", job.job_id, e)
+            await self._broadcast_workers(
+                job, "StopExecution",
+                {"job_id": job.job_id, "stop_mode": "graceful"},
+                ignore_errors=True)
 
     async def _broadcast_workers(self, job: Job, method: str, payload: Dict,
                                  ignore_errors: bool = False) -> None:

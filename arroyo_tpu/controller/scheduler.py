@@ -78,6 +78,9 @@ class ProcessScheduler(Scheduler):
 
     async def start_workers(self, job_id, controller_addr, n_workers,
                             slots_per_worker):
+        # workers must import this package regardless of their cwd
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
         procs = []
         for _ in range(n_workers):
             env = dict(os.environ)
@@ -86,7 +89,14 @@ class ProcessScheduler(Scheduler):
                 "JOB_ID": job_id,
                 "TASK_SLOTS": str(slots_per_worker),
                 "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+                "PYTHONPATH": (pkg_root + os.pathsep + env["PYTHONPATH"]
+                               if env.get("PYTHONPATH") else pkg_root),
             })
+            if env["JAX_PLATFORMS"] == "cpu":
+                # a CPU worker must not wake the axon TPU-tunnel plugin
+                # (its sitecustomize runs at interpreter start and can
+                # stall the process on tunnel handshakes)
+                env.pop("PALLAS_AXON_POOL_IPS", None)
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "arroyo_tpu.worker.server"], env=env))
         self._procs[job_id] = self._procs.get(job_id, []) + procs

@@ -101,14 +101,17 @@ def coerce_object_col(v: np.ndarray):
     mask None — those stay on the host path.
     """
     mask = np.fromiter((x is not None for x in v), bool, len(v))
-    sample = next((x for x in v if x is not None), None)
-    if sample is None:
+    present = [x for x in v if x is not None]
+    if not present:
         return np.zeros(len(v), dtype=np.float32), mask
-    if isinstance(sample, bool):
+    # type decisions look at every value — mixed-type columns (number in
+    # one row, string in another) must stay on the host path, not crash
+    if all(isinstance(x, bool) for x in present):
         vals = np.fromiter((x if x is not None else False for x in v),
                            bool, len(v))
         return vals, (None if mask.all() else mask)
-    if isinstance(sample, (int, float)):
+    if all(isinstance(x, (int, float)) and not isinstance(x, bool)
+           for x in present):
         vals = np.array([np.nan if x is None else float(x) for x in v],
                         dtype=np.float64)
         return vals, (None if mask.all() else mask)

@@ -63,10 +63,15 @@ def _load() -> Optional[ctypes.CDLL]:
         try:
             os.unlink(_SO)
         except OSError:
-            pass
+            # read-only install: can't replace the corrupt library
+            return None
         if not _build():
             return None
-        lib = ctypes.CDLL(_SO)
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError as e2:
+            logger.warning("native lib unusable, numpy fallbacks: %s", e2)
+            return None
 
     u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
     i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")

@@ -83,37 +83,80 @@ class WorkerServer:
         self._done.set()
 
     async def _heartbeat_loop(self) -> None:
+        """Heartbeats run on a dedicated thread with their own event loop
+        and channel: a worker stalled in a long synchronous jit compile is
+        busy, not dead, and must not trip the controller's 30s timeout
+        (the reference's heartbeat likewise lives on the control thread,
+        arroyo-worker/src/lib.rs:467-476)."""
+        import threading
+
         interval = config().heartbeat_interval_secs
-        while True:
-            await asyncio.sleep(interval)
-            try:
-                await self.controller.call("Heartbeat", {
-                    "worker_id": self.worker_id, "job_id": self.job_id,
-                    "time": now_micros()})
-            except Exception as e:
-                logger.warning("heartbeat failed: %s", e)
+        controller_addr = self.controller_addr
+        worker_id, job_id = self.worker_id, self.job_id
+        stop = threading.Event()
+        self._hb_stop = stop
+
+        def run() -> None:
+            async def beat() -> None:
+                client = RpcClient(controller_addr, "ControllerGrpc")
+                while not stop.is_set():
+                    await asyncio.sleep(interval)
+                    try:
+                        await client.call("Heartbeat", {
+                            "worker_id": worker_id, "job_id": job_id,
+                            "time": now_micros()})
+                    except Exception as e:
+                        logger.warning("heartbeat failed: %s", e)
+                await client.close()
+
+            asyncio.run(beat())
+
+        threading.Thread(target=run, name="heartbeat", daemon=True).start()
+        # keep the asyncio task interface: park until cancelled, then stop
+        # the thread
+        try:
+            await asyncio.Event().wait()
+        finally:
+            stop.set()
 
     # -- WorkerGrpc handlers ----------------------------------------------
 
     async def _start_execution(self, req: Dict) -> Dict:
-        program = pickle.loads(req["program"])
-        assignments = {
-            (t["operator_id"], t["subtask_index"]): t["worker_id"]
-            for t in req["tasks"]}
-        addrs = dict(req.get("worker_data_addrs") or {})
-        for wid, addr in addrs.items():
-            if wid != self.worker_id:
-                await self.network.connect(addr)
-        backend = ParquetBackend.for_url(
-            req.get("checkpoint_url") or config().checkpoint_url)
-        self.engine = Engine(
-            program, self.job_id, backend=backend,
-            restore_epoch=req.get("restore_epoch"),
-            assignments=assignments, my_worker_id=self.worker_id,
-            worker_data_addrs=addrs, network=self.network)
-        self.running = self.engine.start()
-        self._relay_task = asyncio.ensure_future(self._relay_loop())
+        # return immediately: deserializing the program and building the
+        # engine can take seconds (first jax init in a fresh process), and
+        # the controller's RPC deadline must not ride on it — failures are
+        # reported through WorkerError (the reference's StartExecution also
+        # returns before tasks run, arroyo-worker/src/lib.rs:489-545)
+        asyncio.ensure_future(self._start_execution_async(req))
         return {}
+
+    async def _start_execution_async(self, req: Dict) -> None:
+        try:
+            program = pickle.loads(req["program"])
+            assignments = {
+                (t["operator_id"], t["subtask_index"]): t["worker_id"]
+                for t in req["tasks"]}
+            addrs = dict(req.get("worker_data_addrs") or {})
+            for wid, addr in addrs.items():
+                if wid != self.worker_id:
+                    await self.network.connect(addr)
+            backend = ParquetBackend.for_url(
+                req.get("checkpoint_url") or config().checkpoint_url)
+            self.engine = Engine(
+                program, self.job_id, backend=backend,
+                restore_epoch=req.get("restore_epoch"),
+                assignments=assignments, my_worker_id=self.worker_id,
+                worker_data_addrs=addrs, network=self.network)
+            self.running = self.engine.start()
+            self._relay_task = asyncio.ensure_future(self._relay_loop())
+        except Exception as e:
+            logger.error("StartExecution failed: %s", e, exc_info=True)
+            try:
+                await self.controller.call("WorkerError", {
+                    "worker_id": self.worker_id, "job_id": self.job_id,
+                    "error": f"StartExecution failed: {e}"})
+            except Exception:
+                pass
 
     async def _relay_loop(self) -> None:
         """Forward engine ControlResps to the controller (the reference's
@@ -158,8 +201,17 @@ class WorkerServer:
             await self.controller.call("TaskFailed",
                                        base | {"error": resp.error or ""})
 
+    async def _await_started(self, timeout: float = 120.0) -> None:
+        """StartExecution returns before the engine is built; control RPCs
+        that need the running engine park here until it exists."""
+        deadline = asyncio.get_event_loop().time() + timeout
+        while self.running is None:
+            if asyncio.get_event_loop().time() > deadline:
+                raise RuntimeError("engine not started")
+            await asyncio.sleep(0.05)
+
     async def _checkpoint(self, req: Dict) -> Dict:
-        assert self.running is not None
+        await self._await_started()
         barrier = CheckpointBarrier(req["epoch"], req.get("min_epoch", 0),
                                     req.get("timestamp", now_micros()),
                                     req.get("then_stop", False))
@@ -169,7 +221,7 @@ class WorkerServer:
         return {}
 
     async def _commit(self, req: Dict) -> Dict:
-        assert self.running is not None
+        await self._await_started()
         await self.running.commit(req["epoch"])
         return {}
 

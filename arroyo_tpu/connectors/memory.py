@@ -32,6 +32,8 @@ class MemorySource(SourceOperator):
         self.batches: List[Batch] = cfg.get("batches", [])
 
     async def run(self, ctx: Context) -> SourceFinishType:
+        if ctx.task_info.task_index != 0:
+            return SourceFinishType.FINAL  # single-reader source
         runner = getattr(ctx, "_runner", None)
         for b in self.batches:
             await ctx.collect(b)

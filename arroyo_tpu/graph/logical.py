@@ -285,11 +285,16 @@ class EdgeType(Enum):
 
 @dataclass
 class StreamNode:
-    """StreamNode{operator_id, operator, parallelism} (lib.rs:497-502)."""
+    """StreamNode{operator_id, operator, parallelism} (lib.rs:497-502).
+
+    ``max_parallelism`` pins operators whose semantics require a bounded
+    subtask count (e.g. a global TopN merge stage must stay at 1) across
+    rescales."""
 
     operator_id: str
     operator: LogicalOperator
     parallelism: int = 1
+    max_parallelism: Optional[int] = None
 
 
 @dataclass
@@ -398,7 +403,10 @@ class Program:
     def update_parallelism(self, overrides: Dict[str, int]) -> None:
         """Rescaling entry point (states/mod.rs:203-211)."""
         for op_id, p in overrides.items():
-            self.node(op_id).parallelism = p
+            node = self.node(op_id)
+            if node.max_parallelism is not None:
+                p = min(p, node.max_parallelism)
+            node.parallelism = p
 
 
 # ---------------------------------------------------------------------------

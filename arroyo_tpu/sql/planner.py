@@ -180,6 +180,11 @@ def _wrap_predicate(compiled: Compiled) -> Callable:
 class Planned:
     stream: Stream
     schema: Schema
+    # set when this plan ends in [binned window aggregate -> projection]:
+    # the aggregate's node id and the SELECT-name -> internal agg output
+    # mapping, so a following ORDER BY/LIMIT can fuse into the aggregate
+    agg_node: Optional[str] = None
+    agg_map: Optional[Dict[str, str]] = None
 
 
 class Planner:
@@ -652,18 +657,26 @@ class Planner:
 
             passthrough.append(UPDATE_OP_COLUMN)
 
+        agg_tail = stream.tail
+        agg_kind = stream.program.node(agg_tail).operator.kind
         post_fn = _wrap_record(post_compiled, passthrough)
         post_host = any(c.needs_host for _, c in post_compiled)
         pname2 = f"agg_project_{self._next_id()}"
         stream = (stream.udf(post_fn, name=pname2) if post_host
                   else stream.map(post_fn, name=pname2))
-        planned2 = Planned(stream, out_schema)
+        agg_outputs = {a.output for a in aggs}
+        fusable = agg_kind in (OpKind.SLIDING_WINDOW_AGGREGATOR,
+                               OpKind.TUMBLING_WINDOW_AGGREGATOR)
+        planned2 = Planned(
+            stream, out_schema,
+            agg_node=agg_tail if fusable else None,
+            agg_map={name: e.name for name, e in post_items
+                     if isinstance(e, ColumnRef) and e.qualifier is None
+                     and e.name in agg_outputs} if fusable else None)
         if having_rewritten is not None:
-            having_schema = out_schema.clone()
-            for j in range(len(aggs)):
-                having_schema.columns.setdefault(f"__agg{j}", "f")
-            # HAVING may reference agg placeholders not projected; re-project
-            # them through by compiling against mid_schema on the agg output
+            # HAVING compiles against the projected schema: predicates may
+            # only reference selected outputs (aggregates referenced in
+            # HAVING but not in SELECT are unsupported)
             planned2 = self._filter(planned2, having_rewritten, "having")
         return planned2
 
@@ -720,7 +733,18 @@ class Planner:
 
     def _plan_top_n(self, sel: Select, planned: Planned) -> Planned:
         """ORDER BY ... LIMIT n over a windowed stream -> per-window TopN
-        (the reference's window-TopN rewrite, optimizations.rs:293-501)."""
+        (the reference's window-TopN rewrite, optimizations.rs:293-501).
+
+        When the input is directly a binned window aggregate, the TopN
+        fuses INTO the aggregate (SlidingAggregatingTopN,
+        sliding_top_n_aggregating_window.rs): each pane emission keeps
+        only the top rows instead of materializing every (key, pane)
+        aggregate downstream.  A parallel aggregate keeps a parallelism-1
+        global TopN stage after the fused local one (two-phase TopN).
+        """
+        from ..graph.logical import (SlidingAggregatingTopNSpec,
+                                     TopNSpec)
+
         if not planned.schema.window:
             raise SqlPlanError(
                 "ORDER BY/LIMIT requires a windowed input in streaming SQL")
@@ -730,13 +754,43 @@ class Planner:
         col = item.expr.name.lower()
         if not item.desc:
             raise SqlPlanError("streaming TopN requires ORDER BY ... DESC")
-        # partition per window instance: handled inside TopN by window_end
-        stream = planned.stream._chain(LogicalOperator(
+
+        stream = planned.stream
+        node = None
+        sort_col = None
+        tail_node = stream.program.node(stream.tail)
+        tail_spec = tail_node.operator.spec
+        if (tail_node.operator.kind in (OpKind.SLIDING_WINDOW_AGGREGATOR,
+                                        OpKind.TUMBLING_WINDOW_AGGREGATOR)
+                and col in {a.output for a in tail_spec.aggs}):
+            node, sort_col = tail_node, col  # direct Stream-API shape
+        elif (planned.agg_node is not None
+              and planned.agg_map is not None and col in planned.agg_map):
+            # SQL shape: [bin agg -> projection]; fuse through the
+            # projection using the internal agg output name
+            node = stream.program.node(planned.agg_node)
+            sort_col = planned.agg_map[col]
+        if node is not None:
+            spec = node.operator.spec
+            slide = getattr(spec, "slide_micros", spec.width_micros)
+            node.operator.kind = OpKind.SLIDING_AGGREGATING_TOP_N
+            node.operator.spec = SlidingAggregatingTopNSpec(
+                width_micros=spec.width_micros, slide_micros=slide,
+                aggs=spec.aggs, partition_cols=(), sort_column=sort_col,
+                max_elements=sel.limit, projection=spec.projection)
+            # local (per key range) top-N pruning done; the global merge
+            # stage below is always kept — the aggregate's parallelism can
+            # change after planning (rescale), so correctness must not
+            # depend on it being 1 at plan time
+
+        # global per-window-instance TopN: a single merging subtask
+        # (pinned across rescales) partitioned by window_end inside TopN
+        stream = stream._chain(LogicalOperator(
             OpKind.TUMBLING_TOP_N, f"topn_{self._next_id()}",
-            spec=__import__(
-                "arroyo_tpu.graph.logical", fromlist=["TopNSpec"]
-            ).TopNSpec(width_micros=1, max_elements=sel.limit,
-                       sort_column=col, partition_cols=())))
+            spec=TopNSpec(width_micros=1, max_elements=sel.limit,
+                          sort_column=col, partition_cols=())),
+            parallelism=1)
+        stream.program.node(stream.tail).max_parallelism = 1
         return Planned(stream, planned.schema)
 
     # -- joins -------------------------------------------------------------

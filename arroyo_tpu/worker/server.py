@@ -100,13 +100,20 @@ class WorkerServer:
             async def beat() -> None:
                 client = RpcClient(controller_addr, "ControllerGrpc")
                 while not stop.is_set():
-                    await asyncio.sleep(interval)
+                    # chunked sleep: exit promptly on shutdown
+                    slept = 0.0
+                    while slept < interval and not stop.is_set():
+                        await asyncio.sleep(0.2)
+                        slept += 0.2
+                    if stop.is_set():
+                        break
                     try:
                         await client.call("Heartbeat", {
                             "worker_id": worker_id, "job_id": job_id,
                             "time": now_micros()})
                     except Exception as e:
-                        logger.warning("heartbeat failed: %s", e)
+                        if not stop.is_set():
+                            logger.warning("heartbeat failed: %s", e)
                 await client.close()
 
             asyncio.run(beat())

@@ -263,3 +263,119 @@ def test_exec_nullable_bool_predicate():
     LocalRunner(prog).run()
     out = Batch.concat(sink_output("results"))
     assert sorted(out.columns["v"].tolist()) == [0, 3, 6, 7]
+
+
+def test_topn_fuses_into_sliding_aggregate():
+    """ORDER BY agg DESC LIMIT n over a hop aggregate plans as the fused
+    SlidingAggregatingTopN (optimizations.rs:293-501 analog)."""
+    from arroyo_tpu.graph.logical import OpKind
+    from arroyo_tpu.sql import plan_sql
+
+    sql = """
+    CREATE TABLE nexmark WITH (connector = 'nexmark', event_rate = '1000',
+      num_events = '1000', rate_limited = 'false', batch_size = '256');
+    SELECT bid.auction as auction,
+           HOP(INTERVAL '2' SECOND, INTERVAL '10' SECOND) as window,
+           count(*) AS num
+    FROM nexmark WHERE bid is not null
+    GROUP BY 1, 2 ORDER BY num DESC LIMIT 5
+    """
+    prog = plan_sql(sql)
+    kinds = [n.operator.kind for n in prog.nodes()]
+    assert OpKind.SLIDING_AGGREGATING_TOP_N in kinds
+    assert OpKind.SLIDING_WINDOW_AGGREGATOR not in kinds
+    # the global merge stage is always present, pinned to one subtask
+    # (stays correct across rescales)
+    topn = [n for n in prog.nodes()
+            if n.operator.kind == OpKind.TUMBLING_TOP_N]
+    assert len(topn) == 1
+    assert topn[0].parallelism == 1 and topn[0].max_parallelism == 1
+    prog.update_parallelism({topn[0].operator_id: 4})
+    assert prog.node(topn[0].operator_id).parallelism == 1  # pinned
+
+
+def test_exec_fused_topn_hot_items():
+    """Fused sliding TopN emits the same hot items as a full aggregate
+    followed by host-side ranking."""
+    from arroyo_tpu.sql.schema_provider import SchemaProvider
+    from arroyo_tpu.sql.planner import Planner
+    from arroyo_tpu.engine.engine import LocalRunner
+    from arroyo_tpu.connectors.memory import clear_sink, sink_output
+
+    rng = np.random.default_rng(21)
+    n = 3000
+    ts = np.sort(rng.integers(0, 6 * SEC, n)).astype(np.int64)
+    # zipf-ish hot keys
+    keys = (rng.zipf(1.5, n) % 50).astype(np.int64)
+
+    def run(sql):
+        provider = SchemaProvider()
+        provider.add_memory_table("events", {"k": "i"}, [
+            Batch(ts, {"k": keys.copy()})])
+        clear_sink("results")
+        LocalRunner(Planner(provider).plan(sql)).run()
+        outs = sink_output("results")
+        return Batch.concat(outs) if outs else None
+
+    fused = run("""
+        SELECT k, TUMBLE(INTERVAL '2' SECOND) as window, count(*) as num
+        FROM events GROUP BY 1, 2 ORDER BY num DESC LIMIT 3
+    """)
+    full = run("""
+        SELECT k, TUMBLE(INTERVAL '2' SECOND) as window, count(*) as num
+        FROM events GROUP BY 1, 2
+    """)
+    assert fused is not None and full is not None
+    # host-side expected top3 per window from the full aggregate
+    import collections
+    per_window = collections.defaultdict(list)
+    for i in range(len(full)):
+        per_window[int(full.columns["window_end"][i])].append(
+            (int(full.columns["num"][i]), int(full.columns["k"][i])))
+    got = collections.defaultdict(list)
+    for i in range(len(fused)):
+        got[int(fused.columns["window_end"][i])].append(
+            (int(fused.columns["num"][i]), int(fused.columns["k"][i])))
+    assert set(got) == set(per_window)
+    for w, pairs in per_window.items():
+        want_counts = sorted((c for c, _ in pairs), reverse=True)[:3]
+        got_counts = sorted((c for c, _ in got[w]), reverse=True)
+        assert got_counts == want_counts, (w, got_counts, want_counts)
+
+
+def test_exec_fused_topn_parallel_global_merge():
+    """With a parallel aggregate, per-subtask local TopN prunes and the
+    pinned global stage merges to exactly LIMIT rows per window."""
+    from arroyo_tpu.sql.schema_provider import SchemaProvider
+    from arroyo_tpu.sql.planner import Planner
+    from arroyo_tpu.engine.engine import LocalRunner
+    from arroyo_tpu.connectors.memory import clear_sink, sink_output
+    import collections
+
+    rng = np.random.default_rng(33)
+    n = 4000
+    ts = np.sort(rng.integers(0, 4 * SEC, n)).astype(np.int64)
+    keys = (rng.zipf(1.4, n) % 40).astype(np.int64)
+    provider = SchemaProvider()
+    provider.add_memory_table("events", {"k": "i"}, [
+        Batch(ts, {"k": keys})])
+    clear_sink("results")
+    prog = Planner(provider).plan("""
+        SELECT k, TUMBLE(INTERVAL '2' SECOND) as window, count(*) as num
+        FROM events GROUP BY 1, 2 ORDER BY num DESC LIMIT 3
+    """, query_parallelism=2)
+    LocalRunner(prog).run()
+    out = Batch.concat(sink_output("results"))
+    per_w = collections.Counter(int(w) for w in out.columns["window_end"])
+    assert per_w and all(v <= 3 for v in per_w.values()), per_w
+    # the true global top-3 counts per window must be what survived
+    want = collections.defaultdict(collections.Counter)
+    for t, k in zip(ts.tolist(), keys.tolist()):
+        wend = (t // (2 * SEC) + 1) * 2 * SEC
+        want[wend][k] += 1
+    for wend, cnt in per_w.items():
+        top = sorted(want[wend].values(), reverse=True)[:3]
+        got = sorted((int(v) for w2, v in zip(
+            out.columns["window_end"], out.columns["num"])
+            if int(w2) == wend), reverse=True)
+        assert got == top, (wend, got, top)

@@ -437,8 +437,14 @@ class TumblingTopNOperator(Operator):
         rows = self.buffer.query_range(start, end)
         if rows is not None and len(rows):
             out_cols = dict(rows.columns)
-            out_cols["window_start"] = np.full(len(rows), start, np.int64)
-            out_cols["window_end"] = np.full(len(rows), end, np.int64)
+            # rows that already carry window columns (a global TopN merge
+            # over upstream windowed aggregates) keep them: this stage's
+            # 1us buckets are an implementation detail, not the window
+            if "window_start" not in out_cols:
+                out_cols["window_start"] = np.full(len(rows), start,
+                                                   np.int64)
+            if "window_end" not in out_cols:
+                out_cols["window_end"] = np.full(len(rows), end, np.int64)
             out = Batch(np.full(len(rows), end - 1, np.int64), out_cols,
                         rows.key_hash, rows.key_cols)
             out = _apply_top_n(out, self.partition_cols, self.sort_column,

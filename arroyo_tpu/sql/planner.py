@@ -28,7 +28,9 @@ from ..graph.logical import (
     Program,
     SessionWindow,
     SlidingWindow,
+    SlidingAggregatingTopNSpec,
     Stream,
+    TopNSpec,
     TumblingWindow,
 )
 from .ast_nodes import (
@@ -742,12 +744,12 @@ class Planner:
         aggregate downstream.  A parallel aggregate keeps a parallelism-1
         global TopN stage after the fused local one (two-phase TopN).
         """
-        from ..graph.logical import (SlidingAggregatingTopNSpec,
-                                     TopNSpec)
-
         if not planned.schema.window:
             raise SqlPlanError(
                 "ORDER BY/LIMIT requires a windowed input in streaming SQL")
+        if len(sel.order_by) > 1:
+            raise SqlPlanError(
+                "streaming TopN supports a single ORDER BY column")
         item = sel.order_by[0]
         if not isinstance(item.expr, ColumnRef):
             raise SqlPlanError("ORDER BY expression must be a column")

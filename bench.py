@@ -1,7 +1,14 @@
-"""Benchmark: Nexmark q5 (hot items — sliding-window count + windowed max
-join) end-to-end through the SQL-planned engine on the available accelerator.
+"""Nexmark benchmark suite over the SQL-planned engine on the available
+accelerator (BASELINE.md configs):
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+  q1  stateless currency-conversion map over bids
+  q5  hot items: sliding-window count + windowed max join   [headline]
+  q7  highest bid: tumbling global max joined back to bids
+  q8  monitor new users: persons joined to their auctions per window
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} for the
+query named by BENCH_QUERY (default q5, the headline the driver records).
+BENCH_ALL=1 runs every query, printing non-headline results to stderr.
 
 Baseline: the reference publishes no numbers (BASELINE.md) — its README
 claims "millions of events per second", so vs_baseline normalizes to 1M
@@ -16,12 +23,20 @@ import time
 NUM_EVENTS = int(os.environ.get("BENCH_EVENTS", 2_000_000))
 BATCH = int(os.environ.get("BENCH_BATCH", 65536))
 
-
-Q5 = """
+SRC = """
 CREATE TABLE nexmark WITH (
   connector = 'nexmark', event_rate = '1000000',
   num_events = '{n}', rate_limited = 'false', batch_size = '{b}'
 );
+"""
+
+Q1 = SRC + """
+SELECT bid.auction as auction, bid.bidder as bidder,
+       bid.price * 0.908 as price_dol, bid.datetime as datetime
+FROM nexmark WHERE bid is not null
+"""
+
+Q5 = SRC + """
 WITH bids as (SELECT bid.auction as auction, bid.datetime as datetime
     FROM nexmark where bid is not null)
 SELECT AuctionBids.auction as auction, AuctionBids.num as num
@@ -42,18 +57,50 @@ JOIN (
 ON AuctionBids.num = MaxBids.maxn and AuctionBids.window = MaxBids.window
 """
 
+Q7 = SRC + """
+WITH bids as (SELECT bid.auction as auction, bid.price as price,
+                     bid.bidder as bidder
+    FROM nexmark where bid is not null)
+SELECT B.auction as auction, B.price as price, B.bidder as bidder
+FROM (
+  SELECT auction, price, bidder, TUMBLE(INTERVAL '10' SECOND) as window,
+         count(*) as c
+  FROM bids GROUP BY 1, 2, 3, 4
+) AS B
+JOIN (
+  SELECT max(price) AS maxprice, TUMBLE(INTERVAL '10' SECOND) as window
+  FROM bids GROUP BY 2
+) AS M
+ON B.price = M.maxprice and B.window = M.window
+"""
 
-def main() -> None:
+Q8 = SRC + """
+SELECT P.id as id, P.np as np, A.na as na
+FROM (
+  SELECT person.id as id, TUMBLE(INTERVAL '10' SECOND) as window,
+         count(*) as np
+  FROM nexmark WHERE person is not null GROUP BY 1, 2
+) AS P
+JOIN (
+  SELECT auction.seller as seller, TUMBLE(INTERVAL '10' SECOND) as window,
+         count(*) as na
+  FROM nexmark WHERE auction is not null GROUP BY 1, 2
+) AS A
+ON P.id = A.seller and P.window = A.window
+"""
+
+QUERIES = {"q1": Q1, "q5": Q5, "q7": Q7, "q8": Q8}
+
+
+def run_query(name: str, sql_template: str) -> dict:
     from arroyo_tpu.connectors.memory import clear_sink, sink_output
     from arroyo_tpu.engine.engine import LocalRunner
     from arroyo_tpu.sql import plan_sql
 
-    os.environ.setdefault("BATCH_SIZE", str(BATCH))
-
-    sql = Q5.format(n=NUM_EVENTS, b=BATCH)
+    sql = sql_template.format(n=NUM_EVENTS, b=BATCH)
     # warmup: compile all kernels on a small stream
     clear_sink("results")
-    LocalRunner(plan_sql(sql.replace(str(NUM_EVENTS), "100000", 1))).run()
+    LocalRunner(plan_sql(sql_template.format(n=100_000, b=BATCH))).run()
 
     clear_sink("results")
     prog = plan_sql(sql)
@@ -62,15 +109,40 @@ def main() -> None:
     dt = time.perf_counter() - t0
     outs = sink_output("results")
     n_out = sum(len(b) for b in outs)
-    assert n_out > 0, "q5 produced no output"
+    assert n_out > 0, f"{name} produced no output"
 
     eps = NUM_EVENTS / dt
-    print(json.dumps({
-        "metric": "nexmark_q5_events_per_sec",
+    return {
+        "metric": f"nexmark_{name}_events_per_sec",
         "value": round(eps, 1),
         "unit": "events/sec",
         "vs_baseline": round(eps / 1_000_000.0, 3),
-    }))
+    }
+
+
+def main() -> None:
+    os.environ.setdefault("BATCH_SIZE", str(BATCH))
+    # initialize the jax backend before any asyncio loop runs: the axon
+    # TPU-tunnel plugin's device discovery can deadlock when first
+    # triggered from inside a running event loop
+    import jax
+
+    print(f"backend: {jax.default_backend()} "
+          f"({len(jax.devices())} devices)", file=sys.stderr)
+    headline = os.environ.get("BENCH_QUERY", "q5")
+    if headline not in QUERIES:
+        raise SystemExit(f"unknown BENCH_QUERY {headline!r}; "
+                         f"choose from {sorted(QUERIES)}")
+    if os.environ.get("BENCH_ALL"):
+        for name in sorted(QUERIES):
+            result = run_query(name, QUERIES[name])
+            if name == headline:
+                headline_result = result
+            else:
+                print(json.dumps(result), file=sys.stderr)
+        print(json.dumps(headline_result))
+    else:
+        print(json.dumps(run_query(headline, QUERIES[headline])))
 
 
 if __name__ == "__main__":

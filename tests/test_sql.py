@@ -368,6 +368,9 @@ def test_exec_fused_topn_parallel_global_merge():
     out = Batch.concat(sink_output("results"))
     per_w = collections.Counter(int(w) for w in out.columns["window_end"])
     assert per_w and all(v <= 3 for v in per_w.values()), per_w
+    # window columns survive the global merge stage intact
+    np.testing.assert_array_equal(
+        out.columns["window_end"] - out.columns["window_start"], 2 * SEC)
     # the true global top-3 counts per window must be what survived
     want = collections.defaultdict(collections.Counter)
     for t, k in zip(ts.tolist(), keys.tolist()):

@@ -1,0 +1,173 @@
+"""Minimal web console served by the API (the arroyo-console analog).
+
+The reference ships a React/Vite SPA (arroyo-console/) talking to the REST
+API; this is a single-file, dependency-free page with the same core
+workflow: write SQL, validate (pipeline DAG preview), create, watch job
+state, tail output over SSE, and inspect per-operator metrics.
+"""
+
+CONSOLE_HTML = """<!doctype html>
+<html>
+<head>
+<meta charset="utf-8">
+<title>arroyo_tpu console</title>
+<style>
+  :root { --bg:#101418; --panel:#1a2027; --text:#d6dde5; --accent:#4aa3ff;
+          --ok:#3fb68b; --bad:#e5604c; --dim:#7a8794; }
+  * { box-sizing: border-box; }
+  body { margin:0; background:var(--bg); color:var(--text);
+         font:14px/1.5 system-ui, sans-serif; }
+  header { padding:10px 20px; background:var(--panel);
+           border-bottom:1px solid #2a323c; display:flex; gap:12px;
+           align-items:baseline; }
+  header h1 { font-size:16px; margin:0; }
+  header span { color:var(--dim); font-size:12px; }
+  main { display:grid; grid-template-columns: 1fr 1fr; gap:16px;
+         padding:16px 20px; }
+  section { background:var(--panel); border:1px solid #2a323c;
+            border-radius:8px; padding:14px; }
+  h2 { font-size:13px; margin:0 0 10px; color:var(--dim);
+       text-transform:uppercase; letter-spacing:.06em; }
+  textarea { width:100%; height:180px; background:#0c1014; color:var(--text);
+             border:1px solid #2a323c; border-radius:6px; padding:10px;
+             font:13px/1.45 ui-monospace, monospace; resize:vertical; }
+  button { background:var(--accent); color:#fff; border:0; border-radius:6px;
+           padding:7px 14px; margin:8px 8px 0 0; cursor:pointer;
+           font-weight:600; }
+  button.secondary { background:#2a323c; }
+  table { width:100%; border-collapse:collapse; font-size:13px; }
+  th, td { text-align:left; padding:5px 8px;
+           border-bottom:1px solid #2a323c; }
+  th { color:var(--dim); font-weight:500; }
+  .state-Running { color:var(--accent); }
+  .state-Finished, .state-Stopped { color:var(--ok); }
+  .state-Failed { color:var(--bad); }
+  pre { background:#0c1014; border:1px solid #2a323c; border-radius:6px;
+        padding:10px; max-height:260px; overflow:auto; font-size:12px;
+        white-space:pre-wrap; }
+  #dag { color:var(--dim); font-size:12px; }
+  .err { color:var(--bad); }
+</style>
+</head>
+<body>
+<header><h1>arroyo_tpu</h1><span>streaming console</span></header>
+<main>
+  <section style="grid-column: 1 / 3">
+    <h2>New pipeline</h2>
+    <input id="plname" placeholder="pipeline name" value="pipeline"
+           style="width:240px;background:#0c1014;color:var(--text);
+                  border:1px solid #2a323c;border-radius:6px;
+                  padding:7px 10px;margin-bottom:8px">
+    <textarea id="sql">CREATE TABLE impulse WITH (connector = 'impulse',
+  event_rate = '1000', message_count = '10000', batch_size = '256');
+SELECT counter, counter * 2 as doubled FROM impulse
+WHERE counter % 2 = 0</textarea>
+    <div>
+      <button onclick="validateSql()">Validate</button>
+      <button onclick="createPipeline()">Create &amp; run</button>
+    </div>
+    <div id="dag"></div>
+  </section>
+  <section>
+    <h2>Pipelines</h2>
+    <table><thead><tr><th>name</th><th>job</th><th>state</th><th>epoch</th>
+    <th></th></tr></thead><tbody id="plrows"></tbody></table>
+  </section>
+  <section>
+    <h2>Output <span id="tailinfo"></span></h2>
+    <pre id="output">select a job's "tail" to stream results…</pre>
+  </section>
+  <section style="grid-column: 1 / 3">
+    <h2>Operator metrics</h2>
+    <pre id="metrics">—</pre>
+  </section>
+</main>
+<script>
+const $ = (id) => document.getElementById(id);
+const esc = (x) => String(x).replace(/[&<>"']/g, (c) => ({
+  '&':'&amp;', '<':'&lt;', '>':'&gt;', '"':'&quot;', "'":'&#39;'}[c]));
+let tailAbort = null;
+
+async function validateSql() {
+  const r = await fetch('/v1/pipelines/validate', {method:'POST',
+    headers:{'content-type':'application/json'},
+    body: JSON.stringify({query: $('sql').value})});
+  const j = await r.json();
+  $('dag').innerHTML = r.ok
+    ? 'DAG: ' + j.graph.nodes.map(n =>
+        `${n.operator_id}[${n.parallelism}]`).join(' → ')
+    : `<span class="err">${esc(j.error)}</span>`;
+}
+
+async function createPipeline() {
+  const r = await fetch('/v1/pipelines', {method:'POST',
+    headers:{'content-type':'application/json'},
+    body: JSON.stringify({name: $('plname').value, query: $('sql').value})});
+  const j = await r.json();
+  $('dag').innerHTML = r.ok ? `created ${esc(j.id)}`
+    : `<span class="err">${esc(j.error)}</span>`;
+  refresh();
+}
+
+async function refresh() {
+  const r = await fetch('/v1/pipelines');
+  const j = await r.json();
+  $('plrows').innerHTML = j.data.flatMap(p => p.jobs.map(job => `
+    <tr><td>${esc(p.name)}</td><td>${esc(job.id)}</td>
+    <td class="state-${esc(job.state)}">${esc(job.state)}</td>
+    <td>${job.checkpoint_epoch ?? '—'}</td>
+    <td><a href="#" onclick="tail('${p.id}','${job.id}');return false">tail</a>
+        <a href="#" onclick="showMetrics('${p.id}','${job.id}');return false">metrics</a>
+        <a href="#" onclick="stopPipeline('${p.id}');return false">stop</a></td>
+    </tr>`)).join('');
+}
+
+async function stopPipeline(pid) {
+  await fetch('/v1/pipelines/' + pid, {method:'PATCH',
+    headers:{'content-type':'application/json'},
+    body: JSON.stringify({stop: 'checkpoint'})});
+  refresh();
+}
+
+async function tail(pid, jid) {
+  if (tailAbort) tailAbort.abort();
+  tailAbort = new AbortController();
+  $('output').textContent = '';
+  $('tailinfo').textContent = `(${jid})`;
+  const resp = await fetch(`/v1/pipelines/${pid}/jobs/${jid}/output`,
+                           {signal: tailAbort.signal});
+  const reader = resp.body.getReader();
+  const dec = new TextDecoder();
+  let buf = '';
+  for (;;) {
+    const {done, value} = await reader.read();
+    if (done) break;
+    buf += dec.decode(value, {stream: true});
+    let i;
+    while ((i = buf.indexOf('\\n\\n')) >= 0) {
+      const line = buf.slice(0, i); buf = buf.slice(i + 2);
+      if (!line.startsWith('data: ')) continue;
+      const ev = JSON.parse(line.slice(6));
+      for (const row of ev.rows || [])
+        $('output').textContent += JSON.stringify(row) + '\\n';
+      if (ev.done) $('output').textContent += '— end of stream —\\n';
+      $('output').scrollTop = $('output').scrollHeight;
+    }
+  }
+}
+
+async function showMetrics(pid, jid) {
+  const r = await fetch(
+    `/v1/pipelines/${pid}/jobs/${jid}/operator_metric_groups`);
+  const j = await r.json();
+  $('metrics').textContent = j.data.map(g =>
+    g.operator_id + '\\n' + Object.entries(g.metrics).map(
+      ([k, v]) => `  ${k} = ${v}`).join('\\n')).join('\\n') || '—';
+}
+
+refresh();
+setInterval(refresh, 2000);
+</script>
+</body>
+</html>
+"""

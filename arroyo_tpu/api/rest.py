@@ -115,6 +115,14 @@ class ApiServer:
         async def ping(req: Request):
             return {"pong": True}
 
+        @r.get("/")
+        async def console(req: Request):
+            from .console import CONSOLE_HTML
+            from .http import Response
+
+            return Response(body=CONSOLE_HTML.encode(),
+                            content_type="text/html; charset=utf-8")
+
         # ---- pipelines (pipelines.rs:316-700) ----
 
         @r.post("/v1/pipelines/validate")

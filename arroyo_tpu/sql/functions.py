@@ -311,8 +311,11 @@ def _sha256(args):
                  if s is not None else None for s in v]), m
 
 
-@host_fn("get_json_objects")
-def _get_json_objects(args):
+
+def _json_path_walk(args, convert):
+    """Shared $.a.b path walk over a JSON string column; per-row null on
+    parse/path errors.  ``convert`` maps the matched object to the output
+    value (json.rs/jsonpath semantics)."""
     import json as _json
 
     v, m = args[0]
@@ -323,13 +326,21 @@ def _get_json_objects(args):
         try:
             obj = _json.loads(s)
             for k in keys:
-                obj = obj[k]
-            out.append(_json.dumps(obj) if isinstance(obj, (dict, list))
-                       else obj)
+                obj = obj[k] if not isinstance(obj, list) else obj[0][k]
+            out.append(convert(obj))
         except Exception:
             out.append(None)
     mask = np.array([o is not None for o in out])
     return _obj(out), mask if m is None else (m & mask)
+
+
+@host_fn("get_json_objects")
+def _get_json_objects(args):
+    import json as _json
+
+    return _json_path_walk(
+        args, lambda o: _json.dumps(o) if isinstance(o, (dict, list))
+        else o)
 
 
 @host_fn("hash")
@@ -338,3 +349,174 @@ def _hash(args):
 
     (v, m), = args
     return hash_any_column(np.asarray(v)).astype(np.int64), m
+
+
+# -- string parity additions (strings.rs full inventory) ---------------------
+
+def _map_str(v, f):
+    return _obj([f(s) if s is not None else None for s in v])
+
+
+@host_fn("ascii")
+def _ascii(args):
+    (v, m), = args
+    return np.array([ord(s[0]) if s else 0 for s in v], dtype=np.int64), m
+
+
+@host_fn("chr")
+def _chr(args):
+    (v, m), = args
+    out, ok = [], []
+    for x in np.asarray(v).reshape(-1):
+        # per-row null on invalid codepoints, never a batch abort
+        if x is None or not (0 <= int(x) <= 0x10FFFF):
+            out.append(None)
+            ok.append(False)
+        else:
+            out.append(chr(int(x)))
+            ok.append(True)
+    okm = np.asarray(ok)
+    return _obj(out), okm if m is None else (m & okm)
+
+
+@host_fn("initcap")
+def _initcap(args):
+    import re as _re
+
+    (v, m), = args
+
+    def cap(s: str) -> str:
+        # SQL initcap: words are alphanumeric runs (unlike str.title,
+        # which also breaks on digits and apostrophes)
+        return _re.sub(r"[A-Za-z0-9]+",
+                       lambda mt: mt.group(0)[0].upper()
+                       + mt.group(0)[1:].lower(), s)
+
+    return _map_str(v, cap), m
+
+
+@host_fn("left")
+def _left(args):
+    v, m = args[0]
+    n = np.broadcast_to(np.asarray(args[1][0]).astype(int), (len(v),))
+    return _obj([s[:k] if s is not None else None
+                 for s, k in zip(v, n)]), m
+
+
+@host_fn("right")
+def _right(args):
+    v, m = args[0]
+    n = np.broadcast_to(np.asarray(args[1][0]).astype(int), (len(v),))
+
+    def take(s, k):
+        if k > 0:
+            return s[-k:] if k < len(s) else s
+        return s[-k:]  # negative: all but the first |k| chars (Postgres)
+
+    return _obj([take(s, k) if s is not None else None
+                 for s, k in zip(v, n)]), m
+
+
+@host_fn("lpad")
+def _lpad(args):
+    v, m = args[0]
+    n = np.broadcast_to(np.asarray(args[1][0]).astype(int), (len(v),))
+    fill = str(np.asarray(args[2][0]).reshape(-1)[0]) if len(args) > 2 \
+        else " "
+    out = []
+    for s, k in zip(v, n):
+        if s is None:
+            out.append(None)
+        elif k <= 0:
+            out.append("")  # Postgres: non-positive length pads to empty
+        elif len(s) >= k:
+            out.append(s[:k])
+        else:
+            pad = (fill * k)[:k - len(s)]
+            out.append(pad + s)
+    return _obj(out), m
+
+
+@host_fn("rpad")
+def _rpad(args):
+    v, m = args[0]
+    n = np.broadcast_to(np.asarray(args[1][0]).astype(int), (len(v),))
+    fill = str(np.asarray(args[2][0]).reshape(-1)[0]) if len(args) > 2 \
+        else " "
+    out = []
+    for s, k in zip(v, n):
+        if s is None:
+            out.append(None)
+        elif k <= 0:
+            out.append("")  # Postgres: non-positive length pads to empty
+        elif len(s) >= k:
+            out.append(s[:k])
+        else:
+            pad = (fill * k)[:k - len(s)]
+            out.append(s + pad)
+    return _obj(out), m
+
+
+@host_fn("octet_length")
+def _octet_length(args):
+    (v, m), = args
+    return np.array([len(str(s).encode()) if s is not None else 0
+                     for s in v], dtype=np.int64), m
+
+
+@host_fn("bit_length")
+def _bit_length(args):
+    (v, m), = args
+    return np.array([len(str(s).encode()) * 8 if s is not None else 0
+                     for s in v], dtype=np.int64), m
+
+
+@host_fn("strpos")
+def _strpos(args):
+    v, m = args[0]
+    needle = str(np.asarray(args[1][0]).reshape(-1)[0])
+    return np.array([(s.find(needle) + 1) if s is not None else 0
+                     for s in v], dtype=np.int64), m
+
+
+@host_fn("translate")
+def _translate(args):
+    v, m = args[0]
+    frm = str(np.asarray(args[1][0]).reshape(-1)[0])
+    to = str(np.asarray(args[2][0]).reshape(-1)[0])
+    table = {ord(f): (to[i] if i < len(to) else None)
+             for i, f in enumerate(frm)}
+    return _map_str(v, lambda s: s.translate(table)), m
+
+
+def _sha_fn(algo):
+    def fn(args):
+        (v, m), = args
+        return _obj([getattr(hashlib, algo)(str(s).encode()).hexdigest()
+                     if s is not None else None for s in v]), m
+
+    return fn
+
+
+HOST_FUNCTIONS["sha224"] = _sha_fn("sha224")
+HOST_FUNCTIONS["sha384"] = _sha_fn("sha384")
+HOST_FUNCTIONS["sha512"] = _sha_fn("sha512")
+
+
+@host_fn("extract_json_string")
+def _extract_json_string(args):
+    """Like get_json_objects but always stringifies the match
+    (json.rs extract_json_string)."""
+    import json as _json
+
+    return _json_path_walk(
+        args, lambda o: o if isinstance(o, str) else _json.dumps(o))
+
+
+@host_fn("get_first_json_object")
+def _get_first_json_object(args):
+    import json as _json
+
+    return _json_path_walk(
+        args, lambda o: _json.dumps(o) if isinstance(o, (dict, list))
+        else o)

@@ -382,3 +382,50 @@ def test_exec_fused_topn_parallel_global_merge():
             out.columns["window_end"], out.columns["num"])
             if int(w2) == wend), reverse=True)
         assert got == top, (wend, got, top)
+
+
+def test_exec_string_function_parity():
+    """Scalar fn library parity additions (strings.rs/hash.rs/json.rs)."""
+    p = SchemaProvider()
+    ts = np.arange(3, dtype=np.int64) * 100
+    p.add_memory_table("s", {"t": "s", "j": "s"}, [
+        Batch(ts, {
+            "t": np.array(["hello world", "Abc", "x"], dtype=object),
+            "j": np.array(['{"a": {"b": 5}}', '{"a": {"b": "str"}}',
+                           'nope'], dtype=object),
+        })])
+    out = run_sql(
+        "SELECT initcap(t) as ic, left(t, 3) as l3, right(t, 2) as r2, "
+        "lpad(t, 5, '*') as lp, strpos(t, 'l') as sp, ascii(t) as asc, "
+        "octet_length(t) as ol, bit_length(t) as bl, "
+        "translate(t, 'lo', 'LO') as tr, sha512(t) as h "
+        "FROM s", p)
+    assert list(out.columns["ic"]) == ["Hello World", "Abc", "X"]
+    assert list(out.columns["l3"]) == ["hel", "Abc", "x"]
+    assert list(out.columns["r2"]) == ["ld", "bc", "x"]
+    assert list(out.columns["lp"]) == ["hello", "**Abc", "****x"]
+    assert list(out.columns["sp"]) == [3, 0, 0]
+    assert list(out.columns["asc"]) == [ord("h"), ord("A"), ord("x")]
+    assert list(out.columns["ol"]) == [11, 3, 1]
+    assert list(out.columns["bl"]) == [88, 24, 8]
+    assert list(out.columns["tr"]) == ["heLLO wOrLd", "Abc", "x"]
+    assert all(len(h) == 128 for h in out.columns["h"])
+
+    out = run_sql(
+        "SELECT extract_json_string(j, '$.a.b') as v FROM s", p)
+    assert list(out.columns["v"]) == ["5", "str", None]
+
+    # SQL edge semantics: initcap words are alphanumeric runs; non-positive
+    # pad lengths give ''; chr out of range gives null not a crash
+    p2 = SchemaProvider()
+    p2.add_memory_table("e", {"t": "s", "n": "i"}, [
+        Batch(np.arange(2, dtype=np.int64), {
+            "t": np.array(["o'neil ab1cd", "x y"], dtype=object),
+            "n": np.array([65, -5], dtype=np.int64)})])
+    out = run_sql("SELECT initcap(t) as ic, lpad(t, -1, '*') as lp, "
+                  "chr(n) as c FROM e", p2)
+    # reference semantics (strings.rs:29-41): any non-alphanumeric starts
+    # a new word, digits do not -> O'Neil, Ab1cd
+    assert list(out.columns["ic"]) == ["O'Neil Ab1cd", "X Y"]
+    assert list(out.columns["lp"]) == ["", ""]
+    assert out.columns["c"][0] == "A"

@@ -282,7 +282,7 @@ class ApiServer:
                 for s in fam.samples:
                     if s.name.endswith(("_created",)):
                         continue
-                    if s.labels.get("job_id") not in ("", jid):
+                    if s.labels.get("job_id") != jid:
                         continue
                     op = s.labels.get("operator_id", "")
                     g = groups.setdefault(op, {"operator_id": op,

@@ -270,7 +270,7 @@ class WindowOperator(Operator):
                 out = Batch(np.full(len(rows), end - 1, np.int64), out_cols,
                             rows.key_hash, rows.key_cols)
             else:
-                uniq, agg_cols, _, _cnt = segment_aggregate(
+                uniq, agg_cols, _, _cnt, _vc = segment_aggregate(
                     rows.key_hash, rows.timestamp, rows.columns, self.aggs)
                 cols = _first_occurrence_cols(rows, uniq)
                 cols["window_start"] = np.full(len(uniq), start, np.int64)
@@ -381,7 +381,7 @@ class SessionWindowOperator(Operator):
                 out = Batch(np.full(len(rows), e - 1, np.int64), cols,
                             rows.key_hash, rows.key_cols)
             else:
-                uniq, agg_cols, _, _cnt = segment_aggregate(
+                uniq, agg_cols, _, _cnt, _vc = segment_aggregate(
                     rows.key_hash, rows.timestamp, rows.columns, self.aggs)
                 cols = _first_occurrence_cols(rows, uniq)
                 cols["window_start"] = np.full(len(uniq), s, np.int64)
@@ -610,7 +610,7 @@ class NonWindowAggOperator(Operator):
 
     async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
         assert batch.key_hash is not None
-        uniq, agg_cols, max_ts, row_counts = segment_aggregate(
+        uniq, agg_cols, max_ts, row_counts, valid_counts = segment_aggregate(
             batch.key_hash, batch.timestamp, batch.columns, self.aggs)
         key_cols = _first_occurrence_cols(batch, uniq)
         n = len(uniq)
@@ -621,20 +621,32 @@ class NonWindowAggOperator(Operator):
             merged: Dict[str, float] = {}
             for a in self.aggs:
                 new = agg_cols[a.output][i]
+                # an all-null segment contributes nothing to the running
+                # aggregate (NaN marks SQL NULL from segment_aggregate)
+                new_null = (isinstance(new, (float, np.floating))
+                            and np.isnan(new))
                 if a.kind == AggKind.AVG:
-                    # mergeable avg: store (sum, count) internally
-                    new_sum = float(new) * int(row_counts[i])
+                    # mergeable avg: store (sum, non-null count) internally
+                    nv = int(valid_counts[a.output][i])
+                    new_sum = 0.0 if new_null else float(new) * nv
                     old_sum = prev[f"{a.output}__sum"] if prev else 0.0
                     old_cnt = prev[f"{a.output}__cnt"] if prev else 0
                     merged[f"{a.output}__sum"] = old_sum + new_sum
-                    merged[f"{a.output}__cnt"] = old_cnt + int(row_counts[i])
-                    merged[a.output] = (merged[f"{a.output}__sum"]
-                                        / max(merged[f"{a.output}__cnt"], 1))
+                    merged[f"{a.output}__cnt"] = old_cnt + nv
+                    cnt = merged[f"{a.output}__cnt"]
+                    merged[a.output] = (merged[f"{a.output}__sum"] / cnt
+                                        if cnt else float("nan"))
                 elif prev is None:
                     merged[a.output] = new
                 else:
                     old = prev[a.output]
-                    if a.kind in (AggKind.SUM, AggKind.COUNT):
+                    old_null = (isinstance(old, (float, np.floating))
+                                and np.isnan(old))
+                    if new_null:
+                        merged[a.output] = old
+                    elif old_null:
+                        merged[a.output] = new
+                    elif a.kind in (AggKind.SUM, AggKind.COUNT):
                         merged[a.output] = old + new
                     elif a.kind == AggKind.MAX:
                         merged[a.output] = max(old, new)

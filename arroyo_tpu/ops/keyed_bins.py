@@ -92,11 +92,8 @@ def _emit_kernel(kinds: Tuple[str, ...], C: int, B: int, W: int, k: int):
             g = v[:, ring]  # [C, k, W]
             if kind in ("sum", "avg", "count"):
                 r = jnp.sum(jnp.where(bin_ok[None], g, 0.0), axis=-1)
-                if kind == "avg":
-                    r = r / jnp.maximum(cnt, 1)
-                elif kind == "count":
-                    # per-bin counts were accumulated into the value channel
-                    pass
+                # (avg division happens on host from the validity-count
+                # channel — NOT from cnt, which counts null rows too)
             elif kind == "min":
                 r = jnp.min(jnp.where(bin_ok[None], g, POS_INF), axis=-1)
             elif kind == "max":
@@ -149,6 +146,23 @@ class KeyedBinState:
             "window width must be a multiple of slide")
         self.aggs = aggs
         self.kinds = tuple(a.kind.value for a in aggs)
+        # Internal accumulation channels: one per visible agg, plus a hidden
+        # additive validity-count channel per column-reading agg, so null
+        # (NaN) rows neither poison SUM/MIN/MAX nor inflate AVG's divisor
+        # (reference nulls-skipping semantics, aggregating_window.rs).
+        ch_kinds: List[str] = []
+        ch_valid_of: List[Optional[int]] = []  # validity source agg idx
+        for a in aggs:
+            ch_kinds.append("sum" if a.kind == AggKind.AVG else a.kind.value)
+            ch_valid_of.append(None)
+        self._valid_ch: Dict[int, int] = {}
+        for i, a in enumerate(aggs):
+            if a.column is not None and a.kind != AggKind.COUNT:
+                self._valid_ch[i] = len(ch_kinds)
+                ch_kinds.append("sum")
+                ch_valid_of.append(i)
+        self._ch_kinds = tuple(ch_kinds)
+        self._ch_valid_of = tuple(ch_valid_of)
         self.slide = slide_micros
         self.W = width_micros // slide_micros  # bins per window
         # ring must hold all open bins: W for the widest window plus headroom
@@ -161,11 +175,12 @@ class KeyedBinState:
         self.next_slot = 0
         self.slot_to_key = np.zeros(self.C, dtype=np.uint64)
 
-        self.values = jnp.zeros((len(aggs), self.C, self.B), dtype=jnp.float32)
-        for i, a in enumerate(aggs):
-            iv = _init_value(a.kind)
+        self.values = jnp.zeros((len(self._ch_kinds), self.C, self.B),
+                                dtype=jnp.float32)
+        for j, kind in enumerate(self._ch_kinds):
+            iv = _init_value(AggKind(kind))
             if iv != 0.0:
-                self.values = self.values.at[i].set(iv)
+                self.values = self.values.at[j].set(iv)
         self.counts = jnp.zeros((self.C, self.B), dtype=jnp.int32)
 
         self.min_bin: Optional[int] = None  # oldest retained absolute bin
@@ -205,8 +220,9 @@ class KeyedBinState:
         pad = newC - self.C
         self.values = jnp.concatenate([
             self.values,
-            jnp.stack([jnp.full((pad, self.B), _init_value(a.kind), jnp.float32)
-                       for a in self.aggs]) if self.aggs else
+            jnp.stack([jnp.full((pad, self.B),
+                                _init_value(AggKind(kind)), jnp.float32)
+                       for kind in self._ch_kinds]) if self._ch_kinds else
             jnp.zeros((0, pad, self.B), jnp.float32)], axis=1)
         self.counts = jnp.concatenate(
             [self.counts, jnp.zeros((pad, self.B), jnp.int32)], axis=0)
@@ -256,31 +272,46 @@ class KeyedBinState:
         bins_p[:n] = bins_mod
         valid = np.zeros(npad, dtype=bool)
         valid[:n] = live
-        vals = np.zeros((len(self.aggs), npad), dtype=np.float32)
-        for i, a in enumerate(self.aggs):
-            if a.kind == AggKind.COUNT or a.column is None:
-                vals[i, :n] = 1.0
-            else:
-                from ..formats import coerce_float
+        vals = np.zeros((len(self._ch_kinds), npad), dtype=np.float32)
+        for j in range(len(self._ch_kinds)):
+            vals[j, :n] = self._channel_input(j, agg_inputs, n)
 
-                vals[i, :n] = coerce_float(agg_inputs[a.column])
-
-        kernel = _update_kernel(self.kinds, self.C, self.B, npad)
+        kernel = _update_kernel(self._ch_kinds, self.C, self.B, npad)
         self.values, self.counts = kernel(
             self.values, self.counts, jnp.asarray(slots_p),
             jnp.asarray(bins_p), jnp.asarray(vals), jnp.asarray(valid))
+
+    def _channel_input(self, j: int, agg_inputs: Dict[str, np.ndarray],
+                       n: int) -> np.ndarray:
+        """Per-row channel contribution with nulls (NaN) masked to the
+        channel's identity so they are skipped, not aggregated."""
+        from ..formats import coerce_float
+
+        src = self._ch_valid_of[j]
+        if src is not None:  # hidden validity count for agg `src`
+            raw = coerce_float(agg_inputs[self.aggs[src].column])
+            return (~np.isnan(raw)).astype(np.float32)
+        a = self.aggs[j]
+        if a.column is None:
+            return np.ones(n, dtype=np.float32)
+        raw = coerce_float(agg_inputs[a.column])
+        ok = ~np.isnan(raw)
+        if a.kind == AggKind.COUNT:  # COUNT(col) counts non-null rows
+            return ok.astype(np.float32)
+        ident = _init_value(AggKind(self._ch_kinds[j]))
+        return np.where(ok, raw, np.float32(ident)).astype(np.float32)
 
     def _use_pallas(self) -> bool:
         from .pallas_kernels import LANES, pallas_enabled
 
         if not pallas_enabled():
             return False
-        if not all(k in ("sum", "avg", "count") for k in self.kinds):
+        if not all(k in ("sum", "avg", "count") for k in self._ch_kinds):
             return False
-        # packed width P = 2 channels (hi/lo) x (aggs + count) x B lanes;
+        # packed width P = 2 channels (hi/lo) x (channels + count) x B lanes;
         # the kernel holds [CHUNK, P] + [TILE_C, P] f32 blocks in VMEM, so
         # wide rings (long window / short slide) must fall back to XLA
-        P = 2 * (len(self.aggs) + 1) * self.B
+        P = 2 * (len(self._ch_kinds) + 1) * self.B
         return ((P + LANES - 1) // LANES) * LANES <= 1024
 
     def _update_pallas(self, slots: np.ndarray, bins_mod: np.ndarray,
@@ -289,15 +320,10 @@ class KeyedBinState:
         from .pallas_kernels import (active_capacity, pad_batch,
                                      update_bin_state)
 
-        weights = np.zeros((len(self.aggs) + 1, n), dtype=np.float32)
+        weights = np.zeros((len(self._ch_kinds) + 1, n), dtype=np.float32)
         weights[0] = 1.0  # counts channel
-        for i, a in enumerate(self.aggs):
-            if a.kind == AggKind.COUNT or a.column is None:
-                weights[i + 1] = 1.0
-            else:
-                from ..formats import coerce_float
-
-                weights[i + 1] = coerce_float(agg_inputs[a.column])
+        for j in range(len(self._ch_kinds)):
+            weights[j + 1] = self._channel_input(j, agg_inputs, n)
         weights[:, ~live] = 0.0
         s, b, w = pad_batch(slots.astype(np.int32), bins_mod, weights)
         c_act = active_capacity(self.next_slot, self.C)
@@ -311,9 +337,10 @@ class KeyedBinState:
             newB <<= 1
         vals = np.asarray(self.values)
         cnts = np.asarray(self.counts)
-        new_vals = np.zeros((len(self.aggs), self.C, newB), dtype=np.float32)
-        for i, a in enumerate(self.aggs):
-            new_vals[i] = _init_value(a.kind)
+        new_vals = np.zeros((len(self._ch_kinds), self.C, newB),
+                            dtype=np.float32)
+        for j, kind in enumerate(self._ch_kinds):
+            new_vals[j] = _init_value(AggKind(kind))
         new_cnts = np.zeros((self.C, newB), dtype=np.int32)
         if self.min_bin is not None and self.max_bin is not None:
             for ab in range(self.min_bin, self.max_bin + 1):
@@ -362,7 +389,7 @@ class KeyedBinState:
         lo = self.min_bin if self.min_bin is not None else 0
         bin_ok[:k] = (abs_bins >= lo) & (abs_bins <= self.max_bin)
 
-        kernel = _emit_kernel(self.kinds, self.C, self.B, self.W, kpad)
+        kernel = _emit_kernel(self._ch_kinds, self.C, self.B, self.W, kpad)
         outs, cnts = kernel(self.values, self.counts, jnp.asarray(ring),
                             jnp.asarray(bin_ok))
         outs = np.asarray(outs)  # [n_aggs, C, kpad]
@@ -379,7 +406,7 @@ class KeyedBinState:
                 ring[:len(expired)] = expired % self.B
                 ev = np.zeros(epad, dtype=bool)
                 ev[:len(expired)] = True
-                ek = _evict_kernel(self.kinds, self.C, self.B)
+                ek = _evict_kernel(self._ch_kinds, self.C, self.B)
                 self.values, self.counts = ek(self.values, self.counts,
                                               jnp.asarray(ring), jnp.asarray(ev))
             self.min_bin = new_min
@@ -397,6 +424,13 @@ class KeyedBinState:
             col = outs[i, :C_used, :k][key_idx, pane_idx]
             if a.kind == AggKind.COUNT:
                 col = col.astype(np.int64)
+            elif i in self._valid_ch:
+                # nulls-skipping semantics from the validity-count channel:
+                # AVG divides by non-null rows; an all-null pane is NULL
+                nv = outs[self._valid_ch[i], :C_used, :k][key_idx, pane_idx]
+                if a.kind == AggKind.AVG:
+                    col = col / np.maximum(nv, 1)
+                col = np.where(nv > 0, col, np.nan)
             out_cols[a.output] = col
         return keys, out_cols, window_end, cnts_u[key_idx, pane_idx]
 

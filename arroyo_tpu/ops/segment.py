@@ -37,11 +37,9 @@ def _segment_agg_kernel(n_padded: int, n_segments: int, agg_kinds: Tuple[str, ..
         outs = []
         for i, kind in enumerate(agg_kinds):
             v = values[i]
-            if kind in ("sum", "avg"):
+            if kind == "sum":
                 r = jax.ops.segment_sum(jnp.where(valid, v, 0.0), sid,
                                         num_segments=n_segments + 1)[:n_segments]
-                if kind == "avg":
-                    r = r / jnp.maximum(counts, 1)
             elif kind == "min":
                 r = jax.ops.segment_min(jnp.where(valid, v, POS_INF), sid,
                                         num_segments=n_segments + 1)[:n_segments]
@@ -63,12 +61,16 @@ def segment_aggregate(
     timestamps: np.ndarray,
     agg_inputs: Dict[str, np.ndarray],
     aggs: Tuple[AggSpec, ...],
-) -> Tuple[np.ndarray, Dict[str, np.ndarray], np.ndarray, np.ndarray]:
+) -> Tuple[np.ndarray, Dict[str, np.ndarray], np.ndarray, np.ndarray,
+           Dict[str, np.ndarray]]:
     """Group rows by key_hash and compute ``aggs``.
 
     Returns (unique_keys, {output_name: values}, max_ts_per_key,
-    row_counts_per_key).  Host does the sort (numpy argsort, C speed) — the
-    reduce runs on device.
+    row_counts_per_key, {output_name: non_null_counts} for column aggs).
+    Nulls (NaN after coercion) are skipped: they feed the aggregate its
+    identity, COUNT(col) counts non-null rows only, AVG divides by the
+    non-null count, and an all-null segment emits NaN (SQL NULL).  Host
+    does the sort (numpy argsort, C speed) — the reduce runs on device.
     """
     n = len(key_hash)
     order = np.argsort(key_hash, kind="stable")
@@ -103,24 +105,61 @@ def segment_aggregate(
         else:
             device_aggs.append(a)
 
-    kinds = tuple(a.kind.value for a in device_aggs)
-    vals = np.zeros((len(device_aggs), npad), dtype=np.float32)
-    for i, a in enumerate(device_aggs):
-        if a.column is not None and a.kind != AggKind.COUNT:
-            vals[i, :n] = agg_inputs[a.column][order].astype(np.float32)
+    # Channel layout: one kernel channel per agg, plus a hidden additive
+    # validity-count channel per column-reading agg so nulls are skipped
+    # (same scheme as ops/keyed_bins.py)
+    from ..formats import coerce_float
 
-    kernel = _segment_agg_kernel(npad, spad, kinds)
+    kinds: List[str] = []
+    rows: List[np.ndarray] = []
+    specs: List[Tuple[AggSpec, int, Optional[int]]] = []
+    for a in device_aggs:
+        if a.column is None:  # COUNT(*) — all rows
+            specs.append((a, len(kinds), None))
+            kinds.append("count")
+            rows.append(np.zeros(n, dtype=np.float32))
+            continue
+        raw = coerce_float(agg_inputs[a.column][order])
+        ok = ~np.isnan(raw)
+        if a.kind == AggKind.COUNT:  # COUNT(col) — non-null rows
+            specs.append((a, len(kinds), None))
+            kinds.append("sum")
+            rows.append(ok.astype(np.float32))
+            continue
+        ident = np.float32(0.0 if a.kind in (AggKind.SUM, AggKind.AVG)
+                           else (POS_INF if a.kind == AggKind.MIN
+                                 else NEG_INF))
+        specs.append((a, len(kinds), len(kinds) + 1))
+        kinds.append("sum" if a.kind == AggKind.AVG else a.kind.value)
+        rows.append(np.where(ok, raw, ident).astype(np.float32))
+        kinds.append("sum")
+        rows.append(ok.astype(np.float32))
+
+    vals = np.zeros((len(kinds), npad), dtype=np.float32)
+    for i, row in enumerate(rows):
+        vals[i, :n] = row
+
+    kernel = _segment_agg_kernel(npad, spad, tuple(kinds))
     outs, counts = kernel(jnp.asarray(vals), jnp.asarray(sid_p),
                           jnp.asarray(valid))
     outs = np.asarray(outs)[:, :n_seg]
     out_cols = dict(distinct_results)
-    for i, a in enumerate(device_aggs):
-        col = outs[i]
+    valid_counts: Dict[str, np.ndarray] = {}
+    for a, ci, vi in specs:
+        col = outs[ci]
+        if vi is not None:
+            nv = outs[vi]
+            valid_counts[a.output] = nv.astype(np.int64)
+            if a.kind == AggKind.AVG:
+                col = col / np.maximum(nv, 1)
+            col = np.where(nv > 0, col, np.nan)
         if a.kind == AggKind.COUNT:
             col = col.astype(np.int64)
+            valid_counts[a.output] = col
         out_cols[a.output] = col
 
     # per-key max timestamp (host; used for emitted record timestamps)
     ts_sorted = timestamps[order]
     max_ts = np.maximum.reduceat(ts_sorted, seg_start)
-    return uniq, out_cols, max_ts, np.asarray(counts)[:n_seg].astype(np.int64)
+    return (uniq, out_cols, max_ts,
+            np.asarray(counts)[:n_seg].astype(np.int64), valid_counts)

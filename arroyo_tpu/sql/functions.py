@@ -312,35 +312,57 @@ def _sha256(args):
 
 
 
-def _json_path_walk(args, convert):
-    """Shared $.a.b path walk over a JSON string column; per-row null on
-    parse/path errors.  ``convert`` maps the matched object to the output
-    value (json.rs/jsonpath semantics)."""
+def _json_path_query(args):
+    """Evaluate a $.a.b path over a JSON string column, returning per row
+    the list of ALL matches (array nodes fan out over their elements, as
+    jsonpath does) or None on a parse error
+    (/root/reference/arroyo-worker/src/operators/functions/json.rs)."""
     import json as _json
 
     v, m = args[0]
     path = str(np.asarray(args[1][0]).reshape(-1)[0])
     keys = [p for p in path.replace("$.", "").split(".") if p]
-    out = []
+    rows = []
     for s in v:
         try:
-            obj = _json.loads(s)
-            for k in keys:
-                obj = obj[k] if not isinstance(obj, list) else obj[0][k]
-            out.append(convert(obj))
+            nodes = [_json.loads(s)]
         except Exception:
-            out.append(None)
+            rows.append(None)
+            continue
+        for k in keys:
+            nxt = []
+            for nd in nodes:
+                items = nd if isinstance(nd, list) else [nd]
+                for item in items:
+                    try:
+                        nxt.append(item[k])
+                    except Exception:
+                        pass
+            nodes = nxt
+        rows.append(nodes)
+    return rows, m
+
+
+def _json_path_walk(args, convert):
+    """First-match walk; per-row null when the path matches nothing.
+    ``convert`` maps the matched object to the output value."""
+    rows, m = _json_path_query(args)
+    out = [convert(r[0]) if r else None for r in rows]
     mask = np.array([o is not None for o in out])
     return _obj(out), mask if m is None else (m & mask)
 
 
 @host_fn("get_json_objects")
 def _get_json_objects(args):
+    """ALL path matches, each JSON-encoded, as a list per row
+    (json.rs get_json_objects returns Vec<String>)."""
     import json as _json
 
-    return _json_path_walk(
-        args, lambda o: _json.dumps(o) if isinstance(o, (dict, list))
-        else o)
+    rows, m = _json_path_query(args)
+    out = [[_json.dumps(o) for o in r] if r is not None else None
+           for r in rows]
+    mask = np.array([o is not None for o in out])
+    return _obj(out), mask if m is None else (m & mask)
 
 
 @host_fn("hash")
@@ -357,10 +379,18 @@ def _map_str(v, f):
     return _obj([f(s) if s is not None else None for s in v])
 
 
+def _and_input_nulls(v, m):
+    """Validity mask with None input rows marked null, even when the
+    incoming mask is absent (object string columns skip coercion)."""
+    ok = np.array([s is not None for s in v])
+    return ok if m is None else (m & ok)
+
+
 @host_fn("ascii")
 def _ascii(args):
     (v, m), = args
-    return np.array([ord(s[0]) if s else 0 for s in v], dtype=np.int64), m
+    return (np.array([ord(s[0]) if s else 0 for s in v], dtype=np.int64),
+            _and_input_nulls(v, m))
 
 
 @host_fn("chr")
@@ -409,6 +439,8 @@ def _right(args):
     n = np.broadcast_to(np.asarray(args[1][0]).astype(int), (len(v),))
 
     def take(s, k):
+        if k == 0:
+            return ""  # Postgres: right(s, 0) = '' (s[-0:] would be s)
         if k > 0:
             return s[-k:] if k < len(s) else s
         return s[-k:]  # negative: all but the first |k| chars (Postgres)
@@ -460,23 +492,26 @@ def _rpad(args):
 @host_fn("octet_length")
 def _octet_length(args):
     (v, m), = args
-    return np.array([len(str(s).encode()) if s is not None else 0
-                     for s in v], dtype=np.int64), m
+    return (np.array([len(str(s).encode()) if s is not None else 0
+                      for s in v], dtype=np.int64),
+            _and_input_nulls(v, m))
 
 
 @host_fn("bit_length")
 def _bit_length(args):
     (v, m), = args
-    return np.array([len(str(s).encode()) * 8 if s is not None else 0
-                     for s in v], dtype=np.int64), m
+    return (np.array([len(str(s).encode()) * 8 if s is not None else 0
+                      for s in v], dtype=np.int64),
+            _and_input_nulls(v, m))
 
 
 @host_fn("strpos")
 def _strpos(args):
     v, m = args[0]
     needle = str(np.asarray(args[1][0]).reshape(-1)[0])
-    return np.array([(s.find(needle) + 1) if s is not None else 0
-                     for s in v], dtype=np.int64), m
+    return (np.array([(s.find(needle) + 1) if s is not None else 0
+                      for s in v], dtype=np.int64),
+            _and_input_nulls(v, m))
 
 
 @host_fn("translate")
@@ -505,12 +540,10 @@ HOST_FUNCTIONS["sha512"] = _sha_fn("sha512")
 
 @host_fn("extract_json_string")
 def _extract_json_string(args):
-    """Like get_json_objects but always stringifies the match
-    (json.rs extract_json_string)."""
-    import json as _json
-
+    """First match, and only if it is a JSON string — non-string matches
+    are NULL (json.rs extract_json_string matches Value::String only)."""
     return _json_path_walk(
-        args, lambda o: o if isinstance(o, str) else _json.dumps(o))
+        args, lambda o: o if isinstance(o, str) else None)
 
 
 @host_fn("get_first_json_object")

@@ -689,6 +689,11 @@ class Planner:
 
             v, m = c.fn(env)
             if m is None:
+                if isinstance(v, np.ndarray) and v.dtype == object:
+                    # nullable object column without an explicit mask:
+                    # the None rows themselves are the nulls
+                    return np.array([x is not None for x in v],
+                                    dtype=np.float32), None
                 base = jnp.ones_like(jnp.asarray(v), dtype=jnp.float32) \
                     if hasattr(v, "shape") else 1.0
                 return base, None
@@ -704,6 +709,8 @@ class Planner:
             v, m = c.fn(env)
             if m is None:
                 return v, None
+            if isinstance(v, np.ndarray) and v.dtype == object:
+                return np.where(np.asarray(m), v, fill), None
             return jnp.where(m, v, fill), None
 
         return Compiled(fn, c.needs_host, c.sql)

@@ -42,6 +42,7 @@ class WorkerServer:
         self.running: Optional[RunningEngine] = None
         self._relay_task: Optional[asyncio.Task] = None
         self._hb_task: Optional[asyncio.Task] = None
+        self._hb_stop = None  # threading.Event, set by _heartbeat_loop
         self._done = asyncio.Event()
 
     # -- lifecycle ---------------------------------------------------------
@@ -74,6 +75,11 @@ class WorkerServer:
         await self._done.wait()
 
     async def shutdown(self) -> None:
+        if self._hb_stop is not None:
+            # stop the heartbeat thread directly: cancelling the parked
+            # task is not enough on every shutdown path, and a surviving
+            # daemon thread keeps dialing the dead controller
+            self._hb_stop.set()
         for t in (self._hb_task, self._relay_task):
             if t is not None:
                 t.cancel()

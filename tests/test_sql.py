@@ -411,9 +411,25 @@ def test_exec_string_function_parity():
     assert list(out.columns["tr"]) == ["heLLO wOrLd", "Abc", "x"]
     assert all(len(h) == 128 for h in out.columns["h"])
 
+    # extract_json_string matches Value::String ONLY (json.rs): the
+    # numeric match in row 0 is NULL, not "5"
     out = run_sql(
         "SELECT extract_json_string(j, '$.a.b') as v FROM s", p)
-    assert list(out.columns["v"]) == ["5", "str", None]
+    assert list(out.columns["v"]) == [None, "str", None]
+
+    # get_json_objects fans out over array nodes and returns ALL matches,
+    # each JSON-encoded (json.rs returns Vec<String>); right(s, 0) is ''
+    pj = SchemaProvider()
+    pj.add_memory_table("js", {"j": "s", "t": "s"}, [
+        Batch(np.arange(3, dtype=np.int64), {
+            "j": np.array(['{"a": [{"b": 1}, {"b": 2}]}',
+                           '{"a": [{"b": "x"}]}', 'nope'], dtype=object),
+            "t": np.array(["hello", "ab", "z"], dtype=object),
+        })])
+    out = run_sql("SELECT get_json_objects(j, '$.a.b') as v, "
+                  "right(t, 0) as r0 FROM js", pj)
+    assert list(out.columns["v"]) == [["1", "2"], ['"x"'], None]
+    assert list(out.columns["r0"]) == ["", "", ""]
 
     # SQL edge semantics: initcap words are alphanumeric runs; non-positive
     # pad lengths give ''; chr out of range gives null not a crash

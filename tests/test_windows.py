@@ -233,3 +233,59 @@ def test_out_of_order_within_lateness():
     per_window = {int(out.columns["window_end"][i]): int(out.columns["cnt"][i])
                   for i in range(len(out))}
     assert per_window == {SEC: 1, 2 * SEC: 1, 3 * SEC: 1, 4 * SEC: 1}
+
+
+def test_null_skipping_aggregates(rng):
+    """Nulls (None in object columns -> NaN) are SKIPPED by SUM/MIN/MAX/AVG
+    and by COUNT(col), and AVG divides by the NON-NULL row count — not the
+    pane row count (reference nulls-skipping semantics,
+    aggregating_window.rs; round-1 bug: avg used the shared pane count)."""
+    n = 400
+    ts = np.sort(rng.integers(0, 2 * SEC, n)).astype(np.int64)
+    keys = rng.integers(0, 5, n).astype(np.int64)
+    vals = rng.integers(1, 100, n).astype(np.int64)
+    null_mask = rng.random(n) < 0.4
+    col = np.array([None if m else int(v)
+                    for v, m in zip(vals, null_mask)], dtype=object)
+    ev = Batch(ts, {"k": keys, "v": col})
+    aggs = [AggSpec(AggKind.COUNT, None, "cnt"),
+            AggSpec(AggKind.COUNT, "v", "cnt_v"),
+            AggSpec(AggKind.SUM, "v", "total"),
+            AggSpec(AggKind.AVG, "v", "mean"),
+            AggSpec(AggKind.MIN, "v", "lo"),
+            AggSpec(AggKind.MAX, "v", "hi")]
+    out = run_pipeline(
+        [ev],
+        lambda s: s.key_by("k").tumbling_aggregate(SEC, aggs)
+        .sink("memory", {"name": "out"}),
+    )
+    assert out is not None
+    # oracle over non-null rows per (key, window)
+    exp = {}
+    for t, k, v, m in zip(ts.tolist(), keys.tolist(), vals.tolist(),
+                          null_mask.tolist()):
+        e = (t // SEC + 1) * SEC
+        c_all, c_v, s, mn, mx = exp.get((k, e), (0, 0, 0, None, None))
+        c_all += 1
+        if not m:
+            c_v += 1
+            s += v
+            mn = v if mn is None else min(mn, v)
+            mx = v if mx is None else max(mx, v)
+        exp[(k, e)] = (c_all, c_v, s, mn, mx)
+    seen = set()
+    for i in range(len(out)):
+        key = (int(out.columns["k"][i]), int(out.columns["window_end"][i]))
+        c_all, c_v, s, mn, mx = exp[key]
+        seen.add(key)
+        assert int(out.columns["cnt"][i]) == c_all
+        assert int(out.columns["cnt_v"][i]) == c_v
+        if c_v == 0:  # all-null pane: every column agg is NULL (NaN)
+            for c in ("total", "mean", "lo", "hi"):
+                assert np.isnan(out.columns[c][i]), (key, c)
+        else:
+            assert int(out.columns["total"][i]) == s
+            assert out.columns["mean"][i] == pytest.approx(s / c_v, rel=1e-5)
+            assert int(out.columns["lo"][i]) == mn
+            assert int(out.columns["hi"][i]) == mx
+    assert seen == set(exp)

@@ -8,6 +8,15 @@ window state in HBM, shuffles as XLA collectives over a device mesh."""
 
 __version__ = "0.1.0"
 
+# 64-bit integers are load-bearing in a streaming engine: event-time
+# micros and Nexmark ids exceed int32, and with x64 disabled JAX silently
+# canonicalizes int64 jit inputs to int32 (wraparound corruption, not an
+# error).  Enable x64 up front; device kernels pin f32/i32 explicitly so
+# MXU-path compute stays 32-bit (weak-type promotion preserves them).
+import jax as _jax
+
+_jax.config.update("jax_enable_x64", True)
+
 from .types import (  # noqa: F401
     Batch,
     CheckpointBarrier,

@@ -445,3 +445,20 @@ def test_exec_string_function_parity():
     assert list(out.columns["ic"]) == ["O'Neil Ab1cd", "X Y"]
     assert list(out.columns["lp"]) == ["", ""]
     assert out.columns["c"][0] == "A"
+
+
+def test_exec_absolute_micros_int64_exact():
+    """Absolute epoch-micros timestamps and >2^31 ids survive a jitted
+    projection EXACTLY (with x64 off, JAX silently canonicalizes int64 jit
+    inputs to int32 — wraparound corruption this guards against)."""
+    base = 1_700_000_000_000_000  # ~2023 in epoch micros
+    big = np.array([base + 1, base + 2, base + 3], dtype=np.int64)
+    ids = np.array([2**40 + 7, 2**33, 5], dtype=np.int64)
+    p = SchemaProvider()
+    p.add_memory_table("s", {"id": "i", "dt": "t"}, [
+        Batch(big, {"id": ids, "dt": big.copy()})])
+    out = run_sql("SELECT id, dt, id + 1 as id1 FROM s", p)
+    assert list(out.columns["id"]) == list(ids)
+    assert list(out.columns["dt"]) == list(big)
+    assert list(out.columns["id1"]) == [int(i) + 1 for i in ids]
+    assert out.columns["id"].dtype == np.int64

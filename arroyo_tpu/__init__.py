@@ -13,9 +13,15 @@ __version__ = "0.1.0"
 # canonicalizes int64 jit inputs to int32 (wraparound corruption, not an
 # error).  Enable x64 up front; device kernels pin f32/i32 explicitly so
 # MXU-path compute stays 32-bit (weak-type promotion preserves them).
-import jax as _jax
+# An embedding host that needs x32 semantics for its own JAX code can set
+# JAX_ENABLE_X64=0 explicitly — we honor it and the engine's host paths
+# keep 64-bit values in numpy, at reduced in-jit range.
+import os as _os
 
-_jax.config.update("jax_enable_x64", True)
+if _os.environ.get("JAX_ENABLE_X64", "").lower() not in ("0", "false"):
+    import jax as _jax
+
+    _jax.config.update("jax_enable_x64", True)
 
 from .types import (  # noqa: F401
     Batch,

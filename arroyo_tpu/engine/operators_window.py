@@ -132,10 +132,15 @@ class BinAggOperator(Operator):
                  aggs: Tuple[AggSpec, ...], projection=None,
                  top_n: Optional[Tuple[Tuple[str, ...], str, int]] = None):
         super().__init__(name)
+        from ..parallel.mesh_window import make_bin_state
+
         self.width = width_micros
         self.slide = slide_micros
         self.aggs = aggs
-        self.state = KeyedBinState(aggs, slide_micros, width_micros)
+        # mesh-sharded state when >1 device is available (all_to_all re-key
+        # over ICI instead of a host shuffle); single-device KeyedBinState
+        # otherwise
+        self.state = make_bin_state(aggs, slide_micros, width_micros)
         self.keyvals = _SlotKeyValues()
         self.projection = (CompiledExpr(projection.name, projection.fn)
                            if projection else None)

@@ -11,7 +11,7 @@ Differences from the reference, by design:
 * payloads are **Arrow IPC** record batches (columnar, zero-parse into numpy)
   instead of bincode'd single records — the batch is the unit of flow;
 * this is the **DCN/host path only**: shuffles *within* a mesh slice ride ICI
-  via XLA collectives (parallel/spmd_window.py); this plane connects hosts.
+  via XLA collectives (parallel/mesh_window.py); this plane connects hosts.
 
 Frame layout (little-endian):
   u32 magic | u16 kind | u32 src_op_len | src_op | u32 src_idx

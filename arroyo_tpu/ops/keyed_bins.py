@@ -130,6 +130,84 @@ def _bucket(n: int, floor: int = 8) -> int:
     return b
 
 
+# -- shared channel + directory semantics (single-device AND mesh state) -----
+#
+# The null-skipping accumulation rules and the host key directory are THE
+# shared semantics between KeyedBinState and parallel/mesh_window's
+# MeshKeyedBinState; they live here once so a fix cannot apply to one
+# implementation and silently miss the other.
+
+
+def build_channels(aggs: Tuple[AggSpec, ...]
+                   ) -> Tuple[Tuple[str, ...], Dict[int, int]]:
+    """(kernel channel kinds, visible-agg -> hidden-validity-channel map).
+
+    One accumulation channel per visible agg (AVG accumulates as a sum),
+    plus a hidden additive validity-count channel per column-reading agg
+    so null (NaN) rows neither poison SUM/MIN/MAX nor inflate AVG's
+    divisor (reference nulls-skipping semantics, aggregating_window.rs)."""
+    ch_kinds: List[str] = []
+    for a in aggs:
+        ch_kinds.append("sum" if a.kind == AggKind.AVG else a.kind.value)
+    valid_ch: Dict[int, int] = {}
+    for i, a in enumerate(aggs):
+        if a.column is not None and a.kind != AggKind.COUNT:
+            valid_ch[i] = len(ch_kinds)
+            ch_kinds.append("sum")
+    return tuple(ch_kinds), valid_ch
+
+
+def channel_input(aggs: Tuple[AggSpec, ...], ch_kinds: Tuple[str, ...],
+                  valid_of: Dict[int, int], j: int,
+                  agg_inputs: Dict[str, np.ndarray], n: int) -> np.ndarray:
+    """Per-row contribution of channel ``j`` with nulls (NaN) masked to the
+    channel's identity so they are skipped, not aggregated.
+    ``valid_of`` maps hidden channel index -> source visible agg index."""
+    from ..formats import coerce_float
+
+    src = valid_of.get(j)
+    if src is not None:  # hidden validity count for agg `src`
+        raw = coerce_float(agg_inputs[aggs[src].column])
+        return (~np.isnan(raw)).astype(np.float32)
+    a = aggs[j]
+    if a.column is None:
+        return np.ones(n, dtype=np.float32)
+    raw = coerce_float(agg_inputs[a.column])
+    ok = ~np.isnan(raw)
+    if a.kind == AggKind.COUNT:  # COUNT(col) counts non-null rows
+        return ok.astype(np.float32)
+    ident = _init_value(AggKind(ch_kinds[j]))
+    return np.where(ok, raw, np.float32(ident)).astype(np.float32)
+
+
+def directory_insert(state, kh: np.ndarray, ensure_capacity) -> np.ndarray:
+    """Vectorized key-hash -> slot lookup over the host directory attrs
+    (``key_sorted``, ``slot_of_sorted``, ``next_slot``, ``slot_to_key``),
+    inserting unknown keys.  ``ensure_capacity(total_slots, new_keys)`` is
+    the growth hook (device-array growth for KeyedBinState, shard-count
+    accounting + device growth for the mesh state)."""
+    uniq = np.unique(kh)
+    pos = np.searchsorted(state.key_sorted, uniq)
+    pos_c = np.minimum(pos, max(len(state.key_sorted) - 1, 0))
+    known = (len(state.key_sorted) > 0) & (
+        state.key_sorted[pos_c] == uniq if len(state.key_sorted) else
+        np.zeros(len(uniq), dtype=bool))
+    new_keys = uniq[~known] if len(state.key_sorted) else uniq
+    if len(new_keys):
+        n_new = len(new_keys)
+        ensure_capacity(state.next_slot + n_new, new_keys)
+        new_slots = np.arange(state.next_slot, state.next_slot + n_new)
+        state.slot_to_key[new_slots] = new_keys
+        state.next_slot += n_new
+        merged = np.concatenate([state.key_sorted, new_keys])
+        merged_slots = np.concatenate([state.slot_of_sorted, new_slots])
+        order = np.argsort(merged, kind="stable")
+        state.key_sorted = merged[order]
+        state.slot_of_sorted = merged_slots[order]
+    idx = np.searchsorted(state.key_sorted, kh)
+    return state.slot_of_sorted[idx]
+
+
 class KeyedBinState:
     """Sharded keyed bin-ring aggregation state for one subtask."""
 
@@ -146,23 +224,8 @@ class KeyedBinState:
             "window width must be a multiple of slide")
         self.aggs = aggs
         self.kinds = tuple(a.kind.value for a in aggs)
-        # Internal accumulation channels: one per visible agg, plus a hidden
-        # additive validity-count channel per column-reading agg, so null
-        # (NaN) rows neither poison SUM/MIN/MAX nor inflate AVG's divisor
-        # (reference nulls-skipping semantics, aggregating_window.rs).
-        ch_kinds: List[str] = []
-        ch_valid_of: List[Optional[int]] = []  # validity source agg idx
-        for a in aggs:
-            ch_kinds.append("sum" if a.kind == AggKind.AVG else a.kind.value)
-            ch_valid_of.append(None)
-        self._valid_ch: Dict[int, int] = {}
-        for i, a in enumerate(aggs):
-            if a.column is not None and a.kind != AggKind.COUNT:
-                self._valid_ch[i] = len(ch_kinds)
-                ch_kinds.append("sum")
-                ch_valid_of.append(i)
-        self._ch_kinds = tuple(ch_kinds)
-        self._ch_valid_of = tuple(ch_valid_of)
+        self._ch_kinds, self._valid_ch = build_channels(aggs)
+        self._valid_of = {v: k for k, v in self._valid_ch.items()}
         self.slide = slide_micros
         self.W = width_micros // slide_micros  # bins per window
         # ring must hold all open bins: W for the widest window plus headroom
@@ -191,27 +254,11 @@ class KeyedBinState:
 
     def _lookup_or_insert(self, kh: np.ndarray) -> np.ndarray:
         """Vectorized key hash -> slot id, inserting unknown keys."""
-        uniq = np.unique(kh)
-        pos = np.searchsorted(self.key_sorted, uniq)
-        pos_c = np.minimum(pos, max(len(self.key_sorted) - 1, 0))
-        known = (len(self.key_sorted) > 0) & (
-            self.key_sorted[pos_c] == uniq if len(self.key_sorted) else
-            np.zeros(len(uniq), dtype=bool))
-        new_keys = uniq[~known] if len(self.key_sorted) else uniq
-        if len(new_keys):
-            n_new = len(new_keys)
-            if self.next_slot + n_new > self.C:
-                self._grow(self.next_slot + n_new)
-            new_slots = np.arange(self.next_slot, self.next_slot + n_new)
-            self.slot_to_key[new_slots] = new_keys
-            self.next_slot += n_new
-            merged = np.concatenate([self.key_sorted, new_keys])
-            merged_slots = np.concatenate([self.slot_of_sorted, new_slots])
-            order = np.argsort(merged, kind="stable")
-            self.key_sorted = merged[order]
-            self.slot_of_sorted = merged_slots[order]
-        idx = np.searchsorted(self.key_sorted, kh)
-        return self.slot_of_sorted[idx]
+        def ensure(total, _new_keys):
+            if total > self.C:
+                self._grow(total)
+
+        return directory_insert(self, kh, ensure)
 
     def _grow(self, needed: int) -> None:
         newC = self.C
@@ -283,23 +330,8 @@ class KeyedBinState:
 
     def _channel_input(self, j: int, agg_inputs: Dict[str, np.ndarray],
                        n: int) -> np.ndarray:
-        """Per-row channel contribution with nulls (NaN) masked to the
-        channel's identity so they are skipped, not aggregated."""
-        from ..formats import coerce_float
-
-        src = self._ch_valid_of[j]
-        if src is not None:  # hidden validity count for agg `src`
-            raw = coerce_float(agg_inputs[self.aggs[src].column])
-            return (~np.isnan(raw)).astype(np.float32)
-        a = self.aggs[j]
-        if a.column is None:
-            return np.ones(n, dtype=np.float32)
-        raw = coerce_float(agg_inputs[a.column])
-        ok = ~np.isnan(raw)
-        if a.kind == AggKind.COUNT:  # COUNT(col) counts non-null rows
-            return ok.astype(np.float32)
-        ident = _init_value(AggKind(self._ch_kinds[j]))
-        return np.where(ok, raw, np.float32(ident)).astype(np.float32)
+        return channel_input(self.aggs, self._ch_kinds, self._valid_of, j,
+                             agg_inputs, n)
 
     def _use_pallas(self) -> bool:
         from .pallas_kernels import LANES, pallas_enabled
@@ -435,33 +467,69 @@ class KeyedBinState:
         return keys, out_cols, window_end, cnts_u[key_idx, pane_idx]
 
     # -- checkpoint ---------------------------------------------------------
+    #
+    # Snapshots use the CANONICAL topology-independent bin-state format
+    # shared with MeshKeyedBinState (parallel/mesh_window.py): compact
+    # per-key LINEAR bin columns (column j = absolute bin lo+j) plus the
+    # host key directory, so a checkpoint taken single-device restores
+    # onto any mesh and vice versa (restore-time re-partitioning,
+    # parquet.rs:194-218 analog).
 
     def snapshot(self) -> Dict[str, np.ndarray]:
+        n = self.next_slot
+        values = np.asarray(jax.device_get(self.values))
+        counts = np.asarray(jax.device_get(self.counts))
+        if self.min_bin is not None and self.max_bin is not None:
+            lo = self.min_bin
+            cols = (np.arange(lo, self.max_bin + 1) % self.B)
+        else:
+            lo = -1
+            cols = np.zeros(0, dtype=np.int64)
         return {
-            "values": np.asarray(jax.device_get(self.values)),
-            "counts": np.asarray(jax.device_get(self.counts)),
+            "bin_keys": self.slot_to_key[:n],
+            "bin_vals": values[:, :n][:, :, cols],
+            "bin_counts": counts[:n][:, cols],
             "key_sorted": self.key_sorted,
             "slot_of_sorted": self.slot_of_sorted,
-            "slot_to_key": self.slot_to_key,
+            "slot_to_key": self.slot_to_key[:n],
             "meta": np.array([
-                self.next_slot,
-                -1 if self.min_bin is None else self.min_bin,
+                n, lo,
                 -1 if self.max_bin is None else self.max_bin,
                 -1 if self.last_fired_pane is None else self.last_fired_pane,
-                self.B, self.C,
+                -1 if self.min_bin is None else self.min_bin,
             ], dtype=np.int64),
         }
 
     def restore(self, arrays: Dict[str, np.ndarray]) -> None:
         meta = arrays["meta"]
         self.next_slot = int(meta[0])
-        self.min_bin = None if meta[1] < 0 else int(meta[1])
+        lo = int(meta[1])
         self.max_bin = None if meta[2] < 0 else int(meta[2])
         self.last_fired_pane = None if meta[3] < 0 else int(meta[3])
-        self.B = int(meta[4])
-        self.C = int(meta[5])
-        self.values = jnp.asarray(arrays["values"])
-        self.counts = jnp.asarray(arrays["counts"])
+        self.min_bin = None if meta[4] < 0 else int(meta[4])
         self.key_sorted = arrays["key_sorted"].astype(np.uint64)
         self.slot_of_sorted = arrays["slot_of_sorted"].astype(np.int64)
-        self.slot_to_key = arrays["slot_to_key"].astype(np.uint64)
+        self.C = _bucket(max(self.next_slot, 8))
+        self.slot_to_key = np.zeros(self.C, dtype=np.uint64)
+        self.slot_to_key[:self.next_slot] = \
+            arrays["slot_to_key"].astype(np.uint64)[:self.next_slot]
+
+        bin_keys = arrays["bin_keys"].astype(np.uint64)
+        bin_vals = np.asarray(arrays["bin_vals"], dtype=np.float32)
+        bin_counts = np.asarray(arrays["bin_counts"], dtype=np.int32)
+        span = bin_vals.shape[-1]
+        self.B = _bucket(max(span, 2 * self.W + 4), floor=8)
+        values = np.zeros((len(self._ch_kinds), self.C, self.B), np.float32)
+        for j, k in enumerate(self._ch_kinds):
+            values[j] = _init_value(AggKind(k))
+        counts = np.zeros((self.C, self.B), np.int32)
+        if len(bin_keys) and span and lo >= 0:
+            # bin rows land at their DIRECTORY slot (restores from a mesh
+            # snapshot may order rows differently than this host's slots)
+            idx = np.searchsorted(self.key_sorted, bin_keys)
+            slots = self.slot_of_sorted[idx]
+            cols = (np.arange(lo, lo + span) % self.B)
+            values[:, slots[:, None], cols[None, :]] = bin_vals
+            counts[slots[:, None], cols[None, :]] = bin_counts
+        self.values = jnp.asarray(values)
+        self.counts = jnp.asarray(counts)

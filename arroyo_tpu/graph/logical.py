@@ -81,15 +81,24 @@ class AggKind(Enum):
     MAX = "max"
     AVG = "avg"
     VEC = "vec"  # collect values (WindowAgg::Expression / flatten path)
+    UDAF = "udaf"  # user aggregate fn(values)->scalar; buffered paths only
 
 
 @dataclass(frozen=True)
 class AggSpec:
-    """One aggregate: kind + input column + output column name."""
+    """One aggregate: kind + input column + output column name.
+
+    ``fn`` carries the Python callable for UDAF kinds (user aggregates,
+    the analog of the reference's registered UDFs executed in the worker,
+    arroyo-sql/src/lib.rs:196-290 + operators/mod.rs:347-494).  UDAFs are
+    not mergeable, so they plan onto the buffered window paths only —
+    matching the reference's two-phase exclusion (operators.rs:165-167).
+    """
 
     kind: AggKind
     column: Optional[str]  # None for COUNT(*)
     output: str
+    fn: Optional[Any] = None
 
 
 class ExprReturnType(Enum):

@@ -493,10 +493,9 @@ class KeyedBinState:
             "slot_of_sorted": self.slot_of_sorted,
             "slot_to_key": self.slot_to_key[:n],
             "meta": np.array([
-                n, lo,
+                n, lo,  # lo == min_bin: first linear column's absolute bin
                 -1 if self.max_bin is None else self.max_bin,
                 -1 if self.last_fired_pane is None else self.last_fired_pane,
-                -1 if self.min_bin is None else self.min_bin,
             ], dtype=np.int64),
         }
 
@@ -506,7 +505,7 @@ class KeyedBinState:
         lo = int(meta[1])
         self.max_bin = None if meta[2] < 0 else int(meta[2])
         self.last_fired_pane = None if meta[3] < 0 else int(meta[3])
-        self.min_bin = None if meta[4] < 0 else int(meta[4])
+        self.min_bin = None if lo < 0 else lo
         self.key_sorted = arrays["key_sorted"].astype(np.uint64)
         self.slot_of_sorted = arrays["slot_of_sorted"].astype(np.int64)
         self.C = _bucket(max(self.next_slot, 8))

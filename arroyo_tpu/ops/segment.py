@@ -93,7 +93,24 @@ def segment_aggregate(
     distinct_results: Dict[str, np.ndarray] = {}
     device_aggs = []
     for a in aggs:
-        if a.kind == AggKind.COUNT_DISTINCT:
+        if a.kind == AggKind.UDAF:
+            # user aggregate: per-segment host call over non-null values
+            # (non-mergeable — only reachable via buffered window paths,
+            # like the reference's wasm UDFs, operators/mod.rs:347-494)
+            v = agg_inputs[a.column][order]
+            if v.dtype == object:
+                ok_rows = np.array([x is not None for x in v])
+            elif np.issubdtype(v.dtype, np.floating):
+                ok_rows = ~np.isnan(v)
+            else:
+                ok_rows = np.ones(len(v), dtype=bool)
+            groups = np.split(np.arange(n), seg_start[1:])
+            out = []
+            for g in groups:
+                gv = v[g[ok_rows[g]]]
+                out.append(a.fn(gv) if len(gv) else np.nan)
+            distinct_results[a.output] = np.asarray(out)
+        elif a.kind == AggKind.COUNT_DISTINCT:
             v = agg_inputs[a.column][order]
             pair_sort = np.lexsort((v, kh))
             kv, vv = kh[pair_sort], v[pair_sort]

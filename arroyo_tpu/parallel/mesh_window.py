@@ -648,10 +648,9 @@ class MeshKeyedBinState:
             "slot_of_sorted": self.slot_of_sorted,
             "slot_to_key": self.slot_to_key[:self.next_slot],
             "meta": np.array([
-                self.next_slot, lo,
+                self.next_slot, lo,  # lo == min_bin (min_bin >= base_bin)
                 -1 if self.max_bin is None else self.max_bin,
                 -1 if self.last_fired_pane is None else self.last_fired_pane,
-                -1 if self.min_bin is None else self.min_bin,
             ], dtype=np.int64),
         }
 
@@ -665,11 +664,11 @@ class MeshKeyedBinState:
         lo = int(meta[1])
         self.max_bin = None if meta[2] < 0 else int(meta[2])
         self.last_fired_pane = None if meta[3] < 0 else int(meta[3])
-        self.min_bin = None if meta[4] < 0 else int(meta[4])
-        # base starts at the oldest stored bin; update()'s _rebase lowers
-        # it on demand if live out-of-order rows arrive below it (eagerly
-        # reserving columns down to the late threshold could allocate a
-        # huge ring when the watermark lags far behind data)
+        self.min_bin = None if lo < 0 else lo
+        # base starts at the oldest stored bin (column 0); update()'s
+        # _rebase lowers it on demand if live out-of-order rows arrive
+        # below it (eagerly reserving columns down to the late threshold
+        # could allocate a huge ring when the watermark lags behind data)
         self.base_bin = lo if lo >= 0 else None
         self.key_sorted = arrays["key_sorted"].astype(np.uint64)
         self.slot_of_sorted = arrays["slot_of_sorted"].astype(np.int64)
@@ -682,16 +681,13 @@ class MeshKeyedBinState:
         bins = np.asarray(arrays["bin_vals"], dtype=np.float32)
         counts = np.asarray(arrays["bin_counts"], dtype=np.int32)
         span = bins.shape[-1]
-        # stored columns start at absolute bin lo; device columns are
-        # base-relative, so they land at offset lo - base
-        off = (lo - self.base_bin) if lo >= 0 else 0
-        self.B = _bucket(max(off + span, 2 * self.W + 4), floor=8)
-        if off or span < self.B:  # re-seat columns in the wider ring
+        self.B = _bucket(max(span, 2 * self.W + 4), floor=8)
+        if span < self.B:  # pad linear columns out to the ring width
             bins_p = _init_filled(self._ch_kinds, bins.shape[1:-1] + (self.B,))
-            bins_p[..., off:off + span] = bins
+            bins_p[..., :span] = bins
             bins = bins_p
             counts_p = np.zeros(counts.shape[:-1] + (self.B,), np.int32)
-            counts_p[..., off:off + span] = counts
+            counts_p[..., :span] = counts
             counts = counts_p
         # admission control counts come from the HOST directory (a strict
         # superset of device-resident keys — late-only keys included), so

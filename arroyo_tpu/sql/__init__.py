@@ -4,3 +4,8 @@ from .parser import parse_sql  # noqa: F401
 from .planner import Planner, SqlPlanError, plan_sql  # noqa: F401
 from .schema_provider import SchemaProvider  # noqa: F401
 from .compiler import Schema, SqlCompileError  # noqa: F401
+from .functions import (  # noqa: F401
+    register_udaf,
+    register_udf,
+    unregister_udfs,
+)

@@ -500,6 +500,24 @@ class ExprCompiler:
             self.needs_host = True
             fn = HOST_FUNCTIONS[name]
             return lambda env: fn([a(env) for a in args])
+        from .functions import SCALAR_UDFS
+
+        if name in SCALAR_UDFS:
+            self.needs_host = True
+            udf = SCALAR_UDFS[name]
+
+            def call_udf(env):
+                pairs = [a(env) for a in args]
+                vals = [np.asarray(v) for v, _m in pairs]
+                out = np.asarray(udf(*vals))
+                mask = None
+                for _v, m in pairs:
+                    if m is not None:
+                        mask = np.asarray(m) if mask is None \
+                            else (mask & np.asarray(m))
+                return out, mask
+
+            return call_udf
         raise SqlCompileError(f"unknown function {name}()")
 
 

@@ -37,6 +37,55 @@ def host_fn(name):
     return deco
 
 
+# -- user-defined functions ---------------------------------------------------
+#
+# The analog of the reference's UDF registration into the planner
+# (arroyo-sql/src/lib.rs:196-290) and worker-side execution
+# (operators/mod.rs:347-494, wasmtime there — plain host Python here, the
+# jit-or-callback policy SURVEY #20 prescribes).
+
+SCALAR_UDFS: Dict[str, Callable] = {}
+UDAFS: Dict[str, Callable] = {}
+
+
+# names handled specially by the expression compiler / planner, never
+# present in the function registries but still not shadowable
+_RESERVED_FN_NAMES = {
+    "count", "sum", "min", "max", "avg",  # built-in aggregates
+    "hop", "tumble", "session",  # window assignment markers
+    "date_trunc", "date_part", "extract",  # compiler special cases
+}
+
+
+def _check_udf_name(name: str) -> str:
+    n = name.lower()
+    if (n in DEVICE_FUNCTIONS or n in HOST_FUNCTIONS
+            or n in _RESERVED_FN_NAMES or n in SCALAR_UDFS or n in UDAFS):
+        raise ValueError(f"cannot shadow existing function {name!r}")
+    return n
+
+
+def register_udf(name: str, fn: Callable) -> None:
+    """Register a scalar UDF: ``fn(*cols: np.ndarray) -> np.ndarray``,
+    vectorized over the batch; runs on the host expression path."""
+    SCALAR_UDFS[_check_udf_name(name)] = fn
+
+
+def register_udaf(name: str, fn: Callable) -> None:
+    """Register a user aggregate: ``fn(values: np.ndarray) -> scalar``,
+    applied per group over the non-null input rows.  UDAFs are not
+    mergeable and therefore plan onto buffered window operators only
+    (the reference's two-phase rewrite likewise excludes UDAFs,
+    operators.rs:165-167)."""
+    UDAFS[_check_udf_name(name)] = fn
+
+
+def unregister_udfs() -> None:
+    """Testing hook: clear all user-registered functions."""
+    SCALAR_UDFS.clear()
+    UDAFS.clear()
+
+
 def _all_valid_mask(masks):
     import jax.numpy as jnp
 

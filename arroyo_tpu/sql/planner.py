@@ -60,6 +60,12 @@ from .parser import parse_sql
 from .schema_provider import SchemaProvider, TableDef
 
 AGG_NAMES = {"count", "sum", "min", "max", "avg"}
+
+
+def _is_agg_name(name: str) -> bool:
+    from .functions import UDAFS
+
+    return name in AGG_NAMES or name in UDAFS
 DEFAULT_JOIN_TTL = 3_600_000_000  # 1h, micros
 DEFAULT_UPDATING_TTL = 86_400_000_000  # 1d (reference updating default)
 
@@ -105,7 +111,7 @@ class AggCollector:
 
     def rewrite(self, e: Expr) -> Expr:
         if isinstance(e, FunctionCall):
-            if e.name in AGG_NAMES:
+            if _is_agg_name(e.name):
                 for j, existing in enumerate(self.aggs):
                     if repr(existing) == repr(e):
                         return ColumnRef(f"__agg{j}")
@@ -528,7 +534,7 @@ class Planner:
                 return UnaryOp(e.op, sub_group(e.operand))
             if isinstance(e, Cast):
                 return Cast(sub_group(e.operand), e.target_type)
-            if isinstance(e, FunctionCall) and e.name not in AGG_NAMES:
+            if isinstance(e, FunctionCall) and not _is_agg_name(e.name):
                 return FunctionCall(e.name, [sub_group(a) for a in e.args],
                                     e.distinct)
             return e
@@ -563,6 +569,8 @@ class Planner:
             key_cols.append(col)
             key_kinds[col] = self._infer_kind(e, schema)
 
+        from .functions import UDAFS
+
         aggs: List[AggSpec] = []
         post_fixups: Dict[str, Tuple[str, str]] = {}  # out -> (sum_col, cnt_col)
         int_outputs: List[str] = []
@@ -570,6 +578,25 @@ class Planner:
         for j, fc in enumerate(collector.aggs):
             out = f"__agg{j}"
             arg = fc.args[0] if fc.args else None
+            if fc.name in UDAFS:
+                if window is None:
+                    raise SqlPlanError(
+                        f"UDAF {fc.name}() requires a window: user "
+                        "aggregates are not mergeable, so they cannot run "
+                        "as updating (non-windowed) aggregates")
+                if fc.distinct:
+                    raise SqlPlanError(
+                        f"DISTINCT is not supported with UDAF {fc.name}()")
+                if len(fc.args) != 1:
+                    raise SqlPlanError(
+                        f"UDAF {fc.name}() takes exactly one column "
+                        f"argument, got {len(fc.args)}")
+                needs_generic = True  # buffered path only (not mergeable)
+                col = f"__ain{j}"
+                pre_compiled.append((col, compile_scalar(arg, schema)))
+                aggs.append(AggSpec(AggKind.UDAF, col, out,
+                                    fn=UDAFS[fc.name]))
+                continue
             if fc.distinct:
                 needs_generic = True
                 col = f"__ain{j}"

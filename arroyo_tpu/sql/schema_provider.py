@@ -98,6 +98,22 @@ class SchemaProvider:
     def __init__(self) -> None:
         self.tables: Dict[str, TableDef] = {}
 
+    def register_udf(self, name: str, fn) -> None:
+        """Register a scalar UDF ``fn(*cols: np.ndarray) -> np.ndarray``
+        usable in any SQL expression (arroyo-sql/src/lib.rs:196-290
+        analog; executed on the host expression path)."""
+        from .functions import register_udf
+
+        register_udf(name, fn)
+
+    def register_udaf(self, name: str, fn) -> None:
+        """Register a user aggregate ``fn(values: np.ndarray) -> scalar``,
+        applied per group over non-null rows; windowed aggregations only
+        (not mergeable — operators.rs:165-167 two-phase exclusion)."""
+        from .functions import register_udaf
+
+        register_udaf(name, fn)
+
     def get(self, name: str, default_config: Optional[Dict[str, Any]] = None
             ) -> TableDef:
         n = name.lower()

@@ -1,0 +1,200 @@
+"""SQL UDF / UDAF registration and execution (the reference's Rust-UDF
+registration arroyo-sql/src/lib.rs:196-290 + worker execution
+operators/mod.rs:347-494), including BASELINE.md config #5: session-window
+aggregation with a UDAF over a Kafka source with checkpoint/restore."""
+
+import asyncio
+import json
+
+import numpy as np
+import pytest
+
+from arroyo_tpu import Batch
+from arroyo_tpu.connectors.kafka import InMemoryKafkaBroker
+from arroyo_tpu.connectors.memory import clear_sink, sink_output
+from arroyo_tpu.engine.engine import Engine, LocalRunner
+from arroyo_tpu.sql import (
+    SchemaProvider,
+    SqlPlanError,
+    plan_sql,
+    unregister_udfs,
+)
+from arroyo_tpu.types import StopMode
+
+SEC = 1_000_000
+
+
+@pytest.fixture(autouse=True)
+def _clean_udfs():
+    yield
+    unregister_udfs()
+
+
+def run_sql(sql, provider=None):
+    clear_sink("results")
+    prog = plan_sql(sql, provider)
+    LocalRunner(prog).run()
+    outs = sink_output("results")
+    return Batch.concat(outs) if outs else None
+
+
+def events_table(p, n=200):
+    rng = np.random.default_rng(3)
+    ts = np.sort(rng.integers(0, 3 * SEC, n)).astype(np.int64)
+    p.add_memory_table("events", {"k": "i", "v": "f", "name": "s"}, [
+        Batch(ts, {"k": rng.integers(0, 4, n).astype(np.int64),
+                   "v": rng.random(n).astype(np.float64) * 100,
+                   "name": np.array([f"u{i % 3}" for i in range(n)],
+                                    dtype=object)})])
+    return p
+
+
+def test_scalar_udf_in_projection():
+    p = SchemaProvider()
+    p.register_udf("add_tax", lambda v: v * 1.2)
+    p.register_udf("shout", lambda s: np.array(
+        [x.upper() + "!" if x is not None else None for x in s],
+        dtype=object))
+    events_table(p)
+    out = run_sql("SELECT add_tax(v) as taxed, shout(name) as n2, v "
+                  "FROM events WHERE add_tax(v) > 60", p)
+    assert out is not None and len(out) > 0
+    np.testing.assert_allclose(out.columns["taxed"],
+                               np.asarray(out.columns["v"]) * 1.2,
+                               rtol=1e-6)
+    assert np.all(out.columns["taxed"] > 60)
+    assert set(np.unique(list(out.columns["n2"]))) <= {"U0!", "U1!", "U2!"}
+
+
+def test_udaf_tumbling_window_matches_numpy():
+    p = SchemaProvider()
+    p.register_udaf("median", np.median)
+    p.register_udaf("p90", lambda v: float(np.percentile(v, 90)))
+    events_table(p)
+    out = run_sql(
+        "SELECT k, median(v) as med, p90(v) as p90v, count(*) as cnt "
+        "FROM events GROUP BY k, tumble(interval '1 second')", p)
+    assert out is not None
+    # oracle: recompute per (key, window) from the source batch
+    src = events_table(SchemaProvider()).get("events").config["batches"][0]
+    groups = {}
+    for t, k, v in zip(src.timestamp.tolist(), src.columns["k"].tolist(),
+                       src.columns["v"].tolist()):
+        groups.setdefault((k, (t // SEC + 1) * SEC), []).append(v)
+    for i in range(len(out)):
+        key = (int(out.columns["k"][i]), int(out.columns["window_end"][i]))
+        vals = np.asarray(groups[key])
+        assert out.columns["cnt"][i] == len(vals)
+        assert out.columns["med"][i] == pytest.approx(np.median(vals))
+        assert out.columns["p90v"][i] == pytest.approx(
+            np.percentile(vals, 90))
+
+
+def test_udaf_without_window_rejected():
+    p = SchemaProvider()
+    p.register_udaf("median", np.median)
+    events_table(p)
+    with pytest.raises(SqlPlanError, match="requires a window"):
+        plan_sql("CREATE TABLE out WITH (connector='memory', "
+                 "name='results'); INSERT INTO out "
+                 "SELECT k, median(v) FROM events GROUP BY k", p)
+
+
+def test_udf_cannot_shadow_builtin():
+    p = SchemaProvider()
+    with pytest.raises(ValueError, match="shadow"):
+        p.register_udf("upper", lambda s: s)
+    with pytest.raises(ValueError, match="shadow"):
+        p.register_udaf("sum", np.sum)
+
+
+def test_baseline5_session_udaf_kafka_checkpoint(tmp_path):
+    """BASELINE.md config #5: session-window aggregation with a UDAF over
+    a Kafka source, with a checkpoint + restore in the middle of an OPEN
+    session — the buffered session state must survive the restore and the
+    session must close with every value from both runs."""
+    InMemoryKafkaBroker.reset("u5")
+    broker = InMemoryKafkaBroker.get("u5")
+    broker.create_topic("sess", partitions=1)
+
+    # run-1 events: key 1 session [0.0s, 1.0s], key 2 single at 0.2s
+    run1 = [(1, 10.0, 0), (1, 30.0, 500_000), (2, 5.0, 200_000),
+            (1, 20.0, 1_000_000)]
+    for k, v, ts in run1:
+        broker.produce("sess", json.dumps(
+            {"k": k, "v": v, "ts": ts * 1000}).encode(), partition=0)
+
+    p = SchemaProvider()
+    p.register_udaf("median", np.median)
+    sql = """
+    CREATE TABLE ev (
+      k BIGINT, v DOUBLE, ts BIGINT,
+      event_time TIMESTAMP GENERATED ALWAYS AS
+        (CAST(from_unixtime(ts) as TIMESTAMP))
+    ) WITH (
+      connector = 'kafka', bootstrap_servers = 'memory://u5',
+      topic = 'sess', type = 'source', format = 'json',
+      event_time_field = 'event_time', batch_size = '2'
+    );
+    CREATE TABLE out WITH (connector = 'memory', name = 'results');
+    INSERT INTO out
+    SELECT k, median(v) as med, count(*) as cnt,
+           session(INTERVAL '1' SECOND) as window
+    FROM ev GROUP BY 1, 4
+    """
+    url = f"file://{tmp_path}/ckpt"
+    clear_sink("results")
+
+    async def run_phase(restore, epoch, settle_secs):
+        prog = plan_sql(sql, p)
+        eng = Engine.for_local(prog, "udaf-job", checkpoint_url=url,
+                               restore_epoch=restore)
+        running = eng.start()
+        await asyncio.sleep(settle_secs)  # let the source drain the topic
+        await running.checkpoint(epoch)
+        assert await running.wait_for_checkpoint(epoch)
+        await running.stop(StopMode.IMMEDIATE)
+        try:
+            await running.join()
+        except RuntimeError:
+            pass
+
+    # phase 1: consume the run-1 events, checkpoint with the session OPEN
+    # (max run-1 event time is 1.0s and lateness is 1s, so the watermark
+    # cannot reach any session end — nothing may fire before the restore)
+    asyncio.run(run_phase(None, 1, 0.6))
+    assert not sink_output("results"), "session fired before its gap closed"
+
+    # run-2 events: key 1's session EXTENDS at 1.4s (gap 1s from 1.0s),
+    # then a far event advances the watermark past the session end
+    run2 = [(1, 40.0, 1_400_000), (1, 99.0, 10_000_000)]
+    for k, v, ts in run2:
+        broker.produce("sess", json.dumps(
+            {"k": k, "v": v, "ts": ts * 1000}).encode(), partition=0)
+
+    asyncio.run(run_phase(1, 2, 0.8))
+    out = Batch.concat(sink_output("results"))
+    rows = {}
+    for i in range(len(out)):
+        rows[(int(out.columns["k"][i]),
+              int(out.columns["window_start"][i]))] = (
+            int(out.columns["cnt"][i]), float(out.columns["med"][i]))
+    # key 1 session [0, 2.4s): all four values, including the three
+    # buffered BEFORE the checkpoint -> median(10, 20, 30, 40) = 25
+    assert rows[(1, 0)] == (4, 25.0)
+    # key 2 session [0.2s, 1.2s)
+    assert rows[(2, 200_000)] == (1, 5.0)
+
+
+def test_udaf_distinct_and_arity_rejected():
+    p = SchemaProvider()
+    p.register_udaf("median", np.median)
+    events_table(p)
+    base = ("CREATE TABLE out WITH (connector='memory', name='results');"
+            "INSERT INTO out ")
+    with pytest.raises(SqlPlanError, match="DISTINCT"):
+        plan_sql(base + "SELECT k, median(DISTINCT v) FROM events "
+                 "GROUP BY k, tumble(interval '1 second')", p)
+    with pytest.raises(SqlPlanError, match="exactly one column"):
+        plan_sql(base + "SELECT k, median(v, k) FROM events "
+                 "GROUP BY k, tumble(interval '1 second')", p)

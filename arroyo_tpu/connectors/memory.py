@@ -14,14 +14,23 @@ from ..types import Batch
 from .registry import ConnectorMeta, register_connector
 
 _SINKS: Dict[str, List[Batch]] = {}
+_SINK_ARRIVALS: Dict[str, List[float]] = {}
 
 
 def sink_output(name: str) -> List[Batch]:
     return _SINKS.setdefault(name, [])
 
 
+def sink_arrivals(name: str) -> List[float]:
+    """Wallclock (time.monotonic — same clock the rate-limited sources
+    pace on) arrival time of each sink batch: the measurement end of the
+    bench's end-to-end latency probe."""
+    return _SINK_ARRIVALS.setdefault(name, [])
+
+
 def clear_sink(name: str) -> None:
     _SINKS.pop(name, None)
+    _SINK_ARRIVALS.pop(name, None)
 
 
 class MemorySource(SourceOperator):
@@ -51,7 +60,10 @@ class MemorySink(Operator):
         self.name = cfg.get("name", "default")
 
     async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        import time
+
         sink_output(self.name).append(batch)
+        sink_arrivals(self.name).append(time.monotonic())
 
 
 register_connector(ConnectorMeta(

@@ -81,6 +81,8 @@ class NexmarkConfig(BaseModel):
     generate_strings: bool = True
     rate_limited: bool = True  # False: generate as fast as possible (bench)
     batch_size: Optional[int] = None
+    base_time_micros: Optional[int] = None  # pin event-time origin (bench
+    # latency math needs wall(T) = wall_base + (T - base_time)/1e6 exactly)
 
 
 class NexmarkGenerator:
@@ -333,7 +335,9 @@ class NexmarkSource(SourceOperator):
         if saved is not None:
             base_time, split, count = saved
         else:
-            base_time = now_micros()
+            base_time = (self.cfg.base_time_micros
+                         if self.cfg.base_time_micros is not None
+                         else now_micros())
             split = make_splits(self.cfg, base_time, par)[ctx.task_info.task_index]
             count = 0
 
@@ -345,6 +349,12 @@ class NexmarkSource(SourceOperator):
         batch_size = self.cfg.batch_size or config().target_batch_size
         runner = getattr(ctx, "_runner", None)
         wall_base = _time.monotonic() - (gen.inter_event_delay * count) / 1e6
+        from ..obs import perf
+
+        # anchors for the bench's end-to-end latency math: event with
+        # time T is emitted at wall_base + (T - base_time)/1e6
+        perf.note("nexmark_wall_base", wall_base)
+        perf.note("nexmark_base_time", base_time)
 
         while gen.has_next:
             batch, nums = gen.next_batch(batch_size)

@@ -100,6 +100,14 @@ def coerce_object_col(v: np.ndarray):
     non-null values aren't scalars (strings, lists) return unchanged with
     mask None — those stay on the host path.
     """
+    # fast path: a string in front means a string column — skip the O(n)
+    # scans (if a later row were numeric the column is mixed-type and the
+    # host path is the correct destination anyway)
+    for x in v[:64]:
+        if x is not None:
+            if isinstance(x, str):
+                return v, None
+            break
     mask = np.fromiter((x is not None for x in v), bool, len(v))
     present = [x for x in v if x is not None]
     if not present:

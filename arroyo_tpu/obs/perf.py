@@ -1,0 +1,54 @@
+"""Lightweight performance accounting, enabled by ``ARROYO_TIMING=1``.
+
+Answers the two questions BASELINE.md's protocol needs (and the reference
+answers with pyroscope + prometheus): how much of the wall-clock went to
+device kernels vs the host loop, and what the end-to-end latency
+distribution looks like.  Device time is measured by blocking on the
+kernel result at the call site, so enabling timing serializes dispatch —
+use for measurement runs, not production.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict
+
+_COUNTERS: Dict[str, int] = {}
+_NOTES: Dict[str, Any] = {}
+
+
+def timing_enabled() -> bool:
+    return bool(os.environ.get("ARROYO_TIMING"))
+
+
+def reset() -> None:
+    _COUNTERS.clear()
+    _NOTES.clear()
+
+
+def counter_ns(key: str) -> int:
+    return _COUNTERS.get(key, 0)
+
+
+def note(key: str, value: Any) -> None:
+    _NOTES[key] = value
+
+
+def get_note(key: str, default: Any = None) -> Any:
+    return _NOTES.get(key, default)
+
+
+def timed_device(call, *args):
+    """Run a jitted kernel call; when timing is on, block until the result
+    is ready and account the wall time to the ``device_ns`` counter."""
+    if not timing_enabled():
+        return call(*args)
+    import jax
+
+    t0 = time.perf_counter_ns()
+    out = call(*args)
+    jax.block_until_ready(out)
+    _COUNTERS["device_ns"] = (_COUNTERS.get("device_ns", 0)
+                              + time.perf_counter_ns() - t0)
+    return out

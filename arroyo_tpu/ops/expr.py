@@ -23,6 +23,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+import weakref
+
 from ..types import Batch
 
 _MIN_BUCKET = 256
@@ -40,6 +42,15 @@ def _is_device_dtype(dt: np.dtype) -> bool:
         np.issubdtype(dt, np.number) or np.issubdtype(dt, np.bool_))
 
 
+def _looks_stringy(v: np.ndarray) -> bool:
+    """First non-None value (of a prefix) is a str: the column would stay
+    on the host path rather than coerce to a device dtype."""
+    for x in v[:64]:
+        if x is not None:
+            return isinstance(x, str)
+    return False
+
+
 class CompiledExpr:
     """A ColumnExpr jitted over padded numeric columns.
 
@@ -49,17 +60,31 @@ class CompiledExpr:
     see it but predicate results are AND-ed with it.
     """
 
+    # jitted-executable cache shared process-wide, keyed by the underlying
+    # expression fn (weakly — closures die with their program) and the
+    # batch schema: rebuilding the physical graph from the same logical
+    # program (engine restarts, bench warm runs) reuses compiled kernels
+    _JIT_CACHE = weakref.WeakKeyDictionary()
+
     def __init__(self, name: str, fn: Callable[[Dict[str, Any]], Any]):
         self.name = name
         self.fn = fn
-        self._jitted: Dict[Tuple, Callable] = {}
+        # columns the fn actually reads (attached by the SQL planner from
+        # the compile-time AST; None = unknown, coerce everything)
+        self.used_cols = getattr(fn, "used_cols", None)
+        try:
+            self._jitted = CompiledExpr._JIT_CACHE.setdefault(fn, {})
+        except TypeError:  # non-weakref-able callable: private cache
+            self._jitted = {}
 
     def _get_jitted(self, schema_key: Tuple) -> Callable:
         f = self._jitted.get(schema_key)
         if f is None:
+            fn = self.fn
+
             @jax.jit
             def run(num_cols: Dict[str, jnp.ndarray]):
-                return self.fn(dict(num_cols))
+                return fn(dict(num_cols))
 
             f = run
             self._jitted[schema_key] = f
@@ -70,7 +95,17 @@ class CompiledExpr:
         padded = bucket_size(n)
         num_cols: Dict[str, np.ndarray] = {"__timestamp": batch.timestamp}
         host_cols: Dict[str, np.ndarray] = {}
+        used = self.used_cols
         for k, v in batch.columns.items():
+            if used is not None and k not in used:
+                # untouched by the expression: skip coercion/padding.
+                # STRING-like object columns stay visible for host
+                # passthrough (where they land today); nullable-numeric
+                # object columns would have been coerced-then-dropped by
+                # the projection, so drop them here too.
+                if v.dtype == object and _looks_stringy(v):
+                    host_cols[k] = v
+                continue
             if v.dtype == object:
                 # nullable scalar columns (bool/int with Nones) become a
                 # typed column + __mask_ validity so they can enter jit
@@ -95,7 +130,9 @@ class CompiledExpr:
         }
         schema_key = tuple(sorted((k, str(v.dtype), padded)
                                   for k, v in padded_cols.items()))
-        out = self._get_jitted(schema_key)(padded_cols)
+        from ..obs.perf import timed_device
+
+        out = timed_device(self._get_jitted(schema_key), padded_cols)
         return out, n, host_cols
 
 

@@ -323,9 +323,11 @@ class KeyedBinState:
         for j in range(len(self._ch_kinds)):
             vals[j, :n] = self._channel_input(j, agg_inputs, n)
 
+        from ..obs.perf import timed_device
+
         kernel = _update_kernel(self._ch_kinds, self.C, self.B, npad)
-        self.values, self.counts = kernel(
-            self.values, self.counts, jnp.asarray(slots_p),
+        self.values, self.counts = timed_device(
+            kernel, self.values, self.counts, jnp.asarray(slots_p),
             jnp.asarray(bins_p), jnp.asarray(vals), jnp.asarray(valid))
 
     def _channel_input(self, j: int, agg_inputs: Dict[str, np.ndarray],
@@ -421,9 +423,11 @@ class KeyedBinState:
         lo = self.min_bin if self.min_bin is not None else 0
         bin_ok[:k] = (abs_bins >= lo) & (abs_bins <= self.max_bin)
 
+        from ..obs.perf import timed_device
+
         kernel = _emit_kernel(self._ch_kinds, self.C, self.B, self.W, kpad)
-        outs, cnts = kernel(self.values, self.counts, jnp.asarray(ring),
-                            jnp.asarray(bin_ok))
+        outs, cnts = timed_device(kernel, self.values, self.counts,
+                                  jnp.asarray(ring), jnp.asarray(bin_ok))
         outs = np.asarray(outs)  # [n_aggs, C, kpad]
         cnts = np.asarray(cnts)  # [C, kpad]
 

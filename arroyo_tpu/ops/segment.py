@@ -156,9 +156,11 @@ def segment_aggregate(
     for i, row in enumerate(rows):
         vals[i, :n] = row
 
+    from ..obs.perf import timed_device
+
     kernel = _segment_agg_kernel(npad, spad, tuple(kinds))
-    outs, counts = kernel(jnp.asarray(vals), jnp.asarray(sid_p),
-                          jnp.asarray(valid))
+    outs, counts = timed_device(kernel, jnp.asarray(vals),
+                                jnp.asarray(sid_p), jnp.asarray(valid))
     outs = np.asarray(outs)[:, :n_seg]
     out_cols = dict(distinct_results)
     valid_counts: Dict[str, np.ndarray] = {}

@@ -535,10 +535,12 @@ class MeshKeyedBinState:
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from ..obs.perf import timed_device
+
         shard1 = NamedSharding(self.mesh, P("keys"))
         step = _update_step(self._ch_kinds, self.nk, self.C, self.B, N)
-        self.d_keys, self.d_bins, self.d_counts, self.d_of = step(
-            self.d_keys, self.d_bins, self.d_counts, self.d_of,
+        self.d_keys, self.d_bins, self.d_counts, self.d_of = timed_device(
+            step, self.d_keys, self.d_bins, self.d_counts, self.d_of,
             jax.device_put(jnp.asarray(kh_p), shard1),
             jax.device_put(jnp.asarray(rel_p), shard1),
             jax.device_put(jnp.asarray(vals_p),
@@ -577,9 +579,12 @@ class MeshKeyedBinState:
         import jax
         import jax.numpy as jnp
 
+        from ..obs.perf import timed_device
+
         fire = _fire_step(self._ch_kinds, self.nk, self.C, self.B, self.W)
-        outs, cnts, mask = fire(self.d_keys, self.d_bins, self.d_counts,
-                                jnp.asarray([first_rel, wm_rel], jnp.int32))
+        outs, cnts, mask = timed_device(
+            fire, self.d_keys, self.d_bins, self.d_counts,
+            jnp.asarray([first_rel, wm_rel], jnp.int32))
         # transfer only the fired pane range, not the whole [.., B+W-1]
         k = wm_rel - first_rel + 1
         outs = np.asarray(jax.device_get(outs[:, :, first_rel:first_rel + k]))

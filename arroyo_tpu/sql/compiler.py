@@ -128,6 +128,9 @@ class Compiled:
     fn: Callable[[Dict[str, Any]], MV]
     needs_host: bool = False
     sql: str = ""
+    # physical columns the expression reads (from the compile-time AST):
+    # lets the executor skip coercing/padding untouched columns
+    used_cols: Optional[frozenset] = None
 
 
 def _jnp():
@@ -164,6 +167,7 @@ class ExprCompiler:
     def __init__(self, schema: Schema):
         self.schema = schema
         self.needs_host = False
+        self.used_cols: set = set()
 
     # -- main dispatch ----------------------------------------------------
 
@@ -180,6 +184,7 @@ class ExprCompiler:
         if isinstance(e, ColumnRef):
             kind, target = self.schema.resolve(e)
             if kind == "col":
+                self.used_cols.add(target)
                 if self.schema.is_string(target):
                     self.needs_host = True
                 # temporal columns are int64 epoch micros: jit (x64 off)
@@ -193,6 +198,8 @@ class ExprCompiler:
                 pcpv = ((sd.presence_col, sd.presence_val)
                         if sd is not None and sd.presence_col is not None
                         else None)
+                if pcpv is not None:
+                    self.used_cols.add(pcpv[0])
                 is_str = self.schema.is_string(target)
 
                 def load(env, _t=target, _p=pcpv, _s=is_str):
@@ -219,6 +226,7 @@ class ExprCompiler:
                         f"struct {sd.name} has no presence column; "
                         "use its fields")
                 pc, pv = sd.presence_col, sd.presence_val
+                self.used_cols.add(pc)
                 # a struct used as a value: expose its presence (IS NULL etc.)
                 return lambda env: (env[pc] == pv, None)
             raise SqlCompileError(
@@ -243,6 +251,7 @@ class ExprCompiler:
                 kind, target = self.schema.resolve(inner_e)
                 if kind == "struct":
                     pc, pv = target.presence_col, target.presence_val
+                    self.used_cols.add(pc)
                     if e.negated:
                         return lambda env: (env[pc] == pv, None)
                     return lambda env: (env[pc] != pv, None)
@@ -524,4 +533,4 @@ class ExprCompiler:
 def compile_scalar(e: Expr, schema: Schema, sql: str = "") -> Compiled:
     c = ExprCompiler(schema)
     fn = c.compile(e)
-    return Compiled(fn, c.needs_host, sql)
+    return Compiled(fn, c.needs_host, sql, frozenset(c.used_cols))

@@ -167,6 +167,15 @@ def _wrap_record(compiled: List[Tuple[str, Compiled]], passthrough: List[str]
         # (where x64-disabled JAX would truncate them to int32)
         return out
 
+    # compile-time column footprint -> executor skips untouched columns
+    used = set(passthrough) | {"__timestamp"}
+    for _name, c in compiled:
+        if c.used_cols is None:
+            used = None
+            break
+        used |= c.used_cols
+    if used is not None:
+        fn.used_cols = frozenset(used)
     return fn
 
 
@@ -181,6 +190,8 @@ def _wrap_predicate(compiled: Compiled) -> Callable:
             v = v & m
         return v
 
+    if compiled.used_cols is not None:
+        fn.used_cols = frozenset(compiled.used_cols | {"__timestamp"})
     return fn
 
 
@@ -726,7 +737,7 @@ class Planner:
                 return base, None
             return jnp.asarray(m).astype(jnp.float32), None
 
-        return Compiled(fn, c.needs_host, c.sql)
+        return Compiled(fn, c.needs_host, c.sql, c.used_cols)
 
     @staticmethod
     def _mask_fill(c: Compiled, fill: float) -> Compiled:
@@ -740,7 +751,7 @@ class Planner:
                 return np.where(np.asarray(m), v, fill), None
             return jnp.where(m, v, fill), None
 
-        return Compiled(fn, c.needs_host, c.sql)
+        return Compiled(fn, c.needs_host, c.sql, c.used_cols)
 
     @staticmethod
     def _normalize_key(c: Compiled) -> Compiled:
@@ -753,7 +764,7 @@ class Planner:
                 return v, m
             return jnp.asarray(v).astype(jnp.float32), m
 
-        return Compiled(fn, c.needs_host, c.sql)
+        return Compiled(fn, c.needs_host, c.sql, c.used_cols)
 
     @staticmethod
     def _cast_int(c: Compiled) -> Compiled:
@@ -763,7 +774,7 @@ class Planner:
             v, m = c.fn(env)
             return jnp.asarray(v).astype(jnp.int64), m
 
-        return Compiled(fn, c.needs_host, c.sql)
+        return Compiled(fn, c.needs_host, c.sql, c.used_cols)
 
     # -- TopN --------------------------------------------------------------
 

@@ -156,17 +156,120 @@ def run_query(name: str, sql_template: str) -> dict:
     assert n_out > 0, f"{name} produced no output"
 
     eps = NUM_EVENTS / dt
-    return {
+    result = {
         "metric": f"nexmark_{name}_events_per_sec",
         "value": round(eps, 1),
         "unit": "events/sec",
         "vs_baseline": round(eps / 1_000_000.0, 3),
+    }
+    result.update(device_share(name, sql_template))
+    return result
+
+
+def device_share(name: str, sql_template: str) -> dict:
+    """Host/device wall-time split: re-run a slice of the stream with
+    per-kernel blocking timers (ARROYO_TIMING serializes dispatch, so this
+    runs separately from the throughput measurement)."""
+    from arroyo_tpu.connectors.memory import clear_sink
+    from arroyo_tpu.engine.engine import LocalRunner
+    from arroyo_tpu.obs import perf
+    from arroyo_tpu.sql import plan_sql
+
+    n = min(NUM_EVENTS, 500_000)
+    prog = plan_sql(sql_template.format(n=n, b=BATCH))
+    # warm run of the SAME program first (the jit cache is keyed by the
+    # program's expression fns, so the timed run never counts compiles)
+    clear_sink("results")
+    LocalRunner(prog).run()
+    os.environ["ARROYO_TIMING"] = "1"
+    try:
+        perf.reset()
+        clear_sink("results")
+        t0 = time.perf_counter()
+        LocalRunner(prog).run()
+        dt = time.perf_counter() - t0
+    finally:
+        os.environ.pop("ARROYO_TIMING", None)
+    dev = perf.counter_ns("device_ns") / 1e9
+    return {"device_time_share": round(dev / dt, 3),
+            "host_time_share": round(1 - dev / dt, 3)}
+
+
+LAT_SQL = """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark', event_rate = '{rate}', num_events = '{n}',
+  rate_limited = 'true', batch_size = '{b}', base_time_micros = '{base}'
+);
+SELECT bid.auction as auction,
+       HOP(INTERVAL '2' SECOND, INTERVAL '10' SECOND) as window,
+       count(*) AS num
+FROM nexmark WHERE bid is not null GROUP BY 1, 2
+"""
+
+
+def run_latency() -> dict:
+    """End-to-end p50/p99 latency (BASELINE.md headline): run the q5-shaped
+    hop aggregate against a RATE-LIMITED source and measure, per emitted
+    pane, sink arrival wallclock minus the moment the pane became
+    computable (its window end + allowed lateness reaching the source).
+    """
+    import numpy as np
+
+    from arroyo_tpu.connectors.memory import (
+        clear_sink,
+        sink_arrivals,
+        sink_output,
+    )
+    from arroyo_tpu.engine.engine import LocalRunner
+    from arroyo_tpu.obs import perf
+    from arroyo_tpu.sql import plan_sql
+
+    rate = float(os.environ.get("BENCH_LAT_RATE", 100_000))
+    secs = float(os.environ.get("BENCH_LAT_SECS", 6))
+    lat_batch = min(BATCH, 8192)
+    base = int(time.time() * 1e6)
+    sql = LAT_SQL.format(rate=int(rate), n=int(rate * secs),
+                         b=lat_batch, base=base)
+    prog = plan_sql(sql)
+    # warm run of the same program: compiles must not pollute the
+    # measured latency distribution (jit cache is keyed by program fns)
+    clear_sink("results")
+    LocalRunner(prog).run()
+    perf.reset()
+    clear_sink("results")
+    LocalRunner(prog).run()
+    wall_base = perf.get_note("nexmark_wall_base")
+    base_time = perf.get_note("nexmark_base_time")
+    outs = sink_output("results")
+    arrivals = sink_arrivals("results")
+    # latency per pane = sink arrival minus the wallclock at which the
+    # pane's window closed in real (rate-limited) time; the watermark wait
+    # (lateness + batch granularity) is part of the measured latency
+    samples = []
+    for b, arr in zip(outs, arrivals):
+        wend = np.asarray(b.columns["window_end"], dtype=np.int64)
+        closed = wall_base + (wend - base_time) / 1e6
+        samples.extend((arr - closed).tolist())
+    samples = np.asarray(samples)
+    # the end-of-stream flush emits every still-open pane regardless of
+    # the watermark — those aren't steady-state latency; emission pacing
+    # can also lead schedule by up to one batch, so clip at -0.5s
+    samples = np.maximum(samples[samples > -0.5], 0.0)
+    if not len(samples):
+        return {}
+    return {
+        "latency_p50_ms": round(float(np.percentile(samples, 50)) * 1e3, 1),
+        "latency_p99_ms": round(float(np.percentile(samples, 99)) * 1e3, 1),
+        "latency_rate_events_per_sec": int(rate),
     }
 
 
 def main_child() -> None:
     """The actual benchmark, run inside a supervised subprocess."""
     os.environ.setdefault("BATCH_SIZE", str(BATCH))
+    # pre-size keyed state near the expected Nexmark key cardinality so the
+    # timed run never pays a capacity-growth recompile (config.py hint)
+    os.environ.setdefault("STATE_CAPACITY", str(1 << 15))
     # initialize the jax backend before any asyncio loop runs: the axon
     # TPU-tunnel plugin's device discovery can deadlock when first
     # triggered from inside a running event loop
@@ -192,10 +295,12 @@ def main_child() -> None:
                 headline_result = result
             else:
                 print(json.dumps(result), file=sys.stderr)
+        headline_result.update(run_latency())
         print(json.dumps(headline_result))
     else:
         result = run_query(headline, QUERIES[headline])
         result["backend"] = backend
+        result.update(run_latency())
         print(json.dumps(result))
 
 

@@ -87,7 +87,16 @@ class Job:
 class ControllerServer:
     def __init__(self, scheduler: Optional[Scheduler] = None,
                  host: str = "127.0.0.1"):
-        self.scheduler = scheduler or InProcessScheduler()
+        if scheduler is None:
+            import os
+
+            if os.environ.get("SCHEDULER"):
+                from .scheduler import scheduler_from_env
+
+                scheduler = scheduler_from_env()
+            else:
+                scheduler = InProcessScheduler()
+        self.scheduler = scheduler
         self.host = host
         self.rpc = RpcServer()
         self.jobs: Dict[str, Job] = {}

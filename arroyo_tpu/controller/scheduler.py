@@ -115,3 +115,221 @@ class ProcessScheduler(Scheduler):
     def workers_for_job(self, job_id):
         return [f"pid-{p.pid}" for p in self._procs.get(job_id, [])
                 if p.poll() is None]
+
+
+class KubernetesApiClient:
+    """Minimal in-cluster Kubernetes API client (no external deps): reads
+    the service-account token and talks to the API server over HTTPS.
+    Tests inject a fake with the same three methods."""
+
+    SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+    def __init__(self, api_server: Optional[str] = None,
+                 token: Optional[str] = None,
+                 namespace: Optional[str] = None):
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        self.api_server = api_server or (f"https://{host}:{port}"
+                                         if host else None)
+        self.token = token or self._read(f"{self.SA_DIR}/token")
+        self.namespace = namespace or self._read(
+            f"{self.SA_DIR}/namespace") or "default"
+
+    @staticmethod
+    def _read(path: str) -> Optional[str]:
+        try:
+            with open(path) as f:
+                return f.read().strip()
+        except OSError:
+            return None
+
+    def _request(self, method: str, path: str, body=None) -> dict:
+        import json as _json
+        import ssl
+        import urllib.request
+
+        if not self.api_server:
+            raise RuntimeError(
+                "not running in a Kubernetes cluster "
+                "(KUBERNETES_SERVICE_HOST unset) and no api_server given")
+        req = urllib.request.Request(
+            self.api_server + path, method=method,
+            data=_json.dumps(body).encode() if body is not None else None,
+            headers={"Authorization": f"Bearer {self.token}",
+                     "Content-Type": "application/json"})
+        ctx = ssl.create_default_context(
+            cafile=f"{self.SA_DIR}/ca.crt"
+            if os.path.exists(f"{self.SA_DIR}/ca.crt") else None)
+        with urllib.request.urlopen(req, context=ctx, timeout=30) as r:
+            return _json.loads(r.read() or b"{}")
+
+    def create_replicaset(self, manifest: dict) -> dict:
+        ns = manifest["metadata"]["namespace"]
+        return self._request(
+            "POST", f"/apis/apps/v1/namespaces/{ns}/replicasets", manifest)
+
+    def delete_replicasets(self, namespace: str, label_selector: str) -> dict:
+        return self._request(
+            "DELETE",
+            f"/apis/apps/v1/namespaces/{namespace}/replicasets"
+            f"?labelSelector={label_selector}&propagationPolicy=Background")
+
+    def list_pods(self, namespace: str, label_selector: str) -> dict:
+        return self._request(
+            "GET", f"/api/v1/namespaces/{namespace}/pods"
+            f"?labelSelector={label_selector}")
+
+
+class KubernetesScheduler(Scheduler):
+    """Pod-per-worker scheduling on Kubernetes / GKE TPU pools
+    (kubernetes.rs:28-243 analog).
+
+    One ReplicaSet per (job, run) with ``replicas = n_workers`` worker
+    pods, each advertising the controller-assigned ``slots_per_worker``
+    task slots (``K8S_WORKER_SLOTS`` is the default when the controller
+    does not specify).  On TPU node pools, slots map to chips: set
+    ``K8S_WORKER_TPU_CHIPS`` and the pod requests ``google.com/tpu``
+    resources so the GKE TPU scheduler places one worker per TPU host.
+    Env-templated like every other knob in the system (the reference's
+    K8S_* env family, arroyo-types lib.rs:78-129)."""
+
+    CLUSTER_LABEL = "cluster"
+    JOB_ID_LABEL = "job_id"
+    RUN_ID_LABEL = "run_id"
+
+    def __init__(self, client=None):
+        import json as _json
+
+        self.client = client  # lazily constructed in-cluster if None
+        self.namespace = os.environ.get("K8S_NAMESPACE", "default")
+        self.name = os.environ.get("K8S_WORKER_NAME", "arroyo-tpu") + "-worker"
+        self.image = os.environ.get(
+            "K8S_WORKER_IMAGE", "arroyo-tpu-worker:latest")
+        self.image_pull_policy = os.environ.get(
+            "K8S_WORKER_IMAGE_PULL_POLICY", "IfNotPresent")
+        self.service_account = os.environ.get(
+            "K8S_WORKER_SERVICE_ACCOUNT_NAME", "default")
+        self.labels = _json.loads(os.environ.get("K8S_WORKER_LABELS", "{}"))
+        self.annotations = _json.loads(
+            os.environ.get("K8S_WORKER_ANNOTATIONS", "{}"))
+        self.tpu_chips = int(os.environ.get("K8S_WORKER_TPU_CHIPS", "0"))
+        self.slots_per_pod = int(os.environ.get(
+            "K8S_WORKER_SLOTS", str(self.tpu_chips or 4)))
+        default_res = {"requests": {"cpu": "400m", "memory": "200Mi"}}
+        if self.tpu_chips:
+            default_res["limits"] = {"google.com/tpu": str(self.tpu_chips)}
+        self.resources = _json.loads(os.environ.get(
+            "K8S_WORKER_RESOURCES", _json.dumps(default_res)))
+        self.node_selector = _json.loads(os.environ.get(
+            "K8S_WORKER_NODE_SELECTOR", "{}"))
+        self._jobs: Dict[str, str] = {}  # job_id -> label selector
+
+    def _get_client(self):
+        if self.client is None:
+            self.client = KubernetesApiClient()
+        return self.client
+
+    def make_replicaset(self, job_id: str, controller_addr: str,
+                        n_workers: int, slots_per_worker: int,
+                        run_id: str = "0") -> dict:
+        labels = {
+            self.CLUSTER_LABEL: self.name,
+            self.JOB_ID_LABEL: job_id,
+            self.RUN_ID_LABEL: run_id,
+            **self.labels,
+        }
+        slots = slots_per_worker or self.slots_per_pod
+        if self.tpu_chips and slots != self.tpu_chips:
+            logger.warning(
+                "worker advertises %d slots but pods request %d TPU chips"
+                " — slots should equal chips on TPU pools",
+                slots, self.tpu_chips)
+        env = [
+            {"name": "PROD", "value": "true"},
+            {"name": "TASK_SLOTS", "value": str(slots)},
+            {"name": "JOB_ID", "value": job_id},
+            {"name": "RUN_ID", "value": run_id},
+            {"name": "CONTROLLER_ADDR", "value": controller_addr},
+        ]
+        if self.tpu_chips:
+            # the mesh path shards keyed state over the pod's chips
+            env.append({"name": "ARROYO_MESH", "value": "auto"})
+        name = (f"{self.name}-"
+                f"{job_id.lower().replace('_', '-')}-{run_id}")
+        return {
+            "apiVersion": "apps/v1",
+            "kind": "ReplicaSet",
+            "metadata": {
+                "name": name,
+                "namespace": self.namespace,
+                "labels": labels,
+                "annotations": dict(self.annotations),
+            },
+            "spec": {
+                "replicas": n_workers,
+                "selector": {"matchLabels": {
+                    self.JOB_ID_LABEL: job_id,
+                    self.RUN_ID_LABEL: run_id,
+                }},
+                "template": {
+                    "metadata": {"labels": labels,
+                                 "annotations": dict(self.annotations)},
+                    "spec": {
+                        "nodeSelector": dict(self.node_selector),
+                        "serviceAccountName": self.service_account,
+                        "containers": [{
+                            "name": "worker",
+                            "image": self.image,
+                            "imagePullPolicy": self.image_pull_policy,
+                            "command": ["python", "-m",
+                                        "arroyo_tpu.worker.server"],
+                            "resources": self.resources,
+                            "env": env,
+                            "ports": [
+                                {"containerPort": 6900, "name": "rpc"},
+                                {"containerPort": 6901, "name": "admin"},
+                            ],
+                        }],
+                    },
+                },
+            },
+        }
+
+    async def start_workers(self, job_id, controller_addr, n_workers,
+                            slots_per_worker):
+        rs = self.make_replicaset(job_id, controller_addr, n_workers,
+                                  slots_per_worker)
+        sel = (f"{self.JOB_ID_LABEL}={job_id},"
+               f"{self.RUN_ID_LABEL}="
+               f"{rs['metadata']['labels'][self.RUN_ID_LABEL]}")
+        self._jobs[job_id] = sel
+        await asyncio.get_event_loop().run_in_executor(
+            None, self._get_client().create_replicaset, rs)
+
+    async def stop_workers(self, job_id, force=False):
+        sel = self._jobs.pop(job_id, f"{self.JOB_ID_LABEL}={job_id}")
+        client = self._get_client()
+        await asyncio.get_event_loop().run_in_executor(
+            None, client.delete_replicasets, self.namespace, sel)
+
+    def workers_for_job(self, job_id):
+        sel = self._jobs.get(job_id, f"{self.JOB_ID_LABEL}={job_id}")
+        pods = self._get_client().list_pods(self.namespace, sel)
+        return [p["metadata"]["name"] for p in pods.get("items", [])
+                if p.get("status", {}).get("phase") in ("Running", "Pending")]
+
+
+def scheduler_from_env() -> Scheduler:
+    """SCHEDULER env selection (schedulers/mod.rs:70-76 analog):
+    'process' (default), 'kubernetes'/'k8s', or 'embedded'."""
+    mode = os.environ.get("SCHEDULER", "process").lower()
+    if mode in ("kubernetes", "k8s"):
+        return KubernetesScheduler()
+    if mode in ("embedded", "inprocess"):
+        return InProcessScheduler()
+    if mode in ("process", ""):
+        return ProcessScheduler()
+    # a typo must fail fast, not silently spawn subprocesses in the
+    # controller container
+    raise ValueError(f"unknown SCHEDULER {mode!r}; "
+                     "expected process | kubernetes | embedded")

@@ -204,16 +204,27 @@ class BinAggOperator(Operator):
 def _apply_top_n(batch: Batch, partition_cols: Tuple[str, ...],
                  sort_column: str, max_elements: int) -> Batch:
     """Keep the top ``max_elements`` rows by ``sort_column`` (desc) per
-    partition (rank-within-partition via lexsort)."""
+    partition — one fused device sort over (partition, window) segments
+    (ops/topk.py; SURVEY #14/#15 device top-k).  Tiny batches stay on a
+    host lexsort: kernel dispatch costs more than the sort itself."""
     if len(batch) == 0:
         return batch
     sort_val = batch.columns[sort_column]
     if partition_cols:
         from ..types import hash_columns
 
-        part = hash_columns([batch.columns[c] for c in partition_cols])
+        # the window instance is always part of the partition: TopN ranks
+        # within a window, never across windows
+        cols = [batch.columns[c] for c in partition_cols]
+        if "window_end" in batch.columns:
+            cols.append(batch.columns["window_end"])
+        part = hash_columns(cols)
     else:
         part = batch.columns.get("window_end", np.zeros(len(batch), np.int64))
+    if len(batch) >= 512:
+        from ..ops.topk import segment_top_k
+
+        return batch.select(segment_top_k(part, sort_val, max_elements))
     order = np.lexsort((-np.asarray(sort_val, dtype=np.float64), part))
     part_sorted = np.asarray(part)[order]
     is_start = np.ones(len(order), dtype=bool)
@@ -593,6 +604,71 @@ class JoinWithExpirationOperator(Operator):
         await ctx.broadcast(Message.wm(Watermark.event_time(watermark)))
 
 
+class SemiJoinOperator(Operator):
+    """Streaming semi-join — the executor behind ``x IN (SELECT ...)``:
+    left rows emit EXACTLY ONCE when a matching right key exists (now or
+    within the TTL), never duplicated per right-side match.
+
+    Left rows without a current match wait in a batch buffer; when a right
+    key is seen for the first time, matching buffered left rows emit and
+    leave the buffer.  Right keys live in keyed state with the right TTL.
+    """
+
+    def __init__(self, name: str, left_ttl: int, right_ttl: int):
+        super().__init__(name)
+        self.left_ttl = left_ttl
+        self.right_ttl = right_ttl
+
+    def tables(self) -> List[TableDescriptor]:
+        return [
+            TableDescriptor("l", TableType.BATCH_BUFFER, "left pending",
+                            retention_micros=self.left_ttl),
+            TableDescriptor("r", TableType.KEYED, "right keys seen",
+                            retention_micros=self.right_ttl),
+        ]
+
+    async def on_start(self, ctx: Context) -> None:
+        self.left = ctx.state.get_batch_buffer("l")
+        self.rkeys = ctx.state.get_keyed_state("r")
+
+    def _right_has(self, kh: np.ndarray) -> np.ndarray:
+        uniq = np.unique(kh)
+        known = np.array([self.rkeys.get(int(k)) is not None
+                          for k in uniq])
+        return known[np.searchsorted(uniq, kh)]
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        assert batch.key_hash is not None
+        if side == 0:  # left: emit matches now, buffer the rest
+            mask = self._right_has(batch.key_hash)
+            if mask.any():
+                await ctx.collect(batch.select(mask))
+            if not mask.all():
+                self.left.append(batch.select(~mask))
+            return
+        # right: first sighting of a key releases waiting left rows
+        uniq, first = np.unique(batch.key_hash, return_index=True)
+        fresh = np.array([self.rkeys.get(int(k)) is None for k in uniq])
+        if not fresh.any():
+            return
+        new_keys = uniq[fresh]
+        for k, i in zip(new_keys.tolist(), first[fresh].tolist()):
+            self.rkeys.insert(int(batch.timestamp[i]), int(k), True)
+        pending = self.left.all()
+        if pending is not None and len(pending):
+            m = np.isin(pending.key_hash, new_keys)
+            if m.any():
+                await ctx.collect(pending.select(m))
+                self.left.remove_keys(new_keys)
+
+    async def handle_watermark(self, watermark: int, ctx: Context) -> None:
+        self.left.evict_before(watermark - self.left_ttl)
+        for t, k, _v in self.rkeys.snapshot():
+            if t < watermark - self.right_ttl:
+                self.rkeys.remove(k)
+        await ctx.broadcast(Message.wm(Watermark.event_time(watermark)))
+
+
 class NonWindowAggOperator(Operator):
     """Running per-key aggregates over an updating stream with expiration
     (UpdatingAggregateOperator, updating_aggregate.rs:11-150): each batch
@@ -724,6 +800,9 @@ def _build_window_join(op: LogicalOperator) -> Operator:
 @register_builder(OpKind.JOIN_WITH_EXPIRATION)
 def _build_join_exp(op: LogicalOperator) -> Operator:
     s = op.spec
+    if s.join_type == JoinType.SEMI:
+        return SemiJoinOperator(op.name, s.left_expiration_micros,
+                                s.right_expiration_micros)
     return JoinWithExpirationOperator(op.name, s.left_expiration_micros,
                                       s.right_expiration_micros, s.join_type)
 

@@ -162,6 +162,7 @@ class JoinType(Enum):
     LEFT = "left"
     RIGHT = "right"
     FULL = "full"
+    SEMI = "semi"  # IN (SELECT ...): left rows emit once on first match
 
 
 @dataclass

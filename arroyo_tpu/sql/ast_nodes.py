@@ -69,6 +69,15 @@ class InList(Expr):
 
 
 @dataclass
+class InSubquery(Expr):
+    """``x IN (SELECT c FROM ...)`` — planned as a streaming semi-join."""
+
+    operand: Expr
+    query: "Select"
+    negated: bool = False
+
+
+@dataclass
 class Between(Expr):
     operand: Expr
     low: Expr
@@ -90,10 +99,20 @@ class Cast(Expr):
 
 
 @dataclass
+class OverClause:
+    """OVER (PARTITION BY ... ORDER BY ...) for SQL window functions
+    (ROW_NUMBER — the streaming planner rewrites it into TopN)."""
+
+    partition_by: List[Expr]
+    order_by: List["OrderItem"]
+
+
+@dataclass
 class FunctionCall(Expr):
     name: str  # lowercase
     args: List[Expr]
     distinct: bool = False
+    over: Optional[OverClause] = None
 
     @property
     def is_window_fn(self) -> bool:

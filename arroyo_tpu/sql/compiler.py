@@ -28,6 +28,7 @@ from .ast_nodes import (
     Expr,
     FunctionCall,
     InList,
+    InSubquery,
     IntervalLit,
     IsNull,
     Literal,
@@ -479,6 +480,10 @@ class ExprCompiler:
 
     def _compile_function(self, e: FunctionCall):
         name = e.name
+        if e.over is not None:
+            raise SqlCompileError(
+                f"window function {name}() OVER (...) is only supported "
+                "as the ROW_NUMBER TopN shape")
         if name in ("hop", "tumble", "session"):
             raise SqlCompileError(
                 f"{name}() is only valid in GROUP BY (window assignment)")
@@ -486,20 +491,33 @@ class ExprCompiler:
             raise SqlCompileError(
                 f"aggregate {name}() outside of aggregation context")
         if name == "date_trunc":
+            from .functions import CAL_TRUNC_PRECISIONS
+
             precision = e.args[0]
             if not isinstance(precision, Literal):
                 raise SqlCompileError("date_trunc precision must be a literal")
             inner = self.compile(e.args[1])
             p = str(precision.value).lower()
-            fn = DEVICE_FUNCTIONS["__date_trunc"]
+            if p in CAL_TRUNC_PRECISIONS:
+                # calendar arithmetic (variable month lengths): host path
+                self.needs_host = True
+                fn = HOST_FUNCTIONS["__date_trunc_host"]
+            else:
+                fn = DEVICE_FUNCTIONS["__date_trunc"]
             return lambda env: fn(inner(env), p)
         if name == "date_part" or name == "extract":
+            from .functions import CAL_EXTRACT_FIELDS
+
             fld = e.args[0]
             if not isinstance(fld, Literal):
                 raise SqlCompileError("date_part field must be a literal")
             inner = self.compile(e.args[1])
             f = str(fld.value).lower()
-            fn = DEVICE_FUNCTIONS["__extract"]
+            if f in CAL_EXTRACT_FIELDS:
+                self.needs_host = True
+                fn = HOST_FUNCTIONS["__extract_host"]
+            else:
+                fn = DEVICE_FUNCTIONS["__extract"]
             return lambda env: fn(inner(env), f)
         args = [self.compile(a) for a in e.args]
         if name in DEVICE_FUNCTIONS:

@@ -22,6 +22,14 @@ SECONDS = 1_000_000
 DEVICE_FUNCTIONS: Dict[str, Callable] = {}
 HOST_FUNCTIONS: Dict[str, Callable] = {}
 
+# datetime precisions/fields that need calendar arithmetic (host path).
+# 'week' is calendar too: Postgres truncates to the ISO Monday, not to
+# 7-day buckets from the (Thursday) epoch.
+CAL_TRUNC_PRECISIONS = {"week", "month", "quarter", "year", "decade",
+                        "century"}
+CAL_EXTRACT_FIELDS = {"year", "month", "day", "doy", "quarter", "week",
+                      "isodow", "millennium", "century", "decade"}
+
 
 def device_fn(name):
     def deco(f):
@@ -160,7 +168,8 @@ def _register_datetime():
         "minute": 60 * SECONDS,
         "hour": 3600 * SECONDS,
         "day": 86400 * SECONDS,
-        "week": 7 * 86400 * SECONDS,
+        # no 'week' here: ISO weeks start Monday, the epoch was a Thursday
+        # -> calendar (host) path
     }
 
     def date_trunc_factory(unit_micros):
@@ -178,6 +187,37 @@ def _register_datetime():
 
     DEVICE_FUNCTIONS["__date_trunc"] = date_trunc  # special-cased in compiler
 
+    # calendar-aware precisions (month lengths vary): vectorized host
+    # numpy datetime64 arithmetic; the compiler routes these precisions to
+    # the host path (datetime.rs month/quarter/year parity)
+
+    def date_trunc_host(args, precision: str):
+        v, m = args
+        dt = np.asarray(v, dtype=np.int64).astype("datetime64[us]")
+        p = precision.lower()
+        if p == "week":  # ISO week starts Monday; epoch day 0 was Thursday
+            D = dt.astype("datetime64[D]")
+            dow_mon0 = (D.astype(np.int64) + 3) % 7
+            t = D - dow_mon0
+        elif p == "month":
+            t = dt.astype("datetime64[M]")
+        elif p == "quarter":
+            mo = dt.astype("datetime64[M]").astype(np.int64)
+            t = ((mo // 3) * 3).astype("datetime64[M]")
+        elif p == "year":
+            t = dt.astype("datetime64[Y]")
+        elif p == "decade":
+            y = dt.astype("datetime64[Y]").astype(np.int64) + 1970
+            t = ((y // 10) * 10 - 1970).astype("datetime64[Y]")
+        elif p == "century":
+            y = dt.astype("datetime64[Y]").astype(np.int64) + 1970
+            t = (((y - 1) // 100) * 100 + 1 - 1970).astype("datetime64[Y]")
+        else:
+            raise ValueError(f"unsupported date_trunc precision {p}")
+        return t.astype("datetime64[us]").astype(np.int64), m
+
+    HOST_FUNCTIONS["__date_trunc_host"] = date_trunc_host
+
     def extract(args, field: str):
         v, m = args
         f = field.lower()
@@ -194,6 +234,43 @@ def _register_datetime():
         raise ValueError(f"extract field {f} requires host path")
 
     DEVICE_FUNCTIONS["__extract"] = extract
+
+    def extract_host(args, field: str):
+        v, m = args
+        dt = np.asarray(v, dtype=np.int64).astype("datetime64[us]")
+        f = field.lower()
+        Y = dt.astype("datetime64[Y]")
+        year = Y.astype(np.int64) + 1970
+        if f == "year":
+            return year, m
+        mo = dt.astype("datetime64[M]").astype(np.int64)
+        month = mo % 12 + 1
+        if f == "month":
+            return month, m
+        if f == "quarter":
+            return (month - 1) // 3 + 1, m
+        D = dt.astype("datetime64[D]")
+        if f == "day":
+            return ((D - dt.astype("datetime64[M]").astype("datetime64[D]"))
+                    .astype(np.int64) + 1), m
+        if f == "doy":
+            return (D - Y.astype("datetime64[D]")).astype(np.int64) + 1, m
+        if f == "isodow":  # Monday=1..Sunday=7
+            return (D.astype(np.int64) + 3) % 7 + 1, m
+        if f == "week":  # ISO 8601 week number
+            import pandas as pd
+
+            idx = pd.to_datetime(dt)
+            return idx.isocalendar().week.to_numpy().astype(np.int64), m
+        if f == "decade":
+            return year // 10, m
+        if f == "century":
+            return (year - 1) // 100 + 1, m
+        if f == "millennium":
+            return (year - 1) // 1000 + 1, m
+        raise ValueError(f"unsupported extract field {f}")
+
+    HOST_FUNCTIONS["__extract_host"] = extract_host
 
     def from_unixtime(args):
         # nanoseconds -> micros timestamp (reference from_unixtime takes ns)

@@ -19,6 +19,7 @@ from .ast_nodes import (
     Expr,
     FunctionCall,
     InList,
+    InSubquery,
     Insert,
     IntervalLit,
     IsNull,
@@ -27,6 +28,7 @@ from .ast_nodes import (
     Literal,
     NamedTable,
     OrderItem,
+    OverClause,
     Select,
     SelectItem,
     Star,
@@ -361,6 +363,11 @@ class Parser:
             elif self.at_kw("in"):
                 self.next()
                 self.expect_op("(")
+                if self.at_kw("select", "with"):
+                    q = self.parse_select()
+                    self.expect_op(")")
+                    left = InSubquery(left, q)
+                    continue
                 items = [self.parse_expr()]
                 while self.eat_op(","):
                     items.append(self.parse_expr())
@@ -379,6 +386,11 @@ class Parser:
                 self.next()
                 if self.eat_kw("in"):
                     self.expect_op("(")
+                    if self.at_kw("select", "with"):
+                        q = self.parse_select()
+                        self.expect_op(")")
+                        left = InSubquery(left, q, negated=True)
+                        continue
                     items = [self.parse_expr()]
                     while self.eat_op(","):
                         items.append(self.parse_expr())
@@ -534,7 +546,31 @@ class Parser:
             while self.eat_op(","):
                 args.append(self.parse_expr())
         self.expect_op(")")
-        return FunctionCall(name.lower(), args, distinct)
+        over = None
+        if self.eat_kw("over"):
+            self.expect_op("(")
+            partition: List[Expr] = []
+            if self.eat_kw("partition"):
+                self.expect_kw("by")
+                partition.append(self.parse_expr())
+                while self.eat_op(","):
+                    partition.append(self.parse_expr())
+            order: List[OrderItem] = []
+            if self.eat_kw("order"):
+                self.expect_kw("by")
+                while True:
+                    e = self.parse_expr()
+                    desc = False
+                    if self.eat_kw("desc"):
+                        desc = True
+                    else:
+                        self.eat_kw("asc")
+                    order.append(OrderItem(e, desc))
+                    if not self.eat_op(","):
+                        break
+            self.expect_op(")")
+            over = OverClause(partition, order)
+        return FunctionCall(name.lower(), args, distinct, over)
 
 
 def parse_sql(sql: str) -> List:

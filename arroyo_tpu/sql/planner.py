@@ -35,6 +35,7 @@ from ..graph.logical import (
 )
 from .ast_nodes import (
     BinaryOp,
+    InSubquery,
     Case,
     Cast,
     ColumnRef,
@@ -111,6 +112,14 @@ class AggCollector:
 
     def rewrite(self, e: Expr) -> Expr:
         if isinstance(e, FunctionCall):
+            if e.over is not None:
+                # the ROW_NUMBER TopN shape is rewritten before planning;
+                # any OVER clause reaching here would be silently treated
+                # as a plain aggregate — reject instead
+                raise SqlPlanError(
+                    f"window function {e.name}() OVER (...) is only "
+                    "supported as ROW_NUMBER() OVER (PARTITION BY window "
+                    "ORDER BY col DESC) with an outer rank filter")
             if _is_agg_name(e.name):
                 for j, existing in enumerate(self.aggs):
                     if repr(existing) == repr(e):
@@ -273,11 +282,22 @@ class Planner:
 
         if sel.from_ is None:
             raise SqlPlanError("SELECT without FROM is not a stream")
-        upstream = self._plan_table_ref(sel.from_, prog, scope)
+        # canonical ROW_NUMBER TopN: FROM (SELECT ..., ROW_NUMBER() OVER
+        # (PARTITION BY window ORDER BY x DESC) rn FROM ...) WHERE rn <= k
+        rewritten = self._rewrite_rownumber_topn(sel, prog, scope)
+        if rewritten is not None:
+            upstream, remaining_where = rewritten
+        else:
+            upstream = self._plan_table_ref(sel.from_, prog, scope)
+            remaining_where = sel.where
 
-        # WHERE
-        if sel.where is not None:
-            upstream = self._filter(upstream, sel.where, "where")
+        # WHERE: IN (SELECT ...) conjuncts become semi-joins, the rest a
+        # filter
+        if remaining_where is not None:
+            upstream, remaining_where = self._apply_in_subqueries(
+                upstream, remaining_where, prog, scope)
+        if remaining_where is not None:
+            upstream = self._filter(upstream, remaining_where, "where")
 
         if _has_aggregates(sel):
             planned = self._plan_aggregate(sel, upstream)
@@ -778,7 +798,147 @@ class Planner:
 
     # -- TopN --------------------------------------------------------------
 
-    def _plan_top_n(self, sel: Select, planned: Planned) -> Planned:
+    def _apply_in_subqueries(self, planned: Planned, where: Expr,
+                             prog: Program, scope: Dict[str, Planned]):
+        """``x IN (SELECT c FROM ...)`` conjuncts -> streaming semi-joins
+        (left rows emit exactly once on a TTL'd right-key match); returns
+        (planned, remaining predicate or None)."""
+        def conjuncts(e):
+            if isinstance(e, BinaryOp) and e.op == "and":
+                return conjuncts(e.left) + conjuncts(e.right)
+            return [e]
+
+        subs = []
+        rest = []
+        for c in conjuncts(where):
+            (subs if isinstance(c, InSubquery) else rest).append(c)
+        if not subs:
+            return planned, where
+
+        for e in subs:
+            if e.negated:
+                raise SqlPlanError(
+                    "NOT IN (SELECT ...) is not supported in streaming SQL")
+            sub = self.plan_select(e.query, prog, scope)
+            sub_cols = [c for c in sub.schema.columns
+                        if not c.startswith("__")
+                        and c not in ("window_start", "window_end")]
+            if len(sub_cols) != 1:
+                raise SqlPlanError(
+                    "IN (SELECT ...) subquery must produce exactly one "
+                    f"column, got {sub_cols}")
+            lkey = self._normalize_key(
+                compile_scalar(e.operand, planned.schema))
+            rkey = self._normalize_key(
+                compile_scalar(ColumnRef(sub_cols[0]), sub.schema))
+            lcols = [c for c in planned.schema.columns
+                     if not c.startswith("__")]
+            lstream = planned.stream.map(
+                _wrap_record([("__sk", lkey)], lcols),
+                name=f"semi_lkey_{self._next_id()}").key_by("__sk")
+            rstream = sub.stream.map(
+                _wrap_record([("__sk", rkey)], []),
+                name=f"semi_rkey_{self._next_id()}").key_by("__sk")
+            out = lstream.join_with_expiration(
+                rstream, DEFAULT_JOIN_TTL, DEFAULT_JOIN_TTL, JoinType.SEMI,
+                name=f"semi_join_{self._next_id()}")
+            out = out.map(_wrap_record([], lcols),
+                          name=f"semi_drop_{self._next_id()}")
+            planned = Planned(out, planned.schema)
+
+        rem = None
+        for c in rest:
+            rem = c if rem is None else BinaryOp("and", rem, c)
+        return planned, rem
+
+    def _rewrite_rownumber_topn(self, sel: Select, prog: Program,
+                                scope: Dict[str, Planned]):
+        """ROW_NUMBER() OVER (PARTITION BY window ORDER BY x DESC) with an
+        outer rank filter -> per-window TopN (the reference's window-TopN
+        rewrite recognizes exactly this shape, optimizations.rs:293-501).
+        Returns (planned-after-topn, remaining where) or None."""
+        from dataclasses import replace as _replace
+
+        if not isinstance(sel.from_, DerivedTable) or sel.where is None:
+            return None
+        inner = sel.from_.query
+        rn_items = [(i, it) for i, it in enumerate(inner.items)
+                    if isinstance(it.expr, FunctionCall)
+                    and it.expr.name == "row_number"
+                    and it.expr.over is not None]
+        if not rn_items:
+            return None
+        if len(rn_items) > 1:
+            raise SqlPlanError("only one ROW_NUMBER() per query is supported")
+        idx, rn_item = rn_items[0]
+        rn_alias = (rn_item.alias or "row_number").lower()
+        over = rn_item.expr.over
+
+        # outer WHERE: find `rn <= k` / `rn < k` among top-level conjuncts
+        def conjuncts(e):
+            if isinstance(e, BinaryOp) and e.op == "and":
+                return conjuncts(e.left) + conjuncts(e.right)
+            return [e]
+
+        limit = None
+        remaining = []
+        for c in conjuncts(sel.where):
+            if (limit is None and isinstance(c, BinaryOp)
+                    and c.op in ("<=", "<")
+                    and isinstance(c.left, ColumnRef)
+                    and c.left.name.lower() == rn_alias
+                    and isinstance(c.right, Literal)
+                    and c.right.type == "int"):
+                limit = c.right.value if c.op == "<=" else c.right.value - 1
+            else:
+                remaining.append(c)
+        if limit is None:
+            raise SqlPlanError(
+                "ROW_NUMBER() requires an outer rank bound "
+                f"(WHERE {rn_alias} <= k) in streaming SQL")
+        if not over.order_by or len(over.order_by) != 1 \
+                or not isinstance(over.order_by[0].expr, ColumnRef):
+            raise SqlPlanError(
+                "ROW_NUMBER() OVER requires ORDER BY a single column")
+        if not over.order_by[0].desc:
+            raise SqlPlanError("streaming TopN requires ORDER BY ... DESC")
+
+        inner2 = _replace(inner, items=[it for i, it in
+                                        enumerate(inner.items) if i != idx])
+        planned = self.plan_select(inner2, prog, scope)
+        if sel.from_.alias:
+            schema = planned.schema.clone()
+            schema.aliases.add(sel.from_.alias)
+            planned = Planned(planned.stream, schema,
+                              planned.agg_node, planned.agg_map)
+
+        # partition must include the window; extra simple columns ride as
+        # TopN partition columns
+        part_cols: List[str] = []
+        saw_window = False
+        for pe in over.partition_by:
+            if self._is_window_ref(pe, planned.schema):
+                saw_window = True
+            elif isinstance(pe, ColumnRef):
+                part_cols.append(pe.name.lower())
+            else:
+                raise SqlPlanError(
+                    "ROW_NUMBER() PARTITION BY supports the window and "
+                    "simple columns")
+        if not saw_window:
+            raise SqlPlanError(
+                "ROW_NUMBER() in streaming SQL must PARTITION BY the "
+                "window (unbounded ranking is not supported)")
+
+        shim = Select(items=[], order_by=[over.order_by[0]], limit=limit)
+        planned = self._plan_top_n(shim, planned, tuple(part_cols))
+        rem = None
+        for c in remaining:
+            rem = c if rem is None else BinaryOp("and", rem, c)
+        return planned, rem
+
+    def _plan_top_n(self, sel: Select, planned: Planned,
+                    partition_cols: Tuple[str, ...] = ()) -> Planned:
         """ORDER BY ... LIMIT n over a windowed stream -> per-window TopN
         (the reference's window-TopN rewrite, optimizations.rs:293-501).
 
@@ -823,7 +983,8 @@ class Planner:
             node.operator.kind = OpKind.SLIDING_AGGREGATING_TOP_N
             node.operator.spec = SlidingAggregatingTopNSpec(
                 width_micros=spec.width_micros, slide_micros=slide,
-                aggs=spec.aggs, partition_cols=(), sort_column=sort_col,
+                aggs=spec.aggs, partition_cols=partition_cols,
+                sort_column=sort_col,
                 max_elements=sel.limit, projection=spec.projection)
             # local (per key range) top-N pruning done; the global merge
             # stage below is always kept — the aggregate's parallelism can
@@ -835,7 +996,7 @@ class Planner:
         stream = stream._chain(LogicalOperator(
             OpKind.TUMBLING_TOP_N, f"topn_{self._next_id()}",
             spec=TopNSpec(width_micros=1, max_elements=sel.limit,
-                          sort_column=col, partition_cols=())),
+                          sort_column=col, partition_cols=partition_cols)),
             parallelism=1)
         stream.program.node(stream.tail).max_parallelism = 1
         return Planned(stream, planned.schema)

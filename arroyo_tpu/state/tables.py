@@ -281,6 +281,15 @@ class BatchBuffer:
     def all(self) -> Optional[Batch]:
         return self._consolidate()
 
+    def remove_keys(self, key_hashes: np.ndarray) -> None:
+        """Drop buffered rows whose key_hash is in ``key_hashes`` (used by
+        the semi-join: matched-and-emitted left rows leave the buffer)."""
+        m = self._consolidate()
+        if m is None or len(m) == 0 or m.key_hash is None:
+            return
+        keep = ~np.isin(m.key_hash, key_hashes)
+        self._merged = m.select(keep) if not keep.all() else m
+
     def __len__(self) -> int:
         m = self._consolidate()
         return len(m) if m is not None else 0

@@ -1,0 +1,102 @@
+"""KubernetesScheduler against a fake API (the reference tests its
+ReplicaSet construction the same way, kubernetes.rs:245-343)."""
+
+import asyncio
+
+import pytest
+
+from arroyo_tpu.controller.scheduler import (
+    InProcessScheduler,
+    KubernetesScheduler,
+    ProcessScheduler,
+    scheduler_from_env,
+)
+
+
+class FakeK8sApi:
+    def __init__(self):
+        self.created = []
+        self.deleted = []
+        self.pods = []
+
+    def create_replicaset(self, manifest):
+        self.created.append(manifest)
+        return manifest
+
+    def delete_replicasets(self, namespace, label_selector):
+        self.deleted.append((namespace, label_selector))
+        return {}
+
+    def list_pods(self, namespace, label_selector):
+        return {"items": self.pods}
+
+
+def test_replicaset_manifest_shape(monkeypatch):
+    monkeypatch.setenv("K8S_NAMESPACE", "streaming")
+    monkeypatch.setenv("K8S_WORKER_IMAGE", "registry/worker:v2")
+    monkeypatch.setenv("K8S_WORKER_LABELS", '{"team": "data"}')
+    api = FakeK8sApi()
+    s = KubernetesScheduler(client=api)
+    asyncio.run(s.start_workers("job_ab", "http://ctl:9190", 3, 4))
+
+    assert len(api.created) == 1
+    rs = api.created[0]
+    assert rs["kind"] == "ReplicaSet"
+    assert rs["metadata"]["namespace"] == "streaming"
+    assert rs["metadata"]["labels"]["job_id"] == "job_ab"
+    assert rs["metadata"]["labels"]["team"] == "data"
+    assert "_" not in rs["metadata"]["name"]  # k8s name rules
+    assert rs["spec"]["replicas"] == 3
+    assert rs["spec"]["selector"]["matchLabels"]["job_id"] == "job_ab"
+    c = rs["spec"]["template"]["spec"]["containers"][0]
+    assert c["image"] == "registry/worker:v2"
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["JOB_ID"] == "job_ab"
+    assert env["CONTROLLER_ADDR"] == "http://ctl:9190"
+    assert env["TASK_SLOTS"] == "4"
+
+
+def test_tpu_pool_slots_map_to_chips(monkeypatch):
+    """TPU node pools: slots = chips; the pod requests google.com/tpu so
+    GKE places one worker per TPU host, and the worker's mesh path shards
+    state over its chips (SURVEY #34: 'slots = chips')."""
+    monkeypatch.setenv("K8S_WORKER_TPU_CHIPS", "8")
+    api = FakeK8sApi()
+    s = KubernetesScheduler(client=api)
+    assert s.slots_per_pod == 8
+    asyncio.run(s.start_workers("j1", "http://ctl:9190", 2, 8))
+    c = api.created[0]["spec"]["template"]["spec"]["containers"][0]
+    assert c["resources"]["limits"]["google.com/tpu"] == "8"
+    env = {e["name"]: e["value"] for e in c["env"]}
+    assert env["ARROYO_MESH"] == "auto"
+
+
+def test_stop_and_list_workers(monkeypatch):
+    api = FakeK8sApi()
+    s = KubernetesScheduler(client=api)
+    asyncio.run(s.start_workers("j2", "http://ctl:9190", 2, 4))
+    api.pods = [
+        {"metadata": {"name": "w-1"}, "status": {"phase": "Running"}},
+        {"metadata": {"name": "w-2"}, "status": {"phase": "Pending"}},
+        {"metadata": {"name": "w-3"}, "status": {"phase": "Failed"}},
+    ]
+    assert s.workers_for_job("j2") == ["w-1", "w-2"]
+    asyncio.run(s.stop_workers("j2"))
+    ns, sel = api.deleted[0]
+    assert ns == "default" and "job_id=j2" in sel
+
+
+def test_scheduler_from_env(monkeypatch):
+    monkeypatch.setenv("SCHEDULER", "k8s")
+    assert isinstance(scheduler_from_env(), KubernetesScheduler)
+    monkeypatch.setenv("SCHEDULER", "embedded")
+    assert isinstance(scheduler_from_env(), InProcessScheduler)
+    monkeypatch.delenv("SCHEDULER")
+    assert isinstance(scheduler_from_env(), ProcessScheduler)
+
+
+def test_out_of_cluster_fails_loudly(monkeypatch):
+    monkeypatch.delenv("KUBERNETES_SERVICE_HOST", raising=False)
+    s = KubernetesScheduler()  # no client injected
+    with pytest.raises(RuntimeError, match="Kubernetes"):
+        asyncio.run(s.start_workers("j3", "http://ctl:9190", 1, 1))

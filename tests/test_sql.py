@@ -462,3 +462,150 @@ def test_exec_absolute_micros_int64_exact():
     assert list(out.columns["dt"]) == list(big)
     assert list(out.columns["id1"]) == [int(i) + 1 for i in ids]
     assert out.columns["id"].dtype == np.int64
+
+
+def test_exec_row_number_topn_canonical_q5():
+    """The canonical Nexmark q5 shape: ROW_NUMBER() OVER (PARTITION BY
+    window ORDER BY num DESC) with an outer rank filter rewrites into the
+    fused windowed TopN (optimizations.rs:293-501 analog)."""
+    import collections
+
+    rng = np.random.default_rng(23)
+    n = 4000
+    ts = np.sort(rng.integers(0, 6 * SEC, n)).astype(np.int64)
+    keys = rng.integers(0, 30, n).astype(np.int64)
+    p = SchemaProvider()
+    p.add_memory_table("bids", {"auction": "i"}, [
+        Batch(ts, {"auction": keys})])
+    out = run_sql("""
+        CREATE TABLE out WITH (connector='memory', name='results');
+        INSERT INTO out
+        SELECT auction, num, window FROM (
+          SELECT B1.auction, count(*) AS num,
+                 HOP(INTERVAL '2' SECOND, INTERVAL '4' SECOND) as window,
+                 ROW_NUMBER() OVER (PARTITION BY window
+                                    ORDER BY num DESC) as rn
+          FROM bids B1 GROUP BY 1, 3
+        ) WHERE rn <= 3
+    """, p)
+    assert out is not None and len(out) > 0
+    # per window at most 3 rows, and they are the true top-3 counts
+    want = collections.defaultdict(collections.Counter)
+    for t, k in zip(ts.tolist(), keys.tolist()):
+        e = (t // (2 * SEC) + 1) * 2 * SEC
+        for w in range(2):
+            want[e + w * 2 * SEC][k] += 1
+    per_w = collections.defaultdict(list)
+    for i in range(len(out)):
+        per_w[int(out.columns["window_end"][i])].append(
+            int(out.columns["num"][i]))
+    assert per_w
+    for wend, nums in per_w.items():
+        assert len(nums) <= 3
+        top = sorted(want[wend].values(), reverse=True)[:3]
+        assert sorted(nums, reverse=True) == top, (wend, nums, top)
+
+
+def test_row_number_requires_rank_bound():
+    p = SchemaProvider()
+    p.add_memory_table("b", {"a": "i"}, [
+        Batch(np.arange(3, dtype=np.int64), {"a": np.arange(3)})])
+    with pytest.raises(Exception, match="rank bound|row_number|rn"):
+        plan_sql("""
+        SELECT a FROM (
+          SELECT a, count(*) as num, TUMBLE(INTERVAL '1' SECOND) as window,
+                 ROW_NUMBER() OVER (PARTITION BY window
+                                    ORDER BY num DESC) as rn
+          FROM b GROUP BY 1, 3) WHERE num > 0
+        """, p)
+
+
+def test_exec_calendar_datetime_functions():
+    """Calendar-aware date_trunc/extract (month/quarter/year/doy/week) —
+    the round-1 'requires host path' gaps, verified against python
+    datetime."""
+    import datetime as dtm
+
+    days = [dtm.datetime(2023, 1, 1), dtm.datetime(2023, 3, 31),
+            dtm.datetime(2024, 2, 29), dtm.datetime(2024, 12, 31),
+            dtm.datetime(2021, 7, 4, 13, 45, 59)]
+    micros = np.array([int(d.replace(tzinfo=dtm.timezone.utc).timestamp()
+                           * 1e6) for d in days], dtype=np.int64)
+    p = SchemaProvider()
+    p.add_memory_table("t", {"ts_col": "t"}, [
+        Batch(np.arange(5, dtype=np.int64), {"ts_col": micros})])
+    out = run_sql(
+        "SELECT date_trunc('month', ts_col) as tm, "
+        "date_trunc('quarter', ts_col) as tq, "
+        "date_trunc('year', ts_col) as ty, "
+        "extract('year', ts_col) as y, extract('month', ts_col) as mo, "
+        "extract('day', ts_col) as d, extract('doy', ts_col) as doy, "
+        "extract('quarter', ts_col) as q, extract('week', ts_col) as w "
+        "FROM t", p)
+    for i, d in enumerate(days):
+        utc = d.replace(tzinfo=dtm.timezone.utc)
+        assert int(out.columns["y"][i]) == d.year
+        assert int(out.columns["mo"][i]) == d.month
+        assert int(out.columns["d"][i]) == d.day
+        assert int(out.columns["doy"][i]) == d.timetuple().tm_yday
+        assert int(out.columns["q"][i]) == (d.month - 1) // 3 + 1
+        assert int(out.columns["w"][i]) == d.isocalendar()[1]
+        tm = dtm.datetime(d.year, d.month, 1, tzinfo=dtm.timezone.utc)
+        assert int(out.columns["tm"][i]) == int(tm.timestamp() * 1e6)
+        tq = dtm.datetime(d.year, (d.month - 1) // 3 * 3 + 1, 1,
+                          tzinfo=dtm.timezone.utc)
+        assert int(out.columns["tq"][i]) == int(tq.timestamp() * 1e6)
+        ty = dtm.datetime(d.year, 1, 1, tzinfo=dtm.timezone.utc)
+        assert int(out.columns["ty"][i]) == int(ty.timestamp() * 1e6)
+
+
+def test_exec_in_subquery_semi_join():
+    """x IN (SELECT ...) plans as a streaming semi-join: left rows emit
+    exactly once on a match — never duplicated per right-side row."""
+    p = SchemaProvider()
+    lts = np.arange(6, dtype=np.int64) * 100
+    p.add_memory_table("bids", {"auction": "i", "price": "i"}, [
+        Batch(lts, {"auction": np.array([1, 2, 3, 4, 2, 9]),
+                    "price": np.array([10, 20, 30, 40, 21, 90])})])
+    # auction 2 appears TWICE on the right; auctions 5, 6 never on left
+    p.add_memory_table("hot", {"a": "i"}, [
+        Batch(np.arange(4, dtype=np.int64) * 100,
+              {"a": np.array([2, 3, 2, 5])})])
+    out = run_sql("SELECT auction, price FROM bids "
+                  "WHERE auction IN (SELECT a FROM hot)", p)
+    pairs = sorted(zip(out.columns["auction"].tolist(),
+                       out.columns["price"].tolist()))
+    assert pairs == [(2, 20), (2, 21), (3, 30)]
+    assert "__sk" not in out.columns
+
+
+def test_not_in_subquery_rejected():
+    p = SchemaProvider()
+    p.add_memory_table("t", {"a": "i"}, [
+        Batch(np.arange(2, dtype=np.int64), {"a": np.arange(2)})])
+    from arroyo_tpu.sql import SqlPlanError
+    with pytest.raises(SqlPlanError, match="NOT IN"):
+        plan_sql("SELECT a FROM t WHERE a NOT IN (SELECT a FROM t)", p)
+
+
+def test_unsupported_over_rejected():
+    """Any OVER clause outside the ROW_NUMBER TopN shape is an error,
+    never silently planned as a plain aggregate."""
+    p = events_table(SchemaProvider())
+    with pytest.raises(Exception, match="OVER"):
+        plan_sql("SELECT k, sum(v) OVER (PARTITION BY k) as s, "
+                 "TUMBLE(INTERVAL '1' SECOND) as w FROM events "
+                 "GROUP BY 1, 3", p)
+
+
+def test_date_trunc_week_iso_monday():
+    import datetime as dtm
+
+    wed = dtm.datetime(2023, 1, 4, tzinfo=dtm.timezone.utc)  # Wednesday
+    p = SchemaProvider()
+    p.add_memory_table("t", {"ts_col": "t"}, [
+        Batch(np.zeros(1, dtype=np.int64),
+              {"ts_col": np.array([int(wed.timestamp() * 1e6)])})])
+    out = run_sql("SELECT date_trunc('week', ts_col) as w FROM t", p)
+    monday = dtm.datetime(2023, 1, 2, tzinfo=dtm.timezone.utc)
+    assert int(out.columns["w"][0]) == int(monday.timestamp() * 1e6)

@@ -289,3 +289,22 @@ def test_null_skipping_aggregates(rng):
             assert int(out.columns["lo"][i]) == mn
             assert int(out.columns["hi"][i]) == mx
     assert seen == set(exp)
+
+
+def test_device_topk_matches_host_lexsort(rng):
+    """ops/topk.segment_top_k == the host lexsort rank-per-partition, at
+    sizes crossing the device-dispatch threshold, with ties."""
+    from arroyo_tpu.ops.topk import segment_top_k
+
+    for n, k in [(700, 3), (4096, 5), (513, 1)]:
+        part = rng.integers(0, 37, n).astype(np.int64)
+        vals = rng.integers(0, 50, n).astype(np.int64)  # plenty of ties
+        got = segment_top_k(part, vals, k)
+        order = np.lexsort((-vals.astype(np.float64), part))
+        ps = part[order]
+        is_start = np.ones(n, dtype=bool)
+        is_start[1:] = ps[1:] != ps[:-1]
+        seg_id = np.cumsum(is_start) - 1
+        rank = np.arange(n) - is_start.nonzero()[0][seg_id]
+        exp = np.sort(order[rank < k])
+        np.testing.assert_array_equal(got, exp)

@@ -73,12 +73,16 @@ class Router:
     def __init__(self) -> None:
         # method -> list of (compiled path regex, handler)
         self.routes: Dict[str, list] = {}
+        # (method, raw pattern, handler) in registration order — the
+        # OpenAPI generator reads this
+        self.patterns: list = []
 
     def route(self, method: str, pattern: str, handler: Handler) -> None:
         # '/v1/pipelines/{id}/jobs' -> named groups
         rx = re.sub(r"\{(\w+)\}", r"(?P<\1>[^/]+)", pattern)
         self.routes.setdefault(method.upper(), []).append(
             (re.compile(f"^{rx}$"), handler))
+        self.patterns.append((method.upper(), pattern, handler))
 
     def get(self, p: str):
         return lambda h: (self.route("GET", p, h), h)[1]

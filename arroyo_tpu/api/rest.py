@@ -115,6 +115,44 @@ class ApiServer:
         async def ping(req: Request):
             return {"pong": True}
 
+        @r.get("/api/v1/openapi.json")
+        async def openapi(req: Request):
+            """OpenAPI 3.0 description of this API, generated from the
+            live route table (the reference serves a utoipa-generated
+            spec the same way, arroyo-openapi)."""
+            import re as _re
+
+            paths: Dict[str, Dict] = {}
+            for method, pattern, handler in r.patterns:
+                if pattern in ("/", "/api/v1/openapi.json"):
+                    continue
+                entry = paths.setdefault(pattern, {})
+                doc = (handler.__doc__ or "").strip().split("\n")[0]
+                op = {
+                    "summary": doc or handler.__name__,
+                    "operationId": handler.__name__,
+                    "responses": {"200": {"description": "success"}},
+                }
+                params = _re.findall(r"\{(\w+)\}", pattern)
+                if params:
+                    op["parameters"] = [{
+                        "name": p, "in": "path", "required": True,
+                        "schema": {"type": "string"},
+                    } for p in params]
+                if method in ("POST", "PATCH"):
+                    op["requestBody"] = {"content": {
+                        "application/json": {"schema": {"type": "object"}}}}
+                entry[method.lower()] = op
+            return {
+                "openapi": "3.0.3",
+                "info": {"title": "arroyo_tpu REST API",
+                         "version": "0.1.0",
+                         "description":
+                             "Pipeline/job management for the TPU-native "
+                             "streaming engine (arroyo-api parity)"},
+                "paths": paths,
+            }
+
         @r.get("/")
         async def console(req: Request):
             from .console import CONSOLE_HTML

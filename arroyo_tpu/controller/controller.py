@@ -169,8 +169,11 @@ class ControllerServer:
         job.workers.clear()
         job.finished_tasks.clear()
         job.fsm.transition(JobState.SCHEDULING)
-        await self._schedule(job, n_workers=len(
-            self.scheduler.workers_for_job(job_id)) or 1, restore=True)
+        # workers_for_job can do blocking IO (the k8s scheduler lists
+        # pods) — keep it off the controller's event loop
+        prev = await asyncio.get_event_loop().run_in_executor(
+            None, self.scheduler.workers_for_job, job_id)
+        await self._schedule(job, n_workers=len(prev) or 1, restore=True)
         job.fsm.transition(JobState.RUNNING)
 
     def job_state(self, job_id: str) -> JobState:

@@ -223,6 +223,7 @@ class KubernetesScheduler(Scheduler):
         self.node_selector = _json.loads(os.environ.get(
             "K8S_WORKER_NODE_SELECTOR", "{}"))
         self._jobs: Dict[str, str] = {}  # job_id -> label selector
+        self._runs: Dict[str, int] = {}  # job_id -> run counter
 
     def _get_client(self):
         if self.client is None:
@@ -297,8 +298,13 @@ class KubernetesScheduler(Scheduler):
 
     async def start_workers(self, job_id, controller_addr, n_workers,
                             slots_per_worker):
+        # run_id increments per (re)start so a restarted job never
+        # collides with a still-terminating ReplicaSet of the same name
+        # (the reference passes the DB run_id the same way)
+        self._runs[job_id] = self._runs.get(job_id, 0) + 1
         rs = self.make_replicaset(job_id, controller_addr, n_workers,
-                                  slots_per_worker)
+                                  slots_per_worker,
+                                  run_id=str(self._runs[job_id]))
         sel = (f"{self.JOB_ID_LABEL}={job_id},"
                f"{self.RUN_ID_LABEL}="
                f"{rs['metadata']['labels'][self.RUN_ID_LABEL]}")
@@ -319,6 +325,62 @@ class KubernetesScheduler(Scheduler):
                 if p.get("status", {}).get("phase") in ("Running", "Pending")]
 
 
+class NodeScheduler(Scheduler):
+    """Schedule workers onto a pool of node daemons
+    (schedulers/mod.rs:316-664 NodeScheduler analog; daemons are
+    arroyo_tpu.node.daemon processes).  The pool is env-configured:
+    ``NODE_ADDRS=host1:9290,host2:9290`` (the reference's nodes register
+    dynamically; a static pool keeps the control plane one-directional).
+    Workers are round-robined across nodes."""
+
+    def __init__(self, node_addrs: Optional[List[str]] = None):
+        addrs = node_addrs or [
+            a.strip() for a in os.environ.get("NODE_ADDRS", "").split(",")
+            if a.strip()]
+        if not addrs:
+            raise ValueError("NodeScheduler needs NODE_ADDRS")
+        self.node_addrs = addrs
+        self._rr = 0
+        # job_id -> list of (node_addr, worker_id)
+        self._workers: Dict[str, List] = {}
+
+    def _client(self, addr: str):
+        from ..rpc.transport import RpcClient
+
+        return RpcClient(addr, "NodeGrpc")
+
+    async def start_workers(self, job_id, controller_addr, n_workers,
+                            slots_per_worker):
+        placed = self._workers.setdefault(job_id, [])
+        for _ in range(n_workers):
+            addr = self.node_addrs[self._rr % len(self.node_addrs)]
+            self._rr += 1
+            client = self._client(addr)
+            try:
+                resp = await client.call("StartWorker", {
+                    "job_id": job_id,
+                    "controller_addr": controller_addr,
+                    "slots": slots_per_worker,
+                })
+            finally:
+                await client.close()
+            placed.append((addr, resp["worker_id"]))
+
+    async def stop_workers(self, job_id, force=False):
+        for addr, wid in self._workers.pop(job_id, []):
+            client = self._client(addr)
+            try:
+                await client.call("StopWorker",
+                                  {"worker_id": wid, "force": force})
+            except Exception:
+                logger.warning("StopWorker %s on %s failed", wid, addr)
+            finally:
+                await client.close()
+
+    def workers_for_job(self, job_id):
+        return [wid for _addr, wid in self._workers.get(job_id, [])]
+
+
 def scheduler_from_env() -> Scheduler:
     """SCHEDULER env selection (schedulers/mod.rs:70-76 analog):
     'process' (default), 'kubernetes'/'k8s', or 'embedded'."""
@@ -327,9 +389,11 @@ def scheduler_from_env() -> Scheduler:
         return KubernetesScheduler()
     if mode in ("embedded", "inprocess"):
         return InProcessScheduler()
+    if mode == "node":
+        return NodeScheduler()
     if mode in ("process", ""):
         return ProcessScheduler()
     # a typo must fail fast, not silently spawn subprocesses in the
     # controller container
     raise ValueError(f"unknown SCHEDULER {mode!r}; "
-                     "expected process | kubernetes | embedded")
+                     "expected process | kubernetes | embedded | node")

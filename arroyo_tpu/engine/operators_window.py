@@ -646,14 +646,16 @@ class SemiJoinOperator(Operator):
             if not mask.all():
                 self.left.append(batch.select(~mask))
             return
-        # right: first sighting of a key releases waiting left rows
+        # right: refresh every key's timestamp (a continuously-hot key
+        # must not expire off its FIRST sighting); first sightings also
+        # release waiting left rows
         uniq, first = np.unique(batch.key_hash, return_index=True)
         fresh = np.array([self.rkeys.get(int(k)) is None for k in uniq])
+        for k, i in zip(uniq.tolist(), first.tolist()):
+            self.rkeys.insert(int(batch.timestamp[i]), int(k), True)
         if not fresh.any():
             return
         new_keys = uniq[fresh]
-        for k, i in zip(new_keys.tolist(), first[fresh].tolist()):
-            self.rkeys.insert(int(batch.timestamp[i]), int(k), True)
         pending = self.left.all()
         if pending is not None and len(pending):
             m = np.isin(pending.key_hash, new_keys)

@@ -1,0 +1,1 @@
+from .daemon import NodeServer, run_node  # noqa: F401

@@ -1,0 +1,140 @@
+"""Node daemon: a standalone per-host process manager for clusters
+without Kubernetes (/root/reference/arroyo-node/src/main.rs:44-319).
+
+Serves NodeGrpc {StartWorker, StopWorker, GetWorkers} on the protobuf
+control-plane wire: StartWorker spawns a worker OS process with the
+requested env (JOB_ID, CONTROLLER_ADDR, TASK_SLOTS, ...), a reaper task
+watches for exits and reports WorkerFinished to the controller.  The
+reference additionally ships a per-pipeline worker binary in 2MB gRPC
+chunks (main.rs:98-236); here every pipeline runs the same Python
+worker and receives its program via StartExecution, so no binary
+transfer exists by design.
+
+Run: ``python -m arroyo_tpu.node.daemon`` (NODE_PORT, default 9290).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import subprocess
+import sys
+import uuid
+from typing import Dict, Optional
+
+from ..rpc.transport import RpcClient, RpcServer
+
+logger = logging.getLogger(__name__)
+
+
+class NodeServer:
+    def __init__(self, host: str = "127.0.0.1"):
+        self.host = host
+        self.rpc = RpcServer()
+        self.addr: Optional[str] = None
+        self._procs: Dict[str, subprocess.Popen] = {}  # worker_id -> proc
+        self._meta: Dict[str, Dict] = {}  # worker_id -> {job_id, ctrl}
+        self._reaper: Optional[asyncio.Task] = None
+
+    async def start(self, port: int = 0) -> str:
+        self.rpc.add_service("NodeGrpc", {
+            "StartWorker": self._start_worker,
+            "StopWorker": self._stop_worker,
+            "GetWorkers": self._get_workers,
+        })
+        p = await self.rpc.start(self.host, port)
+        self.addr = f"{self.host}:{p}"
+        self._reaper = asyncio.ensure_future(self._reap_loop())
+        logger.info("node daemon on %s", self.addr)
+        return self.addr
+
+    async def stop(self) -> None:
+        if self._reaper:
+            self._reaper.cancel()
+        for wid in list(self._procs):
+            self._kill(wid, force=True)
+        await self.rpc.stop()
+
+    # -- NodeGrpc ----------------------------------------------------------
+
+    async def _start_worker(self, req: Dict) -> Dict:
+        worker_id = f"worker-{uuid.uuid4().hex[:8]}"
+        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        env = dict(os.environ)
+        env.update(req.get("env") or {})
+        env.update({
+            "CONTROLLER_ADDR": req["controller_addr"],
+            "JOB_ID": req["job_id"],
+            "TASK_SLOTS": str(req.get("slots") or 16),
+            "WORKER_ID": worker_id,
+            "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+            "PYTHONPATH": (pkg_root + os.pathsep + env["PYTHONPATH"]
+                           if env.get("PYTHONPATH") else pkg_root),
+        })
+        if env["JAX_PLATFORMS"] == "cpu":
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "arroyo_tpu.worker.server"], env=env)
+        self._procs[worker_id] = proc
+        self._meta[worker_id] = {"job_id": req["job_id"],
+                                 "ctrl": req["controller_addr"]}
+        logger.info("started worker %s (pid %d) for job %s",
+                    worker_id, proc.pid, req["job_id"])
+        return {"worker_id": worker_id}
+
+    async def _stop_worker(self, req: Dict) -> Dict:
+        self._kill(req["worker_id"], force=req.get("force", False))
+        return {}
+
+    async def _get_workers(self, req: Dict) -> Dict:
+        return {"worker_ids": [w for w, p in self._procs.items()
+                               if p.poll() is None]}
+
+    # -- supervision --------------------------------------------------------
+
+    def _kill(self, worker_id: str, force: bool) -> None:
+        p = self._procs.get(worker_id)
+        if p is None or p.poll() is not None:
+            return
+        if force:
+            p.kill()
+        else:
+            p.terminate()
+
+    async def _reap_loop(self) -> None:
+        """Reap exited workers and report WorkerFinished to the controller
+        (main.rs:237-319)."""
+        while True:
+            await asyncio.sleep(0.2)
+            for wid, p in list(self._procs.items()):
+                if p.poll() is None:
+                    continue
+                meta = self._meta.pop(wid, None)
+                del self._procs[wid]
+                logger.info("worker %s exited rc=%s", wid, p.returncode)
+                if meta:
+                    try:
+                        client = RpcClient(meta["ctrl"], "ControllerGrpc")
+                        await client.call("WorkerFinished", {
+                            "worker_id": wid, "job_id": meta["job_id"]})
+                        await client.close()
+                    except Exception as e:
+                        logger.warning("WorkerFinished report failed: %s", e)
+
+
+async def run_node(port: int = 0, host: str = "127.0.0.1") -> None:
+    node = NodeServer(host)
+    await node.start(port)
+    await asyncio.Event().wait()
+
+
+def main() -> None:
+    logging.basicConfig(level=logging.INFO)
+    asyncio.run(run_node(int(os.environ.get("NODE_PORT", "9290")),
+                         os.environ.get("NODE_HOST", "127.0.0.1")))
+
+
+if __name__ == "__main__":
+    main()

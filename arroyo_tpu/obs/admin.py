@@ -42,6 +42,46 @@ class AdminServer:
                     "pid": os.getpid(),
                     "details": self.details_fn()}
 
+        # continuous-profiling hooks: the pyroscope analog
+        # (arroyo-server-common/src/lib.rs:12-15, try_profile_start) is the
+        # jax profiler — one POST captures a Perfetto/XPlane trace of every
+        # device kernel + host dispatch in the window
+        @router.post("/debug/profile")
+        async def profile(req: Request):
+            import asyncio
+
+            import jax
+
+            body = req.json() if req.body else {}
+            secs = float(body.get("seconds", 2.0))
+            out_dir = body.get(
+                "dir", f"/tmp/arroyo_tpu/profiles/{self.service}")
+            os.makedirs(out_dir, exist_ok=True)
+            jax.profiler.start_trace(out_dir)
+            try:
+                await asyncio.sleep(min(secs, 60.0))
+            finally:
+                jax.profiler.stop_trace()
+            traces = []
+            for root, _dirs, files in os.walk(out_dir):
+                traces += [os.path.join(root, f) for f in files
+                           if f.endswith((".trace.json.gz", ".xplane.pb"))]
+            return {"dir": out_dir, "seconds": secs,
+                    "traces": sorted(traces)[-4:],
+                    "hint": "open in perfetto.dev or tensorboard"}
+
+        @router.get("/debug/device")
+        async def device(req: Request):
+            import jax
+
+            return {"backend": jax.default_backend(),
+                    "devices": [str(d) for d in jax.devices()],
+                    "live_buffer_bytes": sum(
+                        getattr(b, "nbytes", 0)
+                        for d in jax.devices()
+                        for b in d.live_buffers())
+                    if hasattr(jax.devices()[0], "live_buffers") else None}
+
         self.http = HttpServer(router)
         self.port: Optional[int] = None
 

@@ -1,0 +1,6 @@
+#!/bin/sh
+# Regenerate the protobuf message classes for the control-plane wire.
+# (grpcio-tools is not required: services are bound by generic handlers
+# in transport.py, so only message classes are generated.)
+cd "$(dirname "$0")"
+protoc --python_out=gen --proto_path=proto proto/rpc.proto

@@ -1,33 +1,117 @@
-"""msgpack-over-grpc transport for the control plane.
+"""Protobuf-over-gRPC transport for the control plane.
 
-The reference uses tonic-generated stubs; grpcio-tools is not in this image,
-so services are wired with grpc *generic handlers*: each method is an async
-function taking/returning msgpack-serializable dicts, registered under the
-same fully-qualified method names as rpc/proto/rpc.proto.  Messages stay
-dicts (the proto file is the schema contract)."""
+The wire IS the schema at rpc/proto/rpc.proto (parity with the
+reference's tonic services, arroyo-rpc/proto/rpc.proto): every request/
+response is a protobuf message from the generated ``rpc_pb2``, carried
+over grpc.aio.  grpcio-tools is not in the image, so instead of
+generated stubs the services are bound with grpc *generic handlers*,
+and the message classes come from ``protoc --python_out`` (gen.sh).
+
+Handlers and callers keep the runtime's dict interface: dicts are
+mapped to/from protobuf messages by field descriptor (including
+repeated, map<,> and message-typed fields).  Services not declared in
+the proto fall back to msgpack payloads (explicitly logged) so ad-hoc
+test services still work.
+"""
 
 from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Any, AsyncIterator, Callable, Dict, Optional
+from typing import Any, AsyncIterator, Callable, Dict, Optional, Tuple
 
 import grpc
 import msgpack
 
+from .gen import rpc_pb2
+
 logger = logging.getLogger(__name__)
 
+_FD = rpc_pb2.DESCRIPTOR
 
-def _ser(obj: Any) -> bytes:
+
+def _msg_cls(descriptor):
+    return getattr(rpc_pb2, descriptor.name)
+
+
+def _method_types(service: str, method: str):
+    """(request class, response class, server_streaming) from the proto,
+    or None when the service/method isn't declared there."""
+    svc = _FD.services_by_name.get(service)
+    if svc is None:
+        return None
+    m = svc.methods_by_name.get(method)
+    if m is None:
+        return None
+    return _msg_cls(m.input_type), _msg_cls(m.output_type), m.server_streaming
+
+
+def dict_to_proto(msg, d: Optional[Dict]) -> Any:
+    """Fill protobuf message ``msg`` from a dict (None values = unset)."""
+    for k, v in (d or {}).items():
+        if v is None:
+            continue
+        f = msg.DESCRIPTOR.fields_by_name.get(k)
+        if f is None:
+            raise KeyError(
+                f"{msg.DESCRIPTOR.name} has no field {k!r} "
+                f"(have {sorted(msg.DESCRIPTOR.fields_by_name)})")
+        if f.message_type is not None and f.message_type.GetOptions().map_entry:
+            getattr(msg, k).update(v)
+        elif f.is_repeated:
+            if f.message_type is not None:
+                for item in v:
+                    dict_to_proto(getattr(msg, k).add(), item)
+            else:
+                getattr(msg, k).extend(_scalar(x) for x in v)
+        elif f.message_type is not None:
+            dict_to_proto(getattr(msg, k), v)
+        else:
+            setattr(msg, k, _scalar(v))
+    return msg
+
+
+def _scalar(v: Any) -> Any:
+    # numpy ints/floats leak into payloads (epochs, watermarks); protobuf
+    # setters want native python scalars
+    if hasattr(v, "item") and not isinstance(v, (bytes, str)):
+        return v.item()
+    return v
+
+
+def proto_to_dict(msg) -> Dict:
+    """Dict view of a protobuf message: plain fields always present (with
+    proto3 defaults), explicit-presence (optional) fields only when set."""
+    out: Dict[str, Any] = {}
+    for f in msg.DESCRIPTOR.fields:
+        if f.message_type is not None and f.message_type.GetOptions().map_entry:
+            out[f.name] = dict(getattr(msg, f.name))
+        elif f.is_repeated:
+            v = getattr(msg, f.name)
+            out[f.name] = ([proto_to_dict(i) for i in v]
+                           if f.message_type is not None else list(v))
+        elif f.message_type is not None:
+            if msg.HasField(f.name):
+                out[f.name] = proto_to_dict(getattr(msg, f.name))
+        elif f.has_presence:
+            if msg.HasField(f.name):
+                out[f.name] = getattr(msg, f.name)
+        else:
+            out[f.name] = getattr(msg, f.name)
+    return out
+
+
+def _ser_msgpack(obj: Any) -> bytes:
     return msgpack.packb(obj, use_bin_type=True)
 
 
-def _deser(data: bytes) -> Any:
+def _deser_msgpack(data: bytes) -> Any:
     return msgpack.unpackb(data, raw=False)
 
 
 class RpcServer:
-    """grpc.aio server hosting one or more msgpack services."""
+    """grpc.aio server hosting proto-declared services (protobuf wire)
+    and, as a fallback, ad-hoc msgpack services."""
 
     def __init__(self) -> None:
         self._services: Dict[str, Dict[str, Callable]] = {}
@@ -40,8 +124,28 @@ class RpcServer:
                     ) -> None:
         """methods: name -> async fn(request_dict) -> response_dict;
         stream_methods: name -> async gen fn(request_dict) -> yields dicts."""
+        if service not in _FD.services_by_name:
+            logger.warning("service %s not in rpc.proto: msgpack fallback",
+                           service)
         self._services[service] = methods
         self._streams[service] = stream_methods or {}
+
+    def _codecs(self, svc: str, method: str
+                ) -> Tuple[Callable, Callable, Callable]:
+        """(decode request bytes->dict, encode response dict->bytes) pair
+        plus the stream encoder for this method."""
+        types = _method_types(svc, method)
+        if types is None:
+            return (_deser_msgpack, _ser_msgpack, _ser_msgpack)
+        req_cls, resp_cls, _ = types
+
+        def dec(data: bytes) -> Dict:
+            return proto_to_dict(req_cls.FromString(data))
+
+        def enc(d: Optional[Dict]) -> bytes:
+            return dict_to_proto(resp_cls(), d).SerializeToString()
+
+        return dec, enc, enc
 
     async def start(self, host: str = "0.0.0.0", port: int = 0) -> int:
         self.server = grpc.aio.server()
@@ -61,10 +165,11 @@ class RpcServer:
                 streams = outer._streams.get(svc, {})
                 if method in methods:
                     fn = methods[method]
+                    dec, enc, _ = outer._codecs(svc, method)
 
                     async def unary(request, context):
                         try:
-                            return _ser(await fn(_deser(request)))
+                            return enc(await fn(dec(request)))
                         except Exception as e:  # surface as grpc error
                             logger.exception("rpc %s failed", path)
                             await context.abort(
@@ -75,10 +180,11 @@ class RpcServer:
                         response_serializer=lambda b: b)
                 if method in streams:
                     gen = streams[method]
+                    dec, _, enc_item = outer._codecs(svc, method)
 
                     async def streaming(request, context):
-                        async for item in gen(_deser(request)):
-                            yield _ser(item)
+                        async for item in gen(dec(request)):
+                            yield enc_item(item)
 
                     return grpc.unary_stream_rpc_method_handler(
                         streaming, request_deserializer=lambda b: b,
@@ -96,7 +202,8 @@ class RpcServer:
 
 
 class RpcClient:
-    """Client for one msgpack service on one endpoint."""
+    """Client for one service on one endpoint (protobuf wire for
+    proto-declared services, msgpack otherwise)."""
 
     def __init__(self, addr: str, service: str,
                  package: str = "arroyo_tpu.rpc"):
@@ -105,23 +212,39 @@ class RpcClient:
         self.package = package
         self.channel = grpc.aio.insecure_channel(addr)
 
+    def _codecs(self, method: str) -> Tuple[Callable, Callable]:
+        types = _method_types(self.service, method)
+        if types is None:
+            return _ser_msgpack, _deser_msgpack
+        req_cls, resp_cls, _ = types
+
+        def enc(d: Optional[Dict]) -> bytes:
+            return dict_to_proto(req_cls(), d).SerializeToString()
+
+        def dec(data: bytes) -> Dict:
+            return proto_to_dict(resp_cls.FromString(data))
+
+        return enc, dec
+
     async def call(self, method: str, request: Optional[Dict] = None,
                    timeout: float = 10.0) -> Any:
         path = f"/{self.package}.{self.service}/{method}"
+        enc, dec = self._codecs(method)
         fn = self.channel.unary_unary(
             path, request_serializer=lambda b: b,
             response_deserializer=lambda b: b)
-        resp = await fn(_ser(request or {}), timeout=timeout)
-        return _deser(resp)
+        resp = await fn(enc(request or {}), timeout=timeout)
+        return dec(resp)
 
     async def stream(self, method: str, request: Optional[Dict] = None
                      ) -> AsyncIterator[Any]:
         path = f"/{self.package}.{self.service}/{method}"
+        enc, dec = self._codecs(method)
         fn = self.channel.unary_stream(
             path, request_serializer=lambda b: b,
             response_deserializer=lambda b: b)
-        async for item in fn(_ser(request or {})):
-            yield _deser(item)
+        async for item in fn(enc(request or {})):
+            yield dec(item)
 
     async def close(self) -> None:
         await self.channel.close()

@@ -75,6 +75,20 @@ class SqlPlanError(ValueError):
     pass
 
 
+def _conjuncts(e: Expr) -> List[Expr]:
+    """Flatten a predicate's top-level AND chain."""
+    if isinstance(e, BinaryOp) and e.op == "and":
+        return _conjuncts(e.left) + _conjuncts(e.right)
+    return [e]
+
+
+def _conjoin(parts: List[Expr]) -> Optional[Expr]:
+    out = None
+    for c in parts:
+        out = c if out is None else BinaryOp("and", out, c)
+    return out
+
+
 def _expr_name(e: Expr, i: int) -> str:
     if isinstance(e, ColumnRef):
         return e.name.lower()
@@ -803,14 +817,9 @@ class Planner:
         """``x IN (SELECT c FROM ...)`` conjuncts -> streaming semi-joins
         (left rows emit exactly once on a TTL'd right-key match); returns
         (planned, remaining predicate or None)."""
-        def conjuncts(e):
-            if isinstance(e, BinaryOp) and e.op == "and":
-                return conjuncts(e.left) + conjuncts(e.right)
-            return [e]
-
         subs = []
         rest = []
-        for c in conjuncts(where):
+        for c in _conjuncts(where):
             (subs if isinstance(c, InSubquery) else rest).append(c)
         if not subs:
             return planned, where
@@ -846,10 +855,7 @@ class Planner:
                           name=f"semi_drop_{self._next_id()}")
             planned = Planned(out, planned.schema)
 
-        rem = None
-        for c in rest:
-            rem = c if rem is None else BinaryOp("and", rem, c)
-        return planned, rem
+        return planned, _conjoin(rest)
 
     def _rewrite_rownumber_topn(self, sel: Select, prog: Program,
                                 scope: Dict[str, Planned]):
@@ -875,14 +881,9 @@ class Planner:
         over = rn_item.expr.over
 
         # outer WHERE: find `rn <= k` / `rn < k` among top-level conjuncts
-        def conjuncts(e):
-            if isinstance(e, BinaryOp) and e.op == "and":
-                return conjuncts(e.left) + conjuncts(e.right)
-            return [e]
-
         limit = None
         remaining = []
-        for c in conjuncts(sel.where):
+        for c in _conjuncts(sel.where):
             if (limit is None and isinstance(c, BinaryOp)
                     and c.op in ("<=", "<")
                     and isinstance(c.left, ColumnRef)
@@ -903,8 +904,22 @@ class Planner:
         if not over.order_by[0].desc:
             raise SqlPlanError("streaming TopN requires ORDER BY ... DESC")
 
-        inner2 = _replace(inner, items=[it for i, it in
-                                        enumerate(inner.items) if i != idx])
+        # removing the rn item shifts later items down: remap GROUP BY
+        # ordinals (1-based) pointing past it, reject ones pointing AT it
+        def remap_ordinal(e: Expr) -> Expr:
+            if isinstance(e, Literal) and e.type == "int":
+                o = e.value - 1
+                if o == idx:
+                    raise SqlPlanError(
+                        "GROUP BY ordinal may not reference ROW_NUMBER()")
+                if o > idx:
+                    return Literal(e.value - 1, "int")
+            return e
+
+        inner2 = _replace(
+            inner,
+            items=[it for i, it in enumerate(inner.items) if i != idx],
+            group_by=[remap_ordinal(g) for g in inner.group_by])
         planned = self.plan_select(inner2, prog, scope)
         if sel.from_.alias:
             schema = planned.schema.clone()
@@ -932,10 +947,7 @@ class Planner:
 
         shim = Select(items=[], order_by=[over.order_by[0]], limit=limit)
         planned = self._plan_top_n(shim, planned, tuple(part_cols))
-        rem = None
-        for c in remaining:
-            rem = c if rem is None else BinaryOp("and", rem, c)
-        return planned, rem
+        return planned, _conjoin(remaining)
 
     def _plan_top_n(self, sel: Select, planned: Planned,
                     partition_cols: Tuple[str, ...] = ()) -> Planned:

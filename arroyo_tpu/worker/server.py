@@ -260,8 +260,9 @@ class WorkerServer:
 
 
 async def run_worker(controller_addr: str, job_id: str,
-                     slots: Optional[int] = None) -> None:
-    w = WorkerServer(controller_addr, job_id, slots)
+                     slots: Optional[int] = None,
+                     worker_id: Optional[str] = None) -> None:
+    w = WorkerServer(controller_addr, job_id, slots, worker_id=worker_id)
     await w.start()
     await w.wait_done()
 
@@ -270,7 +271,10 @@ def main() -> None:
     logging.basicConfig(level=logging.INFO)
     asyncio.run(run_worker(
         os.environ["CONTROLLER_ADDR"], os.environ["JOB_ID"],
-        int(os.environ.get("TASK_SLOTS", "16"))))
+        int(os.environ.get("TASK_SLOTS", "16")),
+        # the node daemon assigns the id so its WorkerFinished reports
+        # match what the controller registered
+        os.environ.get("WORKER_ID")))
 
 
 if __name__ == "__main__":

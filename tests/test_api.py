@@ -201,3 +201,21 @@ def test_output_tailing_sse(api_env):
             assert rows >= 0  # stream terminated cleanly
 
     _run(loop, scenario())
+
+
+def test_openapi_spec(api_env):
+    """GET /api/v1/openapi.json describes the live route table."""
+    loop, _ctrl, url = api_env
+
+    async def fetch():
+        async with httpx.AsyncClient() as c:
+            return (await c.get(f"{url}/api/v1/openapi.json")).json()
+
+    spec = _run(loop, fetch())
+    assert spec["openapi"].startswith("3.")
+    paths = spec["paths"]
+    assert "/v1/pipelines" in paths
+    assert "post" in paths["/v1/pipelines"] and "get" in paths["/v1/pipelines"]
+    assert "/v1/pipelines/{id}" in paths
+    assert paths["/v1/pipelines/{id}"]["get"]["parameters"][0]["name"] == "id"
+    assert "/v1/connection_tables" in paths

@@ -1,0 +1,62 @@
+"""Node-daemon cluster: NodeScheduler places workers on node daemons
+(arroyo-node/src/main.rs:44-319 analog); the daemons spawn worker OS
+processes, reap them, and report WorkerFinished."""
+
+import asyncio
+
+from arroyo_tpu import Stream
+from arroyo_tpu.controller.controller import ControllerServer
+from arroyo_tpu.controller.scheduler import NodeScheduler
+from arroyo_tpu.controller.state_machine import JobState
+from arroyo_tpu.graph.logical import AggKind, AggSpec
+from arroyo_tpu.node import NodeServer
+
+
+def test_node_daemon_cluster(tmp_path):
+    out_path = tmp_path / "out.jsonl"
+
+    async def scenario():
+        node1, node2 = NodeServer(), NodeServer()
+        a1, a2 = await node1.start(), await node2.start()
+        sched = NodeScheduler([a1, a2])
+        ctrl = ControllerServer(sched)
+        await ctrl.start()
+        prog = (
+            Stream.source("impulse", {"event_rate": 0.0,
+                                      "message_count": 2000,
+                                      "event_time_interval_micros": 1000,
+                                      "batch_size": 128}, parallelism=2)
+            .watermark(max_lateness_micros=0)
+            .map(lambda c: {"counter": c["counter"],
+                            "bucket": c["counter"] % 5}, name="b")
+            .key_by("bucket")
+            .tumbling_aggregate(
+                250 * 1000, [AggSpec(AggKind.COUNT, None, "cnt")],
+                parallelism=2)
+            .sink("single_file", {"path": str(out_path)}, parallelism=1)
+        )
+        job_id = await ctrl.submit_job(
+            prog, checkpoint_url=f"file://{tmp_path}/ckpt", n_workers=2)
+        try:
+            # one worker per node daemon, both register with the controller
+            for _ in range(300):
+                if len(ctrl.jobs[job_id].workers) >= 2:
+                    break
+                await asyncio.sleep(0.1)
+            assert len(ctrl.jobs[job_id].workers) >= 2, "workers never came"
+            assert len(sched.workers_for_job(job_id)) == 2
+            w1 = await node1._get_workers({})
+            w2 = await node2._get_workers({})
+            assert len(w1["worker_ids"]) == 1  # round-robin placement
+            assert len(w2["worker_ids"]) == 1
+            state = await ctrl.wait_for_state(job_id, JobState.FINISHED,
+                                              timeout=120)
+        finally:
+            await sched.stop_workers(job_id)
+            await ctrl.stop()
+            await node1.stop()
+            await node2.stop()
+        return state
+
+    state = asyncio.run(scenario())
+    assert state == JobState.FINISHED

@@ -100,3 +100,45 @@ def test_init_logging_sets_excepthook(monkeypatch):
         assert sys.excepthook is not old  # panic hook installed
     finally:
         sys.excepthook = old
+
+
+def test_admin_profile_capture(aiohttp_servers=None):
+    """POST /debug/profile captures a jax profiler (Perfetto) trace — the
+    pyroscope continuous-profiling analog."""
+    import asyncio
+    import urllib.request
+    import json as _json
+
+    import jax.numpy as jnp
+
+    from arroyo_tpu.obs.admin import AdminServer
+
+    async def scenario(tmp):
+        admin = AdminServer("test")
+        port = await admin.start()
+
+        async def work():
+            # some device work inside the profiling window
+            for _ in range(20):
+                (jnp.ones((256, 256)) @ jnp.ones((256, 256))).block_until_ready()
+                await asyncio.sleep(0.01)
+
+        async def capture():
+            loop = asyncio.get_event_loop()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/debug/profile",
+                data=_json.dumps({"seconds": 0.5, "dir": tmp}).encode(),
+                method="POST",
+                headers={"Content-Type": "application/json"})
+            return await loop.run_in_executor(
+                None, lambda: _json.loads(
+                    urllib.request.urlopen(req, timeout=30).read()))
+
+        _, resp = await asyncio.gather(work(), capture())
+        await admin.stop()
+        return resp
+
+    import tempfile
+    with tempfile.TemporaryDirectory() as tmp:
+        resp = asyncio.run(scenario(tmp))
+    assert resp["traces"], f"no trace files captured: {resp}"

@@ -7,8 +7,10 @@
   analog of the reference's single-process mode.
 * :class:`ProcessScheduler` — spawns ``python -m arroyo_tpu.worker.server``
   subprocesses (schedulers/mod.rs:77-233).
-* Kubernetes/TPU-pod scheduling (kubernetes.rs analog): round 2 — slots map
-  to TPU chips per SURVEY §2 #34.
+* :class:`KubernetesScheduler` — pod-per-worker on k8s/GKE TPU pools
+  (kubernetes.rs analog; slots map to TPU chips per SURVEY §2 #34).
+* :class:`NodeScheduler` — workers placed on a pool of
+  ``arroyo_tpu.node`` daemons (schedulers/mod.rs:316-664 analog).
 """
 
 from __future__ import annotations
@@ -78,27 +80,11 @@ class ProcessScheduler(Scheduler):
 
     async def start_workers(self, job_id, controller_addr, n_workers,
                             slots_per_worker):
-        # workers must import this package regardless of their cwd
-        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        procs = []
-        for _ in range(n_workers):
-            env = dict(os.environ)
-            env.update({
-                "CONTROLLER_ADDR": controller_addr,
-                "JOB_ID": job_id,
-                "TASK_SLOTS": str(slots_per_worker),
-                "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
-                "PYTHONPATH": (pkg_root + os.pathsep + env["PYTHONPATH"]
-                               if env.get("PYTHONPATH") else pkg_root),
-            })
-            if env["JAX_PLATFORMS"] == "cpu":
-                # a CPU worker must not wake the axon TPU-tunnel plugin
-                # (its sitecustomize runs at interpreter start and can
-                # stall the process on tunnel handshakes)
-                env.pop("PALLAS_AXON_POOL_IPS", None)
-            procs.append(subprocess.Popen(
-                [sys.executable, "-m", "arroyo_tpu.worker.server"], env=env))
+        from ..worker.spawn import spawn_worker_process
+
+        procs = [spawn_worker_process(job_id, controller_addr,
+                                      slots_per_worker)
+                 for _ in range(n_workers)]
         self._procs[job_id] = self._procs.get(job_id, []) + procs
 
     async def stop_workers(self, job_id, force=False):
@@ -224,6 +210,12 @@ class KubernetesScheduler(Scheduler):
             "K8S_WORKER_NODE_SELECTOR", "{}"))
         self._jobs: Dict[str, str] = {}  # job_id -> label selector
         self._runs: Dict[str, int] = {}  # job_id -> run counter
+        # per-incarnation suffix: a restarted CONTROLLER resets the
+        # counter, and its run 1 must not collide with a still-terminating
+        # ReplicaSet from the previous incarnation's run 1
+        import uuid as _uuid
+
+        self._incarnation = _uuid.uuid4().hex[:6]
 
     def _get_client(self):
         if self.client is None:
@@ -302,9 +294,9 @@ class KubernetesScheduler(Scheduler):
         # collides with a still-terminating ReplicaSet of the same name
         # (the reference passes the DB run_id the same way)
         self._runs[job_id] = self._runs.get(job_id, 0) + 1
-        rs = self.make_replicaset(job_id, controller_addr, n_workers,
-                                  slots_per_worker,
-                                  run_id=str(self._runs[job_id]))
+        rs = self.make_replicaset(
+            job_id, controller_addr, n_workers, slots_per_worker,
+            run_id=f"{self._runs[job_id]}-{self._incarnation}")
         sel = (f"{self.JOB_ID_LABEL}={job_id},"
                f"{self.RUN_ID_LABEL}="
                f"{rs['metadata']['labels'][self.RUN_ID_LABEL]}")

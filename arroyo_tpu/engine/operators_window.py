@@ -14,9 +14,9 @@ Maps the reference's window operator suite onto batched device kernels:
 * :class:`SessionWindowOperator` — SessionWindowFunc (windows.rs:200-427):
   host-managed per-key gap-merged window sets (data-dependent merging stays
   on host, as the reference keeps it in KeyedState), aggregation on device.
-* :class:`TumblingTopNOperator` / :class:`SlidingAggTopNOperator` —
-  TumblingTopN / SlidingAggregatingTopN (tumbling_top_n_window.rs,
-  sliding_top_n_aggregating_window.rs).
+* :class:`TumblingTopNOperator` — TumblingTopN (tumbling_top_n_window.rs);
+  the fused SlidingAggregatingTopN lives as the ``top_n`` mode of
+  :class:`BinAggOperator` (sliding_top_n_aggregating_window.rs).
 * :class:`WindowJoinOperator` — Operator::WindowJoin (joins.rs:14-181):
   dual-sided buffers, sorted-merge join per fired window.
 * :class:`JoinWithExpirationOperator` — JoinWithExpiration
@@ -647,12 +647,14 @@ class SemiJoinOperator(Operator):
                 self.left.append(batch.select(~mask))
             return
         # right: refresh every key's timestamp (a continuously-hot key
-        # must not expire off its FIRST sighting); first sightings also
-        # release waiting left rows
+        # must not expire off its FIRST sighting; a LATE re-sighting must
+        # not move it backward); first sightings release waiting left rows
         uniq, first = np.unique(batch.key_hash, return_index=True)
         fresh = np.array([self.rkeys.get(int(k)) is None for k in uniq])
         for k, i in zip(uniq.tolist(), first.tolist()):
-            self.rkeys.insert(int(batch.timestamp[i]), int(k), True)
+            prev_t = self.rkeys.get_time(int(k)) or 0
+            self.rkeys.insert(max(int(batch.timestamp[i]), prev_t),
+                              int(k), True)
         if not fresh.any():
             return
         new_keys = uniq[fresh]

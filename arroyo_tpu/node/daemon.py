@@ -19,7 +19,6 @@ import asyncio
 import logging
 import os
 import subprocess
-import sys
 import uuid
 from typing import Dict, Optional
 
@@ -59,24 +58,15 @@ class NodeServer:
     # -- NodeGrpc ----------------------------------------------------------
 
     async def _start_worker(self, req: Dict) -> Dict:
+        from ..worker.spawn import spawn_worker_process
+
         worker_id = f"worker-{uuid.uuid4().hex[:8]}"
-        pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__))))
-        env = dict(os.environ)
-        env.update(req.get("env") or {})
-        env.update({
-            "CONTROLLER_ADDR": req["controller_addr"],
-            "JOB_ID": req["job_id"],
-            "TASK_SLOTS": str(req.get("slots") or 16),
-            "WORKER_ID": worker_id,
-            "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
-            "PYTHONPATH": (pkg_root + os.pathsep + env["PYTHONPATH"]
-                           if env.get("PYTHONPATH") else pkg_root),
-        })
-        if env["JAX_PLATFORMS"] == "cpu":
-            env.pop("PALLAS_AXON_POOL_IPS", None)
-        proc = subprocess.Popen(
-            [sys.executable, "-m", "arroyo_tpu.worker.server"], env=env)
+        extra = dict(req.get("env") or {})
+        extra["WORKER_ID"] = worker_id  # daemon-assigned id so reaper
+        # reports match what the controller registered
+        proc = spawn_worker_process(
+            req["job_id"], req["controller_addr"],
+            req.get("slots") or 16, extra)
         self._procs[worker_id] = proc
         self._meta[worker_id] = {"job_id": req["job_id"],
                                  "ctrl": req["controller_addr"]}
@@ -115,13 +105,14 @@ class NodeServer:
                 del self._procs[wid]
                 logger.info("worker %s exited rc=%s", wid, p.returncode)
                 if meta:
+                    client = RpcClient(meta["ctrl"], "ControllerGrpc")
                     try:
-                        client = RpcClient(meta["ctrl"], "ControllerGrpc")
                         await client.call("WorkerFinished", {
                             "worker_id": wid, "job_id": meta["job_id"]})
-                        await client.close()
                     except Exception as e:
                         logger.warning("WorkerFinished report failed: %s", e)
+                    finally:
+                        await client.close()
 
 
 async def run_node(port: int = 0, host: str = "127.0.0.1") -> None:

@@ -42,6 +42,23 @@ def _is_device_dtype(dt: np.dtype) -> bool:
         np.issubdtype(dt, np.number) or np.issubdtype(dt, np.bool_))
 
 
+def _expr_device():
+    """Placement for jitted expressions: ``ARROYO_EXPR_DEVICE=cpu`` pins
+    elementwise expression kernels to the host CPU backend while keyed
+    window state stays on the accelerator.  Elementwise projections are
+    HBM-bandwidth-bound, not MXU work — when the accelerator sits behind
+    a high-latency tunnel, shipping every batch across it for a map/
+    filter costs far more than the compute saves."""
+    import os
+
+    if os.environ.get("ARROYO_EXPR_DEVICE", "").lower() == "cpu":
+        try:
+            return jax.devices("cpu")[0]
+        except RuntimeError:
+            return None
+    return None
+
+
 def _looks_stringy(v: np.ndarray) -> bool:
     """First non-None value (of a prefix) is a str: the column would stay
     on the host path rather than coerce to a device dtype."""
@@ -86,7 +103,17 @@ class CompiledExpr:
             def run(num_cols: Dict[str, jnp.ndarray]):
                 return fn(dict(num_cols))
 
-            f = run
+            dev = _expr_device()
+            if dev is not None:
+                jitted = run
+
+                def run_on(num_cols, _j=jitted, _d=dev):
+                    return _j({k: jax.device_put(v, _d)
+                               for k, v in num_cols.items()})
+
+                f = run_on
+            else:
+                f = run
             self._jitted[schema_key] = f
         return f
 
@@ -129,7 +156,8 @@ class CompiledExpr:
             for k, v in num_cols.items()
         }
         schema_key = tuple(sorted((k, str(v.dtype), padded)
-                                  for k, v in padded_cols.items()))
+                                  for k, v in padded_cols.items())
+                           ) + (_expr_device() is not None,)
         from ..obs.perf import timed_device
 
         out = timed_device(self._get_jitted(schema_key), padded_cols)

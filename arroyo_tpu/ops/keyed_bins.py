@@ -47,8 +47,15 @@ def _init_value(kind: AggKind) -> float:
 @functools.lru_cache(maxsize=256)
 def _update_kernel(kinds: Tuple[str, ...], C: int, B: int, n: int):
     @jax.jit
-    def run(values, counts, slots, bins, vals, valid):
-        # values: [k, C, B]; counts: [C, B]; slots, bins: i32[n]; vals: [k, n]
+    def run(values, counts, packed):
+        # ONE packed f32[k+3, n] input (one host->device transfer — a
+        # tunneled TPU pays per-transfer latency): rows are
+        # [slots, bins, valid, channel values...]; slot/bin/valid values
+        # are small integers, exact in f32
+        slots = packed[0].astype(jnp.int32)
+        bins = packed[1].astype(jnp.int32)
+        valid = packed[2] > 0.5
+        vals = packed[3:]
         s = jnp.where(valid, slots, C)  # trash row
         b = jnp.where(valid, bins, 0)
         counts = counts.at[s.clip(0, C - 1), b].add(
@@ -313,22 +320,21 @@ class KeyedBinState:
             return
 
         npad = _bucket(n, floor=256)
-        slots_p = np.zeros(npad, dtype=np.int32)
-        slots_p[:n] = slots
-        bins_p = np.zeros(npad, dtype=np.int32)
-        bins_p[:n] = bins_mod
-        valid = np.zeros(npad, dtype=bool)
-        valid[:n] = live
-        vals = np.zeros((len(self._ch_kinds), npad), dtype=np.float32)
+        # slot/bin indices ride the packed f32 transfer: exact only below
+        # 2^24 (a key table this size would be hundreds of GB anyway)
+        assert self.C <= 1 << 24, "key capacity exceeds f32-exact packing"
+        packed = np.zeros((len(self._ch_kinds) + 3, npad), dtype=np.float32)
+        packed[0, :n] = slots
+        packed[1, :n] = bins_mod
+        packed[2, :n] = live
         for j in range(len(self._ch_kinds)):
-            vals[j, :n] = self._channel_input(j, agg_inputs, n)
+            packed[3 + j, :n] = self._channel_input(j, agg_inputs, n)
 
         from ..obs.perf import timed_device
 
         kernel = _update_kernel(self._ch_kinds, self.C, self.B, npad)
         self.values, self.counts = timed_device(
-            kernel, self.values, self.counts, jnp.asarray(slots_p),
-            jnp.asarray(bins_p), jnp.asarray(vals), jnp.asarray(valid))
+            kernel, self.values, self.counts, jnp.asarray(packed))
 
     def _channel_input(self, j: int, agg_inputs: Dict[str, np.ndarray],
                        n: int) -> np.ndarray:
@@ -428,8 +434,11 @@ class KeyedBinState:
         kernel = _emit_kernel(self._ch_kinds, self.C, self.B, self.W, kpad)
         outs, cnts = timed_device(kernel, self.values, self.counts,
                                   jnp.asarray(ring), jnp.asarray(bin_ok))
-        outs = np.asarray(outs)  # [n_aggs, C, kpad]
-        cnts = np.asarray(cnts)  # [C, kpad]
+        # transfer only the occupied key rows, not all C slots (bucketed
+        # so the device slice compiles O(log C) times, not per key count)
+        c_slice = min(_bucket(max(self.next_slot, 1), floor=256), self.C)
+        outs = np.asarray(outs[:, :c_slice])  # [n_aggs, c_slice, kpad]
+        cnts = np.asarray(cnts[:c_slice])  # [c_slice, kpad]
 
         self.last_fired_pane = last_pane
         # evict bins that no future pane needs: abs bins <= last_pane - W + 1

@@ -155,8 +155,12 @@ def scatter_add_channels(slots: np.ndarray, bins: np.ndarray,
     assert n % CHUNK == 0 and len(slots) == n
     w2 = _split_hi_lo(np.asarray(weights, np.float32))
     run = _scatter_multi(2 * k, B, C_act, n // CHUNK, _interpret())
-    out = run(jnp.asarray(slots, jnp.int32), jnp.asarray(bins, jnp.int32),
-              jnp.asarray(w2))  # [2k, C_act, B]
+    # every operand is 32-bit; trace under x32 — Mosaic's TPU lowering
+    # rejects the 64-bit index types that global x64 mode introduces
+    with jax.enable_x64(False):
+        out = run(jnp.asarray(slots, jnp.int32),
+                  jnp.asarray(bins, jnp.int32),
+                  jnp.asarray(w2))  # [2k, C_act, B]
     return out[:k] + out[k:]
 
 
@@ -171,8 +175,11 @@ def _update_state_call(k: int, B: int, C_act: int, n_chunks: int,
     run = _scatter_multi(2 * k, B, C_act, n_chunks, interpret)
 
     @jax.jit
-    def apply(values, counts, slots, bins, w2):
-        out = run(slots, bins, w2)
+    def apply(values, counts, packed):
+        # ONE packed f32 input (one transfer): [slots, bins, w2 hi/lo...]
+        slots = packed[0].astype(jnp.int32)
+        bins = packed[1].astype(jnp.int32)
+        out = run(slots, bins, packed[2:])
         deltas = out[:k] + out[k:]
         counts = counts.at[:C_act].add(deltas[0].astype(counts.dtype))
         if k > 1:
@@ -190,9 +197,15 @@ def update_bin_state(values: jnp.ndarray, counts: jnp.ndarray,
     k, n = weights.shape
     assert n % CHUNK == 0
     w2 = _split_hi_lo(np.asarray(weights, np.float32))
+    packed = np.empty((2 + w2.shape[0], n), dtype=np.float32)
+    packed[0] = slots  # small ints: exact in f32
+    packed[1] = bins
+    packed[2:] = w2
     apply = _update_state_call(k, B, C_act, n // CHUNK, _interpret())
-    return apply(values, counts, jnp.asarray(slots, jnp.int32),
-                 jnp.asarray(bins, jnp.int32), jnp.asarray(w2))
+    # every operand is 32-bit; trace under x32 — Mosaic's TPU lowering
+    # rejects the 64-bit index types that global x64 mode introduces
+    with jax.enable_x64(False):
+        return apply(values, counts, jnp.asarray(packed))
 
 
 def pad_batch(slots: np.ndarray, bins: np.ndarray,

@@ -221,6 +221,10 @@ class KeyedState:
         entry = self._data.get(key)
         return entry[1] if entry is not None else None
 
+    def get_time(self, key: Any) -> Optional[int]:
+        entry = self._data.get(key)
+        return entry[0] if entry is not None else None
+
     def remove(self, key: Any) -> None:
         self._data.pop(key, None)
 

@@ -1,0 +1,34 @@
+"""Shared worker-process spawn logic for every scheduler/daemon that
+starts `python -m arroyo_tpu.worker.server` as an OS process."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Dict, Optional
+
+
+def spawn_worker_process(job_id: str, controller_addr: str, slots: int,
+                         extra_env: Optional[Dict[str, str]] = None
+                         ) -> subprocess.Popen:
+    """Start a worker OS process with the package importable from any
+    cwd; CPU workers are kept away from the axon TPU-tunnel plugin
+    (its sitecustomize can stall interpreter start on tunnel
+    handshakes)."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    env.update({
+        "CONTROLLER_ADDR": controller_addr,
+        "JOB_ID": job_id,
+        "TASK_SLOTS": str(slots),
+        "JAX_PLATFORMS": env.get("JAX_PLATFORMS", "cpu"),
+        "PYTHONPATH": (pkg_root + os.pathsep + env["PYTHONPATH"]
+                       if env.get("PYTHONPATH") else pkg_root),
+    })
+    if env["JAX_PLATFORMS"] == "cpu":
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+    return subprocess.Popen(
+        [sys.executable, "-m", "arroyo_tpu.worker.server"], env=env)

@@ -283,6 +283,15 @@ def main_child() -> None:
     backend = jax.default_backend()  # tag results with the REAL backend
     print(f"backend: {backend} ({len(jax.devices())} devices)",
           file=sys.stderr)
+    if backend == "tpu" and os.environ.get("PALLAS_AXON_POOL_IPS"):
+        # the axon TPU is reached through a high-latency tunnel: pin
+        # elementwise expression kernels to the host CPU backend (they are
+        # bandwidth-bound, not MXU work) and keep the keyed window state
+        # on the TPU — override with ARROYO_EXPR_DEVICE=default
+        os.environ.setdefault("ARROYO_EXPR_DEVICE", "cpu")
+        print("axon tunnel detected: expressions pinned to host "
+              f"(ARROYO_EXPR_DEVICE={os.environ['ARROYO_EXPR_DEVICE']})",
+              file=sys.stderr)
     headline = os.environ.get("BENCH_QUERY", "q5")
     if headline not in QUERIES:
         raise SystemExit(f"unknown BENCH_QUERY {headline!r}; "

@@ -501,3 +501,40 @@ def _graph_json(prog) -> Dict[str, Any]:
                    "edge_type": prog.edge(u, v).typ.value}
                   for u, v in prog.graph.edges],
     }
+
+
+async def _serve() -> None:
+    import logging
+    import os
+
+    from ..config import config
+    from ..controller.controller import ControllerServer
+    from ..obs.logging_setup import init_logging
+
+    init_logging("api")
+    controller = ControllerServer(
+        host=os.environ.get("CONTROLLER_HOST", "0.0.0.0"))
+    await controller.start(port=int(os.environ.get("CONTROLLER_PORT",
+                                                   "9190")))
+    api = ApiServer(controller,
+                    db_path=os.environ.get("API_DB", ":memory:"))
+    port = await api.start(host=os.environ.get("API_HOST", "0.0.0.0"),
+                           port=int(os.environ.get("API_PORT", "8000")))
+    logging.getLogger(__name__).info(
+        "REST API on :%s (controller grpc at %s, checkpoints -> %s)",
+        port, controller.addr, config().checkpoint_url)
+    import asyncio
+
+    await asyncio.Event().wait()
+
+
+def main() -> None:
+    """``python -m arroyo_tpu.api.rest``: REST API + controller in one
+    process — the single-node deployment entrypoint (deploy/)."""
+    import asyncio
+
+    asyncio.run(_serve())
+
+
+if __name__ == "__main__":
+    main()

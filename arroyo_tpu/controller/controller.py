@@ -106,6 +106,8 @@ class ControllerServer:
     # -- lifecycle ---------------------------------------------------------
 
     async def start(self, port: int = 0) -> str:
+        import os
+
         self.rpc.add_service("ControllerGrpc", {
             "RegisterWorker": self._register_worker,
             "Heartbeat": self._heartbeat,
@@ -119,7 +121,11 @@ class ControllerServer:
             "SendSinkData": self._send_sink_data,
         }, stream_methods={"SubscribeToOutput": self._subscribe_output})
         p = await self.rpc.start(self.host, port)
-        self.addr = f"{self.host}:{p}"
+        # the address workers DIAL: a 0.0.0.0 bind is not dialable, so
+        # deployments advertise the service address instead
+        self.addr = os.environ.get(
+            "CONTROLLER_ADVERTISE_ADDR",
+            f"{'127.0.0.1' if self.host == '0.0.0.0' else self.host}:{p}")
         return self.addr
 
     async def stop(self) -> None:
@@ -520,3 +526,29 @@ class ControllerServer:
                     return
         finally:
             self.sink_subscribers[req["job_id"]].remove(q)
+
+
+def main() -> None:
+    """``python -m arroyo_tpu.controller.controller``: standalone
+    controller (deploy/ role 'controller'; the API talks to it over
+    gRPC from another pod)."""
+    import os
+
+    from ..obs.logging_setup import init_logging
+
+    async def serve() -> None:
+        init_logging("controller")
+        ctrl = ControllerServer(host=os.environ.get("CONTROLLER_HOST",
+                                                    "0.0.0.0"))
+        await ctrl.start(port=int(os.environ.get("CONTROLLER_PORT",
+                                                 "9190")))
+        logger.info("controller grpc at %s (advertised: set "
+                    "CONTROLLER_ADVERTISE_ADDR for cross-pod dialing)",
+                    ctrl.addr)
+        await asyncio.Event().wait()
+
+    asyncio.run(serve())
+
+
+if __name__ == "__main__":
+    main()

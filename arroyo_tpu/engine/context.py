@@ -177,12 +177,25 @@ class Collector:
         self.edge_groups = edge_groups
         self.metrics = metrics
         self._rr = [0] * len(edge_groups)  # round-robin cursor per group
+        self._local_qs = [q.queue for g in edge_groups for q in g
+                          if q.queue is not None]
+
+    def _update_queue_gauges(self) -> None:
+        # backpressure visibility (engine.rs QueueSizes -> prometheus
+        # gauges the console graphs): capacity and remaining slots across
+        # this subtask's outbound queues
+        qs = self._local_qs
+        if qs:
+            self.metrics.tx_queue_size.set(sum(q.maxsize for q in qs))
+            self.metrics.tx_queue_rem.set(
+                sum(max(q.maxsize - q.qsize(), 0) for q in qs))
 
     async def collect(self, batch: Batch) -> None:
         if len(batch) == 0:
             return
         if self.metrics is not None:
             self.metrics.messages_sent.inc(len(batch))
+            self._update_queue_gauges()
         for gi, group in enumerate(self.edge_groups):
             n = len(group)
             if n == 1:

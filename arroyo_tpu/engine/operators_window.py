@@ -652,8 +652,9 @@ class SemiJoinOperator(Operator):
         uniq, first = np.unique(batch.key_hash, return_index=True)
         fresh = np.array([self.rkeys.get(int(k)) is None for k in uniq])
         for k, i in zip(uniq.tolist(), first.tolist()):
-            prev_t = self.rkeys.get_time(int(k)) or 0
-            self.rkeys.insert(max(int(batch.timestamp[i]), prev_t),
+            prev_t = self.rkeys.get_time(int(k))
+            t = int(batch.timestamp[i])
+            self.rkeys.insert(t if prev_t is None else max(t, prev_t),
                               int(k), True)
         if not fresh.any():
             return

@@ -105,14 +105,16 @@ class NodeServer:
                 del self._procs[wid]
                 logger.info("worker %s exited rc=%s", wid, p.returncode)
                 if meta:
-                    client = RpcClient(meta["ctrl"], "ControllerGrpc")
+                    client = None
                     try:
+                        client = RpcClient(meta["ctrl"], "ControllerGrpc")
                         await client.call("WorkerFinished", {
                             "worker_id": wid, "job_id": meta["job_id"]})
                     except Exception as e:
                         logger.warning("WorkerFinished report failed: %s", e)
                     finally:
-                        await client.close()
+                        if client is not None:
+                            await client.close()
 
 
 async def run_node(port: int = 0, host: str = "127.0.0.1") -> None:

@@ -50,16 +50,18 @@ def _update_kernel(kinds: Tuple[str, ...], C: int, B: int, n: int):
     def run(values, counts, packed):
         # ONE packed f32[k+3, n] input (one host->device transfer — a
         # tunneled TPU pays per-transfer latency): rows are
-        # [slots, bins, valid, channel values...]; slot/bin/valid values
-        # are small integers, exact in f32
+        # [slots, bins, rowcount, channel values...] per pre-aggregated
+        # (key, bin) cell; slot/bin/count values are small integers,
+        # exact in f32.  rowcount 0 marks padding.
         slots = packed[0].astype(jnp.int32)
         bins = packed[1].astype(jnp.int32)
-        valid = packed[2] > 0.5
+        rowcnt = packed[2]
+        valid = rowcnt > 0.5
         vals = packed[3:]
         s = jnp.where(valid, slots, C)  # trash row
         b = jnp.where(valid, bins, 0)
         counts = counts.at[s.clip(0, C - 1), b].add(
-            jnp.where(valid & (s < C), 1, 0))
+            jnp.where(valid & (s < C), rowcnt, 0.0).astype(counts.dtype))
         outs = []
         for i, kind in enumerate(kinds):
             v = values[i]
@@ -185,6 +187,40 @@ def channel_input(aggs: Tuple[AggSpec, ...], ch_kinds: Tuple[str, ...],
         return ok.astype(np.float32)
     ident = _init_value(AggKind(ch_kinds[j]))
     return np.where(ok, raw, np.float32(ident)).astype(np.float32)
+
+
+def preaggregate(kh: np.ndarray, bins: np.ndarray,
+                 ch_kinds: Tuple[str, ...], vals: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Two-phase aggregation, local half: reduce rows with the same
+    (key, bin) on the host BEFORE device dispatch (the reference's
+    TumblingLocalAggregator, plan_graph.rs:71-83 / optimizations.rs:241-291
+    — pre-aggregate without shuffle, then the global phase merges bins).
+
+    Every channel kind is reducible (sum/count add, min/max reduce), so
+    this is lossless; under hot-key skew it collapses a 64k-row batch to
+    a few thousand (key, bin) cells — less scatter work AND a smaller
+    host->device transfer.
+
+    Returns (unique key hashes, bins, per-cell row counts, reduced
+    channel values [n_ch, n_cells]); inputs must be live rows only.
+    """
+    order = np.lexsort((bins, kh))
+    kh_s, bin_s = kh[order], bins[order]
+    is_first = np.ones(len(kh_s), dtype=bool)
+    is_first[1:] = (kh_s[1:] != kh_s[:-1]) | (bin_s[1:] != bin_s[:-1])
+    starts = is_first.nonzero()[0]
+    vals_s = vals[:, order]
+    out = np.empty((len(ch_kinds), len(starts)), dtype=np.float32)
+    for j, kind in enumerate(ch_kinds):
+        if kind == "min":
+            out[j] = np.minimum.reduceat(vals_s[j], starts)
+        elif kind == "max":
+            out[j] = np.maximum.reduceat(vals_s[j], starts)
+        else:  # sum / count channels are additive
+            out[j] = np.add.reduceat(vals_s[j], starts)
+    rowcnt = np.diff(np.append(starts, len(kh_s))).astype(np.float32)
+    return kh_s[starts], bin_s[starts], rowcnt, out
 
 
 def directory_insert(state, kh: np.ndarray, ensure_capacity) -> np.ndarray:
@@ -313,22 +349,34 @@ class KeyedBinState:
 
         slots = self._lookup_or_insert(key_hash)
 
+        # two-phase, local half: reduce rows per (slot, bin) on the host
+        # before any device work (TumblingLocalAggregator analog) — under
+        # hot-key skew this collapses the batch by orders of magnitude
+        vals = np.empty((len(self._ch_kinds), n), dtype=np.float32)
+        for j in range(len(self._ch_kinds)):
+            vals[j] = self._channel_input(j, agg_inputs, n)
+        if not live.all():
+            idx = live.nonzero()[0]
+            slots, bins_mod, vals = slots[idx], bins_mod[idx], vals[:, idx]
+        slots_c, bins_c, rowcnt, vals_c = preaggregate(
+            slots, bins_mod, self._ch_kinds, vals)
+        m = len(slots_c)
+
         # additive aggregates route through the Pallas MXU scatter (one-hot
         # matmul) instead of XLA's serial scatter; min/max stay on XLA
         if self._use_pallas():
-            self._update_pallas(slots, bins_mod, live, agg_inputs, n)
+            self._update_pallas(slots_c, bins_c, rowcnt, vals_c)
             return
 
-        npad = _bucket(n, floor=256)
+        npad = _bucket(m, floor=256)
         # slot/bin indices ride the packed f32 transfer: exact only below
         # 2^24 (a key table this size would be hundreds of GB anyway)
         assert self.C <= 1 << 24, "key capacity exceeds f32-exact packing"
         packed = np.zeros((len(self._ch_kinds) + 3, npad), dtype=np.float32)
-        packed[0, :n] = slots
-        packed[1, :n] = bins_mod
-        packed[2, :n] = live
-        for j in range(len(self._ch_kinds)):
-            packed[3 + j, :n] = self._channel_input(j, agg_inputs, n)
+        packed[0, :m] = slots_c
+        packed[1, :m] = bins_c
+        packed[2, :m] = rowcnt
+        packed[3:, :m] = vals_c
 
         from ..obs.perf import timed_device
 
@@ -354,18 +402,15 @@ class KeyedBinState:
         P = 2 * (len(self._ch_kinds) + 1) * self.B
         return ((P + LANES - 1) // LANES) * LANES <= 1024
 
-    def _update_pallas(self, slots: np.ndarray, bins_mod: np.ndarray,
-                       live: np.ndarray, agg_inputs: Dict[str, np.ndarray],
-                       n: int) -> None:
+    def _update_pallas(self, slots_c: np.ndarray, bins_c: np.ndarray,
+                       rowcnt: np.ndarray, vals_c: np.ndarray) -> None:
         from .pallas_kernels import (active_capacity, pad_batch,
                                      update_bin_state)
 
-        weights = np.zeros((len(self._ch_kinds) + 1, n), dtype=np.float32)
-        weights[0] = 1.0  # counts channel
-        for j in range(len(self._ch_kinds)):
-            weights[j + 1] = self._channel_input(j, agg_inputs, n)
-        weights[:, ~live] = 0.0
-        s, b, w = pad_batch(slots.astype(np.int32), bins_mod, weights)
+        # pre-aggregated cells: counts channel carries the per-cell row
+        # count (the kernel sums weight channels, so this is exact)
+        weights = np.concatenate([rowcnt[None], vals_c], axis=0)
+        s, b, w = pad_batch(slots_c.astype(np.int32), bins_c, weights)
         c_act = active_capacity(self.next_slot, self.C)
         self.values, self.counts = update_bin_state(
             self.values, self.counts, s, b, w, c_act, self.B)

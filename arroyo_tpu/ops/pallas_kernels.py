@@ -196,6 +196,9 @@ def update_bin_state(values: jnp.ndarray, counts: jnp.ndarray,
     count channel, weights[1:] the aggregate channels."""
     k, n = weights.shape
     assert n % CHUNK == 0
+    # slot ids ride an f32 row: exact only below 2^24 (same guard as the
+    # XLA packing in keyed_bins.update)
+    assert C_act <= 1 << 24, "key capacity exceeds f32-exact packing"
     w2 = _split_hi_lo(np.asarray(weights, np.float32))
     packed = np.empty((2 + w2.shape[0], n), dtype=np.float32)
     packed[0] = slots  # small ints: exact in f32

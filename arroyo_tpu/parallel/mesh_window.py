@@ -51,6 +51,7 @@ from ..ops.keyed_bins import (
     build_channels,
     channel_input,
     directory_insert,
+    preaggregate,
 )
 
 EMPTY = np.uint64(0xFFFF_FFFF_FFFF_FFFF)  # sentinel: empty key slot
@@ -154,7 +155,8 @@ def _update_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int, N: int):
                 jnp.where(slot_ok, b_s, 0), mode="drop")
             buf_ok = jnp.zeros((nk * N,), bool).at[tgt].set(
                 ok_s & slot_ok, mode="drop")
-            buf_val = jnp.zeros((n_ch, nk * N), jnp.float32).at[:, tgt].set(
+            buf_val = jnp.zeros((n_ch + 1, nk * N),
+                                jnp.float32).at[:, tgt].set(
                 jnp.where(slot_ok, v_s, 0.0), mode="drop")
             buf_key = jax.lax.all_to_all(
                 buf_key.reshape(nk, N), "keys", 0, 0).reshape(-1)
@@ -163,7 +165,8 @@ def _update_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int, N: int):
             buf_ok = jax.lax.all_to_all(
                 buf_ok.reshape(nk, N), "keys", 0, 0).reshape(-1)
             buf_val = jax.lax.all_to_all(
-                buf_val.reshape(n_ch, nk, N), "keys", 1, 1).reshape(n_ch, -1)
+                buf_val.reshape(n_ch + 1, nk, N), "keys", 1,
+                1).reshape(n_ch + 1, -1)
         else:
             route_drop = jnp.int32(0)
             buf_key = jnp.where(r_ok, r_key, EMPTY)
@@ -203,15 +206,17 @@ def _update_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int, N: int):
                 ch = base.at[o_tgt].max(src, mode="drop")
             chs.append(ch)
 
-        # ---- scatter routed rows
+        # ---- scatter routed cells (host pre-aggregated per (key, bin):
+        # row 0 of the value payload is the per-cell ROW COUNT)
         row_idx = jnp.searchsorted(new_keys, buf_key).clip(0, C - 1)
         row_found = (new_keys[row_idx] == buf_key) & buf_ok
         si = jnp.where(row_found, row_idx, C)
         bi = jnp.where(row_found, buf_bin, 0).clip(0, B - 1)
         new_counts = new_counts.at[si, bi].add(
-            jnp.where(row_found, 1, 0), mode="drop")
+            jnp.where(row_found, buf_val[0], 0.0).astype(new_counts.dtype),
+            mode="drop")
         for j, kind in enumerate(ch_kinds):
-            x = buf_val[j]
+            x = buf_val[j + 1]
             if kind in ("sum", "count"):
                 chs[j] = chs[j].at[si, bi].add(
                     jnp.where(row_found, x, 0.0), mode="drop")
@@ -518,18 +523,28 @@ class MeshKeyedBinState:
 
         vals = _channel_rows(self.aggs, self._ch_kinds, self._valid_of,
                              agg_inputs, n)
-        # pad the batch to nk * N (N power-of-two rows per mesh slice);
-        # each slice holds <= N rows so route buckets cannot overflow
-        N = _bucket(-(-n // self.nk), floor=_MIN_ROWS)
+        # two-phase, local half: reduce rows per (key, bin) on the host
+        # BEFORE routing (TumblingLocalAggregator analog) — shrinks both
+        # the all_to_all payload and the per-shard scatter
+        if not live.all():
+            idx = live.nonzero()[0]
+            kh, rel, vals = kh[idx], rel[idx], vals[:, idx]
+        kh_c, rel_c, rowcnt, vals_c = preaggregate(
+            kh, rel, self._ch_kinds, vals)
+        m = len(kh_c)
+        # pad to nk * N (N power-of-two cells per mesh slice); each slice
+        # holds <= N cells so route buckets cannot overflow
+        N = _bucket(-(-m // self.nk), floor=_MIN_ROWS)
         total = self.nk * N
         kh_p = np.full(total, EMPTY, np.uint64)
-        kh_p[:n] = kh
+        kh_p[:m] = kh_c
         rel_p = np.zeros(total, np.int32)
-        rel_p[:n] = rel
+        rel_p[:m] = rel_c
         ok_p = np.zeros(total, bool)
-        ok_p[:n] = live
-        vals_p = np.zeros((len(self._ch_kinds), total), np.float32)
-        vals_p[:, :n] = vals
+        ok_p[:m] = True
+        vals_p = np.zeros((len(self._ch_kinds) + 1, total), np.float32)
+        vals_p[0, :m] = rowcnt
+        vals_p[1:, :m] = vals_c
 
         import jax
         import jax.numpy as jnp

@@ -1,0 +1,10 @@
+#!/bin/sh
+# Role selector: api (REST + controller in one process), worker, node.
+set -e
+case "${1:-api}" in
+  api)        exec python -m arroyo_tpu.api.rest ;;
+  controller) exec python -m arroyo_tpu.controller.controller ;;
+  worker)     exec python -m arroyo_tpu.worker.server ;;
+  node)       exec python -m arroyo_tpu.node.daemon ;;
+  *)          exec "$@" ;;
+esac

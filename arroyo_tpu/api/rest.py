@@ -51,6 +51,13 @@ CREATE TABLE IF NOT EXISTS job_log (
     level TEXT NOT NULL,
     message TEXT NOT NULL
 );
+CREATE TABLE IF NOT EXISTS connection_profiles (
+    id TEXT PRIMARY KEY,
+    name TEXT UNIQUE NOT NULL,
+    connector TEXT NOT NULL,
+    config TEXT NOT NULL,
+    created_at REAL NOT NULL
+);
 CREATE TABLE IF NOT EXISTS connection_tables (
     id TEXT PRIMARY KEY,
     name TEXT UNIQUE NOT NULL,
@@ -305,6 +312,69 @@ class ApiServer:
             return {"data": data,
                     "last_successful_epoch": job.last_successful_epoch}
 
+        @r.get("/v1/pipelines/{pid}/jobs/{jid}/checkpoints/{epoch}"
+               "/operator_checkpoint_groups")
+        async def checkpoint_details(req: Request):
+            """Per-operator checkpoint detail for one epoch: file sizes
+            written by each operator's subtasks (get_checkpoint_details,
+            jobs.rs — the reference reads its DB rows; here the parquet
+            layout itself is the record)."""
+            job = self.controller.jobs.get(req.params["jid"])
+            if job is None:
+                raise HttpError(404, "no such job")
+            try:
+                epoch = int(req.params["epoch"])
+            except ValueError:
+                raise HttpError(400, "epoch must be an integer")
+            import asyncio
+
+            from ..state.backend import ParquetBackend
+
+            backend = ParquetBackend.for_url(job.checkpoint_url)
+            ckpt_dir = backend.checkpoint_dir(job.job_id, epoch) + "/"
+            store = backend.storage
+
+            def scan():
+                groups: Dict[str, Dict[str, Any]] = {}
+                finished = None
+                try:
+                    files = store.list(ckpt_dir)
+                except Exception:
+                    files = []
+                for f in files:
+                    rel = f[len(ckpt_dir):]
+                    head = rel.split("/", 1)[0]
+                    if head == "metadata.json":
+                        try:
+                            finished = bool(json.loads(
+                                store.get(f)).get("complete"))
+                        except Exception:
+                            pass
+                        continue
+                    # directory names are operator-<id>: report the bare
+                    # id so clients can correlate with the metrics groups
+                    op = head[len("operator-"):] \
+                        if head.startswith("operator-") else head
+                    g = groups.setdefault(op, {"operator_id": op,
+                                               "bytes": 0, "files": []})
+                    try:
+                        size = store.size(f)  # stat, not a full download
+                    except Exception:
+                        size = 0
+                    g["bytes"] += size
+                    g["files"].append({"path": rel, "bytes": size})
+                return groups, finished
+
+            # listing + stats can hit object storage: off the event loop
+            groups, finished = await asyncio.get_event_loop() \
+                .run_in_executor(None, scan)
+            if finished is None:
+                tr = job.trackers.get(epoch)
+                finished = bool(tr.done) if tr else None
+            return {"epoch": epoch, "finished": finished,
+                    "data": sorted(groups.values(),
+                                   key=lambda g: g["operator_id"])}
+
         @r.get("/v1/pipelines/{pid}/jobs/{jid}/operator_metric_groups")
         async def operator_metrics(req: Request):
             """Per-operator throughput metrics (metrics.rs:42-60 queries
@@ -337,6 +407,57 @@ class ApiServer:
                 raise HttpError(404, "no such job")
             return SseResponse(self._tail_output(jid))
 
+        # ---- connection profiles (connection_profiles.rs analog:
+        # reusable connector credentials/config shared across tables) ----
+
+        @r.post("/v1/connection_profiles")
+        async def create_connection_profile(req: Request):
+            body = req.json()
+            for f in ("name", "connector", "config"):
+                if f not in body:
+                    raise HttpError(400, f"missing '{f}'")
+            pid = f"cp_{uuid.uuid4().hex[:12]}"
+            try:
+                with self.db:
+                    self.db.execute(
+                        "INSERT INTO connection_profiles (id, name, "
+                        "connector, config, created_at) VALUES (?,?,?,?,?)",
+                        (pid, body["name"], body["connector"],
+                         json.dumps(body["config"]), time.time()))
+            except sqlite3.IntegrityError:
+                raise HttpError(409,
+                                f"profile {body['name']!r} already exists")
+            return {"id": pid, "name": body["name"],
+                    "connector": body["connector"],
+                    "config": body["config"]}
+
+        @r.get("/v1/connection_profiles")
+        async def list_connection_profiles(req: Request):
+            rows = self.db.execute(
+                "SELECT * FROM connection_profiles ORDER BY created_at"
+            ).fetchall()
+            return {"data": [{
+                "id": row["id"], "name": row["name"],
+                "connector": row["connector"],
+                "config": json.loads(row["config"]),
+            } for row in rows]}
+
+        @r.post("/v1/connection_tables/schemas/test")
+        async def test_schema(req: Request):
+            """Validate a JSON schema document (test_schema analog:
+            the reference checks the schema compiles to valid types)."""
+            body = req.json()
+            schema = body.get("schema")
+            if not isinstance(schema, dict):
+                return {"ok": False, "error": "missing 'schema' object"}
+            try:
+                from ..formats import columns_from_json_schema
+
+                cols = columns_from_json_schema(schema)
+                return {"ok": True, "columns": cols}
+            except Exception as e:
+                return {"ok": False, "error": str(e)}
+
         # ---- connectors & connection tables ----
 
         @r.get("/v1/connectors")
@@ -353,8 +474,21 @@ class ApiServer:
             for f in ("name", "connector", "config"):
                 if f not in body:
                     raise HttpError(400, f"missing '{f}'")
+            if not isinstance(body["config"], dict):
+                raise HttpError(422, "'config' must be an object")
+            cfg_in = dict(body["config"])
+            if body.get("connection_profile_id"):
+                row = self.db.execute(
+                    "SELECT * FROM connection_profiles WHERE id = ?",
+                    (body["connection_profile_id"],)).fetchone()
+                if row is None:
+                    raise HttpError(404, "no such connection profile")
+                if row["connector"] != body["connector"]:
+                    raise HttpError(409, "profile is for connector "
+                                    f"{row['connector']!r}")
+                cfg_in = {**json.loads(row["config"]), **cfg_in}
             try:
-                cfg = validate_config(body["connector"], body["config"])
+                cfg = validate_config(body["connector"], cfg_in)
             except KeyError:
                 raise HttpError(400,
                                 f"unknown connector {body['connector']!r}")

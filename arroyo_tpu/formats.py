@@ -331,3 +331,35 @@ def make_format(name: str, **opts: Any) -> Format:
     if name in ("raw", "raw_string"):
         return RawStringFormat()
     raise ValueError(f"unknown format: {name!r}")
+
+
+def columns_from_json_schema(schema: Dict[str, Any]) -> List[Dict[str, str]]:
+    """JSON schema -> column list (the API's test_schema path,
+    arroyo-api/src/json_schema.rs: schemas must flatten to typed
+    columns).  Raises on non-object roots and unsupported types."""
+    t0 = schema.get("type")
+    if isinstance(t0, list):  # nullable object root/nested
+        t0 = next((x for x in t0 if x != "null"), None)
+    if t0 != "object":
+        raise ValueError("schema root must be an object")
+    kind_of = {"integer": "bigint", "number": "double", "string": "text",
+               "boolean": "boolean"}
+    cols = []
+    for name, spec in (schema.get("properties") or {}).items():
+        t = spec.get("type")
+        if isinstance(t, list):  # nullable union like ["integer", "null"]
+            t = next((x for x in t if x != "null"), None)
+        if t == "object":
+            for sub in columns_from_json_schema(spec):
+                cols.append({"name": f"{name}.{sub['name']}",
+                             "type": sub["type"]})
+            continue
+        if t not in kind_of:
+            raise ValueError(f"unsupported type {t!r} for field {name!r}")
+        fmt = spec.get("format", "")
+        cols.append({"name": name,
+                     "type": "timestamp" if "date-time" in fmt
+                     else kind_of[t]})
+    if not cols:
+        raise ValueError("schema has no supported properties")
+    return cols

@@ -58,6 +58,10 @@ class StorageProvider:
     def list(self, prefix: str) -> List[str]:
         raise NotImplementedError
 
+    def size(self, key: str) -> int:
+        """Object size in bytes without reading the payload."""
+        return len(self.get(key))
+
     def url_for(self, key: str) -> str:
         return f"{self.scheme}://{os.path.join(self.root, key)}"
 
@@ -106,6 +110,9 @@ class LocalStorage(StorageProvider):
                 out.append(os.path.relpath(full, self.root))
         return sorted(out)
 
+    def size(self, key: str) -> int:
+        return os.path.getsize(self._path(key))
+
     def local_path(self, key: str) -> Optional[str]:
         return self._path(key)
 
@@ -137,9 +144,65 @@ class MemoryStorage(StorageProvider):
         return sorted(k for k in self._store if k.startswith(prefix))
 
 
+class FsspecStorage(StorageProvider):
+    """gs:// / s3:// via fsspec (gcsfs/s3fs — installed in the deploy
+    image; this dev image lacks them, so construction raises a clear
+    error instead of failing at import, mirroring arroyo-storage's
+    object_store feature flags)."""
+
+    def __init__(self, scheme: str, url: str):
+        try:
+            import fsspec
+        except ImportError as e:  # pragma: no cover
+            raise RuntimeError(f"{scheme}:// storage requires fsspec")                 from e
+        try:
+            self.fs = fsspec.filesystem(scheme)
+        except (ImportError, ValueError) as e:
+            raise RuntimeError(
+                f"{scheme}:// storage requires "
+                f"{'gcsfs' if scheme == 'gs' else 's3fs'}, which is not "
+                "installed in this image; use file:// or memory://") from e
+        parsed = urlparse(url)
+        super().__init__(scheme, parsed.netloc + parsed.path.rstrip("/"))
+
+    def _path(self, key: str) -> str:
+        return f"{self.root}/{key}" if key else self.root
+
+    def put(self, key: str, data: bytes) -> str:
+        with self.fs.open(self._path(key), "wb") as f:
+            f.write(data)
+        return self._path(key)
+
+    def get(self, key: str) -> bytes:
+        with self.fs.open(self._path(key), "rb") as f:
+            return f.read()
+
+    def exists(self, key: str) -> bool:
+        return self.fs.exists(self._path(key))
+
+    def delete_if_present(self, key: str) -> None:
+        try:
+            self.fs.rm(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def delete_prefix(self, prefix: str) -> None:
+        try:
+            self.fs.rm(self._path(prefix), recursive=True)
+        except FileNotFoundError:
+            pass
+
+    def list(self, prefix: str) -> List[str]:
+        base = self._path(prefix)
+        try:
+            files = self.fs.find(base)
+        except FileNotFoundError:
+            return []
+        return sorted(f[len(self.root) + 1:] for f in files)
+
+    def size(self, key: str) -> int:
+        return int(self.fs.size(self._path(key)))
+
+
 def _fsspec_storage(scheme: str, url: str) -> StorageProvider:
-    raise RuntimeError(
-        f"{scheme}:// storage requires gcsfs/s3fs which are not installed in "
-        "this image; use file:// or memory:// (cloud storage is gated, "
-        "mirroring arroyo-storage's object_store feature flags)"
-    )
+    return FsspecStorage(scheme, url)

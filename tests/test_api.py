@@ -219,3 +219,96 @@ def test_openapi_spec(api_env):
     assert "/v1/pipelines/{id}" in paths
     assert paths["/v1/pipelines/{id}"]["get"]["parameters"][0]["name"] == "id"
     assert "/v1/connection_tables" in paths
+
+
+def test_connection_profiles_and_schema_test(api_env):
+    """Connection profiles (shared connector config merged into tables)
+    and JSON-schema validation (connection_profiles.rs / test_schema)."""
+    loop, _ctrl, url = api_env
+
+    async def go():
+        async with httpx.AsyncClient() as c:
+            r = await c.post(f"{url}/v1/connection_profiles", json={
+                "name": "kafka-prod", "connector": "kafka",
+                "config": {"bootstrap_servers": "memory://prof"}})
+            assert r.status_code == 200, r.text
+            prof = r.json()
+            listed = (await c.get(
+                f"{url}/v1/connection_profiles")).json()["data"]
+            assert [p["name"] for p in listed] == ["kafka-prod"]
+
+            # table config merges the profile's connector settings
+            r = await c.post(f"{url}/v1/connection_tables", json={
+                "name": "evts", "connector": "kafka",
+                "connection_profile_id": prof["id"],
+                "config": {"topic": "t1"}})
+            assert r.status_code == 200, r.text
+            assert r.json()["config"]["bootstrap_servers"] == "memory://prof"
+
+            # profile/connector mismatch is a conflict
+            r = await c.post(f"{url}/v1/connection_tables", json={
+                "name": "evts2", "connector": "impulse",
+                "connection_profile_id": prof["id"], "config": {}})
+            assert r.status_code == 409
+
+            r = await c.post(
+                f"{url}/v1/connection_tables/schemas/test", json={
+                    "schema": {"type": "object", "properties": {
+                        "id": {"type": "integer"},
+                        "name": {"type": ["string", "null"]},
+                        "at": {"type": "string", "format": "date-time"},
+                        "nested": {"type": "object", "properties": {
+                            "x": {"type": "number"}}},
+                    }}})
+            j = r.json()
+            assert j["ok"], j
+            types = {c_["name"]: c_["type"] for c_ in j["columns"]}
+            assert types == {"id": "bigint", "name": "text",
+                             "at": "timestamp", "nested.x": "double"}
+
+            r = await c.post(
+                f"{url}/v1/connection_tables/schemas/test",
+                json={"schema": {"type": "array"}})
+            assert not r.json()["ok"]
+
+    _run(loop, go())
+
+
+def test_checkpoint_details_endpoint(api_env, tmp_path):
+    """Per-operator checkpoint detail lists the parquet files an epoch
+    wrote (get_checkpoint_details analog)."""
+    loop, ctrl, url = api_env
+
+    async def go():
+        async with httpx.AsyncClient() as c:
+            r = await c.post(f"{url}/v1/pipelines", json={
+                "name": "ck", "query": """
+CREATE TABLE impulse WITH (connector = 'impulse', event_rate = '3000',
+  message_count = '100000', batch_size = '512');
+SELECT counter % 5 as k, count(*) as cnt FROM impulse
+GROUP BY 1, tumble(interval '1 second')"""})
+            assert r.status_code == 200, r.text
+            pid = r.json()["id"]
+            jid = r.json()["jobs"][0]["id"]
+            # wait for a finished checkpoint epoch
+            epoch = None
+            for _ in range(300):
+                ck = (await c.get(
+                    f"{url}/v1/pipelines/{pid}/jobs/{jid}/checkpoints")
+                ).json()
+                epoch = ck.get("last_successful_epoch")
+                if epoch:
+                    break
+                await asyncio.sleep(0.1)
+            assert epoch, ck
+            r = await c.get(
+                f"{url}/v1/pipelines/{pid}/jobs/{jid}/checkpoints/"
+                f"{epoch}/operator_checkpoint_groups")
+            j = r.json()
+            assert j["epoch"] == epoch
+            assert j["data"], j  # at least one operator wrote state
+            assert all(g["bytes"] > 0 for g in j["data"])
+            await c.patch(f"{url}/v1/pipelines/{pid}",
+                          json={"stop": "immediate"})
+
+    _run(loop, go())

@@ -416,6 +416,8 @@ class ApiServer:
             for f in ("name", "connector", "config"):
                 if f not in body:
                     raise HttpError(400, f"missing '{f}'")
+            if not isinstance(body["config"], dict):
+                raise HttpError(422, "'config' must be an object")
             pid = f"cp_{uuid.uuid4().hex[:12]}"
             try:
                 with self.db:

@@ -79,7 +79,8 @@ def _ensure_builtin() -> None:
         return
     _loaded = True
     from . import impulse, single_file, blackhole, memory, nexmark, preview  # noqa: F401
-    for mod in ("filesystem", "http_connectors", "kafka", "websocket_connector"):
+    for mod in ("filesystem", "http_connectors", "kafka",
+                "websocket_connector", "kinesis"):
         try:
             __import__(f"arroyo_tpu.connectors.{mod}")
         except ImportError:

@@ -153,9 +153,7 @@ class FsspecStorage(StorageProvider):
     def __init__(self, scheme: str, url: str):
         try:
             import fsspec
-        except ImportError as e:  # pragma: no cover
-            raise RuntimeError(f"{scheme}:// storage requires fsspec")                 from e
-        try:
+
             self.fs = fsspec.filesystem(scheme)
         except (ImportError, ValueError) as e:
             raise RuntimeError(

@@ -22,7 +22,8 @@ import hashlib
 import hmac
 import json
 import os
-from typing import Any, Dict, List, Optional
+import time
+from typing import Any, Dict, List, Literal, Optional
 
 from pydantic import BaseModel
 
@@ -41,7 +42,7 @@ class KinesisConfig(BaseModel):
     format: str = "json"
     batch_size: Optional[int] = None
     max_messages: Optional[int] = None  # bounded runs (tests)
-    offset: str = "earliest"  # earliest | latest
+    offset: Literal["earliest", "latest"] = "earliest"
     partition_key_field: Optional[str] = None  # sink routing
     endpoint_url: Optional[str] = None  # localstack/testing
 
@@ -105,14 +106,35 @@ class KinesisClient:
         return headers
 
     def _call(self, action: str, payload: Dict[str, Any]) -> Dict[str, Any]:
+        import urllib.error
         import urllib.request
 
         body = json.dumps(payload).encode()
-        headers = self._sign(body, f"Kinesis_20131202.{action}")
-        req = urllib.request.Request(self.endpoint, data=body,
-                                     headers=headers, method="POST")
-        with urllib.request.urlopen(req, timeout=30) as r:
-            return json.loads(r.read() or b"{}")
+        # throttling (ProvisionedThroughputExceeded / LimitExceeded), 5xx,
+        # and transport-level failures (connection reset, DNS, timeout) are
+        # transient: retry with exponential backoff, as the AWS SDKs do
+        delay = 0.2
+        for attempt in range(6):
+            headers = self._sign(body, f"Kinesis_20131202.{action}")
+            req = urllib.request.Request(self.endpoint, data=body,
+                                         headers=headers, method="POST")
+            try:
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return json.loads(r.read() or b"{}")
+            except urllib.error.HTTPError as e:
+                detail = e.read().decode(errors="replace")
+                transient = e.code >= 500 or (
+                    e.code == 400 and ("ThroughputExceeded" in detail
+                                       or "LimitExceeded" in detail))
+                if not transient or attempt == 5:
+                    raise RuntimeError(
+                        f"kinesis {action} failed ({e.code}): {detail[:300]}")
+            except (urllib.error.URLError, TimeoutError, OSError) as e:
+                if attempt == 5:
+                    raise RuntimeError(f"kinesis {action} failed: {e}")
+            time.sleep(delay)
+            delay = min(delay * 2, 5.0)
+        raise AssertionError("unreachable")
 
     # -- API surface the connector uses ------------------------------------
 
@@ -140,11 +162,24 @@ class KinesisClient:
 
     def put_records(self, stream: str,
                     records: List[Dict[str, str]]) -> None:
-        out = self._call("PutRecords",
-                         {"StreamName": stream, "Records": records})
-        failed = out.get("FailedRecordCount", 0)
-        if failed:
-            raise RuntimeError(f"kinesis PutRecords: {failed} failed")
+        # PutRecords throttling surfaces as HTTP 200 with per-record
+        # failures (FailedRecordCount > 0): retry exactly the failed
+        # subset with backoff, the way the AWS SDKs do
+        pending = records
+        delay = 0.2
+        for attempt in range(6):
+            out = self._call("PutRecords",
+                             {"StreamName": stream, "Records": pending})
+            if not out.get("FailedRecordCount", 0):
+                return
+            results = out.get("Records", [])
+            pending = [r for r, res in zip(pending, results)
+                       if res.get("ErrorCode")] or pending
+            time.sleep(delay)
+            delay = min(delay * 2, 5.0)
+        raise RuntimeError(
+            f"kinesis PutRecords: {len(pending)} records still failing "
+            "after retries")
 
 
 _TEST_CLIENTS: Dict[str, Any] = {}
@@ -153,6 +188,18 @@ _TEST_CLIENTS: Dict[str, Any] = {}
 def register_test_client(stream: str, client: Any) -> None:
     """Testing hook: inject a fake client for ``stream``."""
     _TEST_CLIENTS[stream] = client
+
+
+def unregister_test_client(stream: str) -> None:
+    _TEST_CLIENTS.pop(stream, None)
+
+
+def _owns_shard(shard_id: str, task_index: int, parallelism: int) -> bool:
+    """Deterministic shard->subtask assignment, stable across reshards: a
+    shard's owner depends only on its id, never on its position in the
+    (changing) ListShards result."""
+    h = int.from_bytes(hashlib.md5(shard_id.encode()).digest()[:8], "big")
+    return h % parallelism == task_index
 
 
 def _client_for(cfg: KinesisConfig):
@@ -175,39 +222,45 @@ class KinesisSource(SourceOperator):
         client = _client_for(self.cfg)
         state = ctx.state.get_global_keyed_state("s")
         loop = asyncio.get_event_loop()
-        shards = await loop.run_in_executor(
-            None, client.list_shards, self.cfg.stream_name)
         me, n = ctx.task_info.task_index, ctx.task_info.parallelism
-        my_shards = [s for i, s in enumerate(sorted(shards)) if i % n == me]
-        if not my_shards:
-            return SourceFinishType.FINAL
 
         async def open_iter(sh: str) -> str:
             return await loop.run_in_executor(
                 None, client.get_shard_iterator, self.cfg.stream_name, sh,
                 state.get(sh), self.cfg.offset == "latest")
 
+        # shards this subtask has fully drained (NextShardIterator == None,
+        # i.e. closed by a reshard); they stay in ListShards for the whole
+        # retention window and must not be re-opened
+        drained: set = set()
         iters: Dict[str, str] = {}
-        for sh in my_shards:
-            iters[sh] = await open_iter(sh)
+
+        async def discover() -> None:
+            fresh = await loop.run_in_executor(
+                None, client.list_shards, self.cfg.stream_name)
+            for sh in sorted(fresh):
+                if (_owns_shard(sh, me, n) and sh not in iters
+                        and sh not in drained):
+                    iters[sh] = await open_iter(sh)
+
+        await discover()
+        # A subtask with no shards today must keep polling: a reshard can
+        # create child shards that hash to it tomorrow.
 
         runner = getattr(ctx, "_runner", None)
         # the real GetRecords API rejects Limit > 10000
         batch_size = min(self.cfg.batch_size
                          or config().target_batch_size, 10_000)
+        # bounded runs are the test rig: poll fast. Unbounded runs pace idle
+        # polling to stay within the 5 reads/sec/shard API limit.
+        idle_sleep = 0.05 if self.cfg.max_messages is not None else 0.2
         total = 0
         idle_spins = 0
         loops = 0
         while True:
             loops += 1
-            if loops % 200 == 0:
-                # resharding: discover child shards; closed parents have
-                # already been dropped below when their iterator ended
-                fresh = await loop.run_in_executor(
-                    None, client.list_shards, self.cfg.stream_name)
-                for i, sh in enumerate(sorted(fresh)):
-                    if i % n == me and sh not in iters:
-                        iters[sh] = await open_iter(sh)
+            if loops % 200 == 0 or (not iters and loops % 20 == 0):
+                await discover()  # resharding: pick up new child shards
             got = 0
             for sh in list(iters):
                 out = await loop.run_in_executor(
@@ -222,10 +275,9 @@ class KinesisSource(SourceOperator):
                 nxt = out.get("NextShardIterator")
                 if nxt is None:  # shard closed (reshard): stop reading it
                     del iters[sh]
+                    drained.add(sh)
                 else:
                     iters[sh] = nxt
-            if not iters and self.cfg.max_messages is None:
-                return SourceFinishType.FINAL  # all shards closed
             if runner is not None:
                 cm = await runner.poll_source_control()
                 if cm is not None and cm.kind == "stop":
@@ -239,7 +291,7 @@ class KinesisSource(SourceOperator):
                 idle_spins += 1
                 if self.cfg.max_messages is not None and idle_spins > 50:
                     return SourceFinishType.FINAL  # bounded run drained
-                await asyncio.sleep(0.05)
+                await asyncio.sleep(idle_sleep)
             else:
                 idle_spins = 0
                 await asyncio.sleep(0)

@@ -80,7 +80,7 @@ def _ensure_builtin() -> None:
     _loaded = True
     from . import impulse, single_file, blackhole, memory, nexmark, preview  # noqa: F401
     for mod in ("filesystem", "http_connectors", "kafka",
-                "websocket_connector", "kinesis"):
+                "websocket_connector", "kinesis", "fluvio"):
         try:
             __import__(f"arroyo_tpu.connectors.{mod}")
         except ImportError:

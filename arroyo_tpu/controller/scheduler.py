@@ -11,6 +11,7 @@
   (kubernetes.rs analog; slots map to TPU chips per SURVEY §2 #34).
 * :class:`NodeScheduler` — workers placed on a pool of
   ``arroyo_tpu.node`` daemons (schedulers/mod.rs:316-664 analog).
+* :class:`NomadScheduler` — worker-per-Nomad-batch-job (nomad.rs analog).
 """
 
 from __future__ import annotations
@@ -317,6 +318,134 @@ class KubernetesScheduler(Scheduler):
                 if p.get("status", {}).get("phase") in ("Running", "Pending")]
 
 
+class NomadApiClient:
+    """Minimal Nomad HTTP API client (no external deps), mirroring the
+    three calls the reference scheduler makes (nomad.rs:38-103): submit a
+    job, list jobs by prefix (with Meta), and stop a job.  Tests inject a
+    fake with the same three methods."""
+
+    def __init__(self, endpoint: Optional[str] = None):
+        self.endpoint = endpoint or os.environ.get(
+            "NOMAD_ENDPOINT", "http://localhost:4646")
+
+    def _request(self, method: str, path: str, body=None) -> object:
+        import json as _json
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.endpoint + path, method=method,
+            data=_json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return _json.loads(r.read() or b"{}")
+
+    def submit_job(self, job: dict) -> dict:
+        return self._request("POST", "/v1/jobs", job)
+
+    def list_jobs(self, prefix: str) -> list:
+        return self._request("GET", f"/v1/jobs?meta=true&prefix={prefix}")
+
+    def delete_job(self, name: str) -> dict:
+        return self._request("DELETE", f"/v1/job/{name}")
+
+
+class NomadScheduler(Scheduler):
+    """Worker-per-Nomad-job scheduling (nomad.rs:18-278 analog).
+
+    Each worker is a ``batch`` Nomad job named ``{job_id}-{run}-{worker}``
+    with restart/reschedule disabled — failure handling belongs to the
+    controller FSM, not Nomad (nomad.rs:155-162).  ``workers_for_job``
+    lists by name prefix and skips dead jobs (nomad.rs:63-103).  Slots per
+    Nomad node and per-slot resources follow the reference's constants,
+    overridable via NOMAD_* env vars."""
+
+    def __init__(self, client=None):
+        self.client = client or NomadApiClient()
+        self.datacenter = os.environ.get("NOMAD_DC", "dc1")
+        self.cpu_per_slot = int(os.environ.get("NOMAD_CPU_PER_SLOT", "3400"))
+        self.mem_per_slot = int(os.environ.get(
+            "NOMAD_MEMORY_PER_SLOT_MB", "4000"))
+        self._runs: Dict[str, int] = {}
+
+    def make_job(self, job_id: str, run_id: int, worker_id: int,
+                 controller_addr: str, slots: int) -> dict:
+        env = {
+            "PROD": "true",
+            "TASK_SLOTS": str(slots),
+            "WORKER_ID": str(worker_id),
+            "NODE_ID": "1",
+            "JOB_ID": job_id,
+            "RUN_ID": str(run_id),
+            "CONTROLLER_ADDR": controller_addr,
+        }
+        return {"Job": {
+            "ID": f"{job_id}-{run_id}-{worker_id}",
+            "Name": f"{job_id}-{run_id}-{worker_id}",
+            "Type": "batch",
+            "Datacenters": [self.datacenter],
+            "Meta": {
+                "job_id": job_id,
+                "worker_id": str(worker_id),
+                "run_id": str(run_id),
+            },
+            "TaskGroups": [{
+                "Name": "worker",
+                "Count": 1,
+                # the controller owns failure handling (nomad.rs:155-162);
+                # in the Nomad JSON API these policies live on the
+                # TaskGroup, not the Job
+                "RestartPolicy": {"Attempts": 0, "Mode": "fail"},
+                "ReschedulePolicy": {"Attempts": 0, "Unlimited": False},
+                "Tasks": [{
+                    "Name": "worker",
+                    "Driver": "exec",
+                    "Config": {
+                        "command": "python",
+                        "args": ["-m", "arroyo_tpu.worker.server"],
+                    },
+                    "Env": env,
+                    "Resources": {
+                        "CPU": self.cpu_per_slot * slots,
+                        "MemoryMB": self.mem_per_slot * slots,
+                    },
+                }],
+            }],
+        }}
+
+    async def start_workers(self, job_id, controller_addr, n_workers,
+                            slots_per_worker):
+        import random
+
+        run_id = self._runs[job_id] = self._runs.get(job_id, 0) + 1
+        loop = asyncio.get_event_loop()
+        for _ in range(n_workers):
+            worker_id = random.getrandbits(32)
+            job = self.make_job(job_id, run_id, worker_id, controller_addr,
+                                slots_per_worker)
+            await loop.run_in_executor(None, self.client.submit_job, job)
+
+    def _live_jobs(self, job_id: str) -> List[dict]:
+        run = self._runs.get(job_id)
+        prefix = f"{job_id}-{run}-" if run is not None else f"{job_id}-"
+        jobs = self.client.list_jobs(prefix)
+        return [j for j in jobs if j.get("Status") != "dead"]
+
+    async def stop_workers(self, job_id, force=False):
+        loop = asyncio.get_event_loop()
+        # the listing is a blocking HTTP call too: keep it off the loop
+        live = await loop.run_in_executor(None, self._live_jobs, job_id)
+        for j in live:
+            name = j.get("Name") or j.get("ID")
+            try:
+                await loop.run_in_executor(None, self.client.delete_job, name)
+            except Exception:
+                logger.warning("failed to stop nomad job %s", name)
+
+    def workers_for_job(self, job_id):
+        return [j["Meta"]["worker_id"] for j in self._live_jobs(job_id)
+                if j.get("Meta", {}).get("worker_id")]
+
+
 class NodeScheduler(Scheduler):
     """Schedule workers onto a pool of node daemons
     (schedulers/mod.rs:316-664 NodeScheduler analog; daemons are
@@ -383,9 +512,11 @@ def scheduler_from_env() -> Scheduler:
         return InProcessScheduler()
     if mode == "node":
         return NodeScheduler()
+    if mode == "nomad":
+        return NomadScheduler()
     if mode in ("process", ""):
         return ProcessScheduler()
     # a typo must fail fast, not silently spawn subprocesses in the
     # controller container
     raise ValueError(f"unknown SCHEDULER {mode!r}; "
-                     "expected process | kubernetes | embedded | node")
+                     "expected process | kubernetes | embedded | node | nomad")

@@ -100,3 +100,86 @@ def test_out_of_cluster_fails_loudly(monkeypatch):
     s = KubernetesScheduler()  # no client injected
     with pytest.raises(RuntimeError, match="Kubernetes"):
         asyncio.run(s.start_workers("j3", "http://ctl:9190", 1, 1))
+
+
+# ---------------------------------------------------------------------------
+# nomad
+# ---------------------------------------------------------------------------
+
+
+class FakeNomadApi:
+    def __init__(self):
+        self.submitted = []
+        self.deleted = []
+
+    def submit_job(self, job):
+        self.submitted.append(job)
+        return {"EvalID": "e1"}
+
+    def list_jobs(self, prefix):
+        out = []
+        for j in self.submitted:
+            job = j["Job"]
+            if job["ID"].startswith(prefix):
+                status = ("dead" if job["ID"] in self.deleted else "running")
+                out.append({"ID": job["ID"], "Name": job["Name"],
+                            "Status": status, "Meta": job["Meta"]})
+        return out
+
+    def delete_job(self, name):
+        self.deleted.append(name)
+        return {}
+
+
+def test_nomad_job_shape_and_lifecycle():
+    from arroyo_tpu.controller.scheduler import NomadScheduler
+
+    api = FakeNomadApi()
+    s = NomadScheduler(client=api)
+    asyncio.run(s.start_workers("job_x", "http://ctl:9190", 2, 5))
+
+    assert len(api.submitted) == 2
+    job = api.submitted[0]["Job"]
+    assert job["Type"] == "batch"
+    # controller owns failures: nomad must not restart/reschedule — and
+    # these policies live on the TaskGroup in the JSON API
+    group = job["TaskGroups"][0]
+    assert group["RestartPolicy"] == {"Attempts": 0, "Mode": "fail"}
+    assert group["ReschedulePolicy"] == {"Attempts": 0, "Unlimited": False}
+    task = group["Tasks"][0]
+    assert task["Env"]["TASK_SLOTS"] == "5"
+    assert task["Env"]["JOB_ID"] == "job_x"
+    assert task["Env"]["CONTROLLER_ADDR"] == "http://ctl:9190"
+    assert task["Resources"]["CPU"] == 3400 * 5
+
+    workers = s.workers_for_job("job_x")
+    assert len(workers) == 2
+    assert all(w.isdigit() for w in workers)
+
+    asyncio.run(s.stop_workers("job_x"))
+    assert len(api.deleted) == 2
+    assert s.workers_for_job("job_x") == []  # dead jobs are filtered
+
+
+def test_nomad_restart_scopes_to_latest_run():
+    """workers_for_job only sees the current run's jobs, so a stale
+    still-terminating worker from the previous run is not double-counted
+    (nomad.rs:68-72 prefixes by run_id)."""
+    from arroyo_tpu.controller.scheduler import NomadScheduler
+
+    api = FakeNomadApi()
+    s = NomadScheduler(client=api)
+    asyncio.run(s.start_workers("job_y", "http://ctl:9190", 1, 2))
+    first = s.workers_for_job("job_y")
+    # restart: run_id increments; old run's job still listed as running
+    asyncio.run(s.start_workers("job_y", "http://ctl:9190", 1, 2))
+    second = s.workers_for_job("job_y")
+    assert len(second) == 1
+    assert first != second
+
+
+def test_scheduler_from_env_nomad(monkeypatch):
+    monkeypatch.setenv("SCHEDULER", "nomad")
+    from arroyo_tpu.controller.scheduler import NomadScheduler
+
+    assert isinstance(scheduler_from_env(), NomadScheduler)

@@ -244,8 +244,17 @@ class KinesisSource(SourceOperator):
                     iters[sh] = await open_iter(sh)
 
         await discover()
-        # A subtask with no shards today must keep polling: a reshard can
-        # create child shards that hash to it tomorrow.
+        # A subtask with no shards today must keep polling (a reshard can
+        # create child shards that hash to it tomorrow) — but it must also
+        # declare itself IDLE so the job-wide min-watermark doesn't stall
+        # on its silence (the reference broadcasts Watermark::Idle for the
+        # no-partitions case, fluvio/source.rs:185-189).
+        from ..types import Message, Watermark
+
+        idle_declared = False
+        if not iters:
+            await ctx.broadcast(Message.wm(Watermark.idle()))
+            idle_declared = True
 
         runner = getattr(ctx, "_runner", None)
         # the real GetRecords API rejects Limit > 10000
@@ -261,6 +270,12 @@ class KinesisSource(SourceOperator):
             loops += 1
             if loops % 200 == 0 or (not iters and loops % 20 == 0):
                 await discover()  # resharding: pick up new child shards
+            if not iters and not idle_declared:
+                # all owned shards just closed: stop holding the watermark
+                await ctx.broadcast(Message.wm(Watermark.idle()))
+                idle_declared = True
+            elif iters:
+                idle_declared = False
             got = 0
             for sh in list(iters):
                 out = await loop.run_in_executor(

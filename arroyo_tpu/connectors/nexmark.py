@@ -83,6 +83,10 @@ class NexmarkConfig(BaseModel):
     batch_size: Optional[int] = None
     base_time_micros: Optional[int] = None  # pin event-time origin (bench
     # latency math needs wall(T) = wall_base + (T - base_time)/1e6 exactly)
+    # planner-injected projection pushdown: physical columns the query
+    # reads; None = generate everything.  Unused column families (notably
+    # the string columns) are skipped entirely.
+    projection: Optional[List[str]] = None
 
 
 class NexmarkGenerator:
@@ -99,9 +103,20 @@ class NexmarkGenerator:
         self.first_event_number = first_event_number
         self.total_prop = (cfg.person_proportion + cfg.auction_proportion
                            + cfg.bid_proportion)
+        # projection pushdown: None = every column wanted
+        self._want = (None if cfg.projection is None
+                      else set(cfg.projection))
         # inter_event_delay covers the whole generator fleet (mod.rs:331-335):
         # delay = 1e6 / rate * n_generators
         self.rng = np.random.default_rng(seed)
+        # independent per-family streams (the reference seeds per event id,
+        # mod.rs:387-391, so families never share randomness): projection
+        # pushdown can then skip a family without perturbing the others —
+        # generation is exactly projection-invariant
+        self._rngs = {fam: np.random.default_rng([seed, i])
+                      for i, fam in enumerate(
+                          ("auction", "bid", "person_s", "auction_s",
+                           "bid_s"))}
         self.events_so_far = 0
 
     def set_rate(self, rate: float, n_generators: int) -> None:
@@ -139,39 +154,44 @@ class NexmarkGenerator:
         return adj_epoch * ap + adj_offset
 
     def _next_base0_person_id(self, event_id: np.ndarray,
-                              num_people: Optional[np.ndarray] = None
-                              ) -> np.ndarray:
+                              num_people: Optional[np.ndarray] = None,
+                              rng=None) -> np.ndarray:
+        rng = rng or self.rng
         if num_people is None:
             num_people = self._last_base0_person_id(event_id)
         active = np.minimum(num_people, self.cfg.num_active_people)
-        n = (self.rng.random(len(event_id)) * (active + PERSON_ID_LEAD)).astype(np.int64)
+        n = (rng.random(len(event_id)) * (active + PERSON_ID_LEAD)).astype(np.int64)
         return num_people - active + n
 
     def _next_base0_auction_id(self, event_id: np.ndarray,
-                               max_a: Optional[np.ndarray] = None) -> np.ndarray:
+                               max_a: Optional[np.ndarray] = None,
+                               rng=None) -> np.ndarray:
         if max_a is None:
             max_a = self._last_base0_auction_id(event_id)
+        rng = rng or self.rng
         min_a = np.maximum(max_a - self.cfg.num_inflight_auctions, 0)
         span = max_a + 1 + AUCTION_ID_LEAD - min_a
-        return min_a + (self.rng.random(len(event_id)) * span).astype(np.int64)
+        return min_a + (rng.random(len(event_id)) * span).astype(np.int64)
 
     def _timestamp_for(self, event_number: np.ndarray) -> np.ndarray:
         return self.base_time + self.inter_event_delay * event_number
 
-    def _next_price(self, n: int) -> np.ndarray:
-        return (np.power(10.0, self.rng.random(n) * 6.0) * 100.0).astype(np.int64)
+    def _next_price(self, n: int, rng=None) -> np.ndarray:
+        rng = rng or self.rng
+        return (np.power(10.0, rng.random(n) * 6.0) * 100.0).astype(np.int64)
 
-    def _rand_strings(self, n: int, max_len: int) -> np.ndarray:
+    def _rand_strings(self, n: int, max_len: int, rng=None) -> np.ndarray:
         """Vectorized alphanumeric strings with the reference's U(3, max_len)
         length distribution (mod.rs:404-409)."""
         if n == 0:
             return np.zeros(0, dtype=object)
-        lengths = self.rng.integers(MIN_STRING_LENGTH, max(max_len, MIN_STRING_LENGTH + 1), n)
+        rng = rng or self.rng
+        lengths = rng.integers(MIN_STRING_LENGTH, max(max_len, MIN_STRING_LENGTH + 1), n)
         alphabet = np.frombuffer(
             b"ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789",
             dtype="S1")
         maxl = int(lengths.max())
-        chars = alphabet[self.rng.integers(0, 62, (n, maxl))]
+        chars = alphabet[rng.integers(0, 62, (n, maxl))]
         flat = chars.view(f"S{maxl}").reshape(n).astype(str)
         return np.array([s[:l] for s, l in zip(flat, lengths)], dtype=object)
 
@@ -198,6 +218,13 @@ class NexmarkGenerator:
         etype[is_auction] = EVENT_AUCTION
 
         cols: Dict[str, np.ndarray] = {"event_type": etype}
+        # projection pushdown: skip whole column families the query never
+        # reads (column order/rng draws stay deterministic per family for a
+        # given projection, so exactly-once resume regenerates identically)
+        want = self._want
+
+        def w(*names: str) -> bool:
+            return want is None or any(c in want for c in names)
 
         # shared closed forms computed once (the Rust generator recomputes
         # them per event; here per batch)
@@ -205,50 +232,65 @@ class NexmarkGenerator:
         last_auction = self._last_base0_auction_id(event_id)
 
         # persons (next_person, mod.rs:545-587)
-        p_id = np.where(is_person, last_person + FIRST_PERSON_ID, 0)
-        cols["person_id"] = p_id
+        if w("person_id"):
+            cols["person_id"] = np.where(
+                is_person, last_person + FIRST_PERSON_ID, 0)
 
         # auctions (next_auction, mod.rs:419-462)
-        hot_seller = self.rng.random(n) * self.cfg.hot_seller_ratio >= 1.0
-        seller = np.where(
-            hot_seller, (last_person // HOT_SELLER_RATIO) * HOT_SELLER_RATIO,
-            self._next_base0_person_id(event_id, last_person)) + FIRST_PERSON_ID
-        a_id = last_auction + FIRST_AUCTION_ID
-        category = FIRST_CATEGORY_ID + self.rng.integers(0, NUM_CATEGORIES, n)
-        initial_bid = self._next_price(n)
-        reserve = initial_bid + self._next_price(n)
-        # next_auction_length_ms (mod.rs:530-548)
-        num_events_for_auctions = (self.cfg.num_inflight_auctions * self.total_prop) // ap
-        horizon = self.inter_event_delay * num_events_for_auctions  # micros
-        horizon_ms = max(horizon // 1000, 1)
-        length_ms = 1 + np.maximum(
-            (self.rng.random(n) * (horizon_ms * 2)).astype(np.int64), 1)
-        expires = ts + length_ms * 1000
-        cols["auction_id"] = np.where(is_auction, a_id, 0)
-        cols["auction_seller"] = np.where(is_auction, seller, 0)
-        cols["auction_category"] = np.where(is_auction, category, 0)
-        cols["auction_initial_bid"] = np.where(is_auction, initial_bid, 0)
-        cols["auction_reserve"] = np.where(is_auction, reserve, 0)
-        cols["auction_expires"] = np.where(is_auction, expires, 0)
-        cols["auction_datetime"] = np.where(is_auction, ts, 0)
+        if w("auction_id", "auction_seller", "auction_category",
+             "auction_initial_bid", "auction_reserve", "auction_expires",
+             "auction_datetime"):
+            rng_a = self._rngs["auction"]
+            hot_seller = rng_a.random(n) * self.cfg.hot_seller_ratio >= 1.0
+            seller = np.where(
+                hot_seller,
+                (last_person // HOT_SELLER_RATIO) * HOT_SELLER_RATIO,
+                self._next_base0_person_id(event_id, last_person, rng=rng_a)
+            ) + FIRST_PERSON_ID
+            a_id = last_auction + FIRST_AUCTION_ID
+            category = FIRST_CATEGORY_ID + rng_a.integers(
+                0, NUM_CATEGORIES, n)
+            initial_bid = self._next_price(n, rng=rng_a)
+            reserve = initial_bid + self._next_price(n, rng=rng_a)
+            # next_auction_length_ms (mod.rs:530-548)
+            num_events_for_auctions = (
+                self.cfg.num_inflight_auctions * self.total_prop) // ap
+            horizon = self.inter_event_delay * num_events_for_auctions
+            horizon_ms = max(horizon // 1000, 1)
+            length_ms = 1 + np.maximum(
+                (rng_a.random(n) * (horizon_ms * 2)).astype(np.int64), 1)
+            expires = ts + length_ms * 1000
+            cols["auction_id"] = np.where(is_auction, a_id, 0)
+            cols["auction_seller"] = np.where(is_auction, seller, 0)
+            cols["auction_category"] = np.where(is_auction, category, 0)
+            cols["auction_initial_bid"] = np.where(is_auction, initial_bid, 0)
+            cols["auction_reserve"] = np.where(is_auction, reserve, 0)
+            cols["auction_expires"] = np.where(is_auction, expires, 0)
+            cols["auction_datetime"] = np.where(is_auction, ts, 0)
 
         # bids (next_bid, mod.rs:588-631)
-        hot_auction = self.rng.random(n) * self.cfg.hot_auction_ratio >= 1.0
-        bid_auction = np.where(
-            hot_auction,
-            (last_auction // HOT_AUCTION_RATIO) * HOT_AUCTION_RATIO,
-            self._next_base0_auction_id(event_id, last_auction)) + FIRST_AUCTION_ID
-        hot_bidder = self.rng.random(n) * self.cfg.hot_bidders_ratio >= 1.0
-        bidder = np.where(
-            hot_bidder, (last_person // HOT_BIDDER_RATIO) * HOT_BIDDER_RATIO,
-            self._next_base0_person_id(event_id, last_person)) + FIRST_PERSON_ID
-        bid_price = self._next_price(n)
-        cols["bid_auction"] = np.where(is_bid, bid_auction, 0)
-        cols["bid_bidder"] = np.where(is_bid, bidder, 0)
-        cols["bid_price"] = np.where(is_bid, bid_price, 0)
-        cols["bid_datetime"] = np.where(is_bid, ts, 0)
+        if w("bid_auction", "bid_bidder", "bid_price", "bid_datetime"):
+            rng_b = self._rngs["bid"]
+            hot_auction = rng_b.random(n) * self.cfg.hot_auction_ratio >= 1.0
+            bid_auction = np.where(
+                hot_auction,
+                (last_auction // HOT_AUCTION_RATIO) * HOT_AUCTION_RATIO,
+                self._next_base0_auction_id(event_id, last_auction, rng=rng_b)
+            ) + FIRST_AUCTION_ID
+            hot_bidder = rng_b.random(n) * self.cfg.hot_bidders_ratio >= 1.0
+            bidder = np.where(
+                hot_bidder,
+                (last_person // HOT_BIDDER_RATIO) * HOT_BIDDER_RATIO,
+                self._next_base0_person_id(event_id, last_person, rng=rng_b)
+            ) + FIRST_PERSON_ID
+            bid_price = self._next_price(n, rng=rng_b)
+            cols["bid_auction"] = np.where(is_bid, bid_auction, 0)
+            cols["bid_bidder"] = np.where(is_bid, bidder, 0)
+            cols["bid_price"] = np.where(is_bid, bid_price, 0)
+            cols["bid_datetime"] = np.where(is_bid, ts, 0)
 
-        if self.cfg.generate_strings:
+        if self.cfg.generate_strings and w(
+                "person_name", "person_email", "person_city", "person_state"):
             np_idx = is_person.nonzero()[0]
             npn = len(np_idx)
             name = np.empty(n, dtype=object); name[:] = ""
@@ -256,35 +298,41 @@ class NexmarkGenerator:
             city = np.empty(n, dtype=object); city[:] = ""
             state = np.empty(n, dtype=object); state[:] = ""
             if npn:
-                fn = np.array(FIRST_NAMES, dtype=object)[self.rng.integers(0, len(FIRST_NAMES), npn)]
-                ln = np.array(LAST_NAMES, dtype=object)[self.rng.integers(0, len(LAST_NAMES), npn)]
+                rng_ps = self._rngs["person_s"]
+                fn = np.array(FIRST_NAMES, dtype=object)[rng_ps.integers(0, len(FIRST_NAMES), npn)]
+                ln = np.array(LAST_NAMES, dtype=object)[rng_ps.integers(0, len(LAST_NAMES), npn)]
                 name[np_idx] = fn + " " + ln
-                email[np_idx] = (self._rand_strings(npn, 7) + "@"
-                                 + self._rand_strings(npn, 5) + ".com")
-                city[np_idx] = np.array(US_CITIES, dtype=object)[self.rng.integers(0, len(US_CITIES), npn)]
-                state[np_idx] = np.array(US_STATES, dtype=object)[self.rng.integers(0, len(US_STATES), npn)]
+                email[np_idx] = (self._rand_strings(npn, 7, rng=rng_ps) + "@"
+                                 + self._rand_strings(npn, 5, rng=rng_ps) + ".com")
+                city[np_idx] = np.array(US_CITIES, dtype=object)[rng_ps.integers(0, len(US_CITIES), npn)]
+                state[np_idx] = np.array(US_STATES, dtype=object)[rng_ps.integers(0, len(US_STATES), npn)]
             cols["person_name"] = name
             cols["person_email"] = email
             cols["person_city"] = city
             cols["person_state"] = state
 
+        if self.cfg.generate_strings and w(
+                "auction_item_name", "auction_description"):
             na_idx = is_auction.nonzero()[0]
             item_name = np.empty(n, dtype=object); item_name[:] = ""
             desc = np.empty(n, dtype=object); desc[:] = ""
             if len(na_idx):
-                item_name[na_idx] = self._rand_strings(len(na_idx), 20)
-                desc[na_idx] = self._rand_strings(len(na_idx), 100)
+                rng_as = self._rngs["auction_s"]
+                item_name[na_idx] = self._rand_strings(len(na_idx), 20, rng=rng_as)
+                desc[na_idx] = self._rand_strings(len(na_idx), 100, rng=rng_as)
             cols["auction_item_name"] = item_name
             cols["auction_description"] = desc
 
+        if self.cfg.generate_strings and w("bid_channel", "bid_url"):
             nb_idx = is_bid.nonzero()[0]
             channel = np.empty(n, dtype=object); channel[:] = ""
             url = np.empty(n, dtype=object); url[:] = ""
             if len(nb_idx):
                 nb = len(nb_idx)
-                hot_ch = (self.rng.random(nb) * HOT_CHANNELS_RATIO).astype(np.int64) > 0
-                hidx = self.rng.integers(0, 4, nb)
-                cold_id = self.rng.integers(0, CHANNELS_NUMBER, nb)
+                rng_bs = self._rngs["bid_s"]
+                hot_ch = (rng_bs.random(nb) * HOT_CHANNELS_RATIO).astype(np.int64) > 0
+                hidx = rng_bs.integers(0, 4, nb)
+                cold_id = rng_bs.integers(0, CHANNELS_NUMBER, nb)
                 ch = np.where(hot_ch, np.array(HOT_CHANNELS, dtype=object)[hidx],
                               np.char.add("channel-", cold_id.astype(str)).astype(object))
                 u = np.where(hot_ch, np.array(HOT_URLS, dtype=object)[hidx],
@@ -367,12 +415,24 @@ class NexmarkSource(SourceOperator):
             b, nums = gen.next_batch(batch_size)
             return b, nums, gen.events_so_far
 
+        # emission log for the latency bench: (cummax event time, wall) per
+        # batch — latency is then measured against when the watermark-
+        # advancing event actually left the source, not an idealized rate
+        # schedule (only kept for rate-limited runs; bench-sized logs)
+        emit_log: list = []
+        if self.cfg.rate_limited:
+            perf.note("nexmark_emit_log", emit_log)
+
         fut = loop.run_in_executor(None, gen_next) if gen.has_next else None
         while fut is not None:
             batch, nums, count_after = await fut
             fut = (loop.run_in_executor(None, gen_next)
                    if gen.has_next else None)
             await ctx.collect(batch)
+            if self.cfg.rate_limited and len(batch):
+                mx = int(np.max(batch.timestamp))
+                if not emit_log or mx > emit_log[-1][0]:
+                    emit_log.append((mx, _time.monotonic()))
             state.insert(ctx.task_info.task_index,
                          (base_time, split, count_after))
             if runner is not None:

@@ -228,7 +228,27 @@ def directory_insert(state, kh: np.ndarray, ensure_capacity) -> np.ndarray:
     (``key_sorted``, ``slot_of_sorted``, ``next_slot``, ``slot_to_key``),
     inserting unknown keys.  ``ensure_capacity(total_slots, new_keys)`` is
     the growth hook (device-array growth for KeyedBinState, shard-count
-    accounting + device growth for the mesh state)."""
+    accounting + device growth for the mesh state).
+
+    Fast path: when the state carries a native C++ hash directory
+    (``state._ndir``), the per-row lookup is one O(n) linear-probe pass;
+    the sorted arrays are still maintained (from the much smaller new-key
+    set) because checkpointing and emission-time lookups read them."""
+    ndir = getattr(state, "_ndir", None)
+    if ndir is not None:
+        slots, new_keys = ndir.insert(kh, state.next_slot)
+        if len(new_keys):
+            n_new = len(new_keys)
+            ensure_capacity(state.next_slot + n_new, new_keys)
+            new_slots = np.arange(state.next_slot, state.next_slot + n_new)
+            state.slot_to_key[new_slots] = new_keys
+            state.next_slot += n_new
+            merged = np.concatenate([state.key_sorted, new_keys])
+            merged_slots = np.concatenate([state.slot_of_sorted, new_slots])
+            order = np.argsort(merged, kind="stable")
+            state.key_sorted = merged[order]
+            state.slot_of_sorted = merged_slots[order]
+        return slots
     uniq = np.unique(kh)
     pos = np.searchsorted(state.key_sorted, uniq)
     pos_c = np.minimum(pos, max(len(state.key_sorted) - 1, 0))
@@ -280,6 +300,9 @@ class KeyedBinState:
         self.slot_of_sorted = np.zeros(0, dtype=np.int64)
         self.next_slot = 0
         self.slot_to_key = np.zeros(self.C, dtype=np.uint64)
+        from ..native import NativeDir
+
+        self._ndir = NativeDir.create(self.C)
 
         self.values = jnp.zeros((len(self._ch_kinds), self.C, self.B),
                                 dtype=jnp.float32)
@@ -355,11 +378,20 @@ class KeyedBinState:
         vals = np.empty((len(self._ch_kinds), n), dtype=np.float32)
         for j in range(len(self._ch_kinds)):
             vals[j] = self._channel_input(j, agg_inputs, n)
-        if not live.all():
-            idx = live.nonzero()[0]
-            slots, bins_mod, vals = slots[idx], bins_mod[idx], vals[:, idx]
-        slots_c, bins_c, rowcnt, vals_c = preaggregate(
-            slots, bins_mod, self._ch_kinds, vals)
+        from ..native import HAVE_NATIVE, agg_cells
+
+        if HAVE_NATIVE:
+            # one O(n) native hash pass (liveness filter folded in)
+            slots_c, bins_c, rowcnt, vals_c = agg_cells(
+                slots, bins_mod, None if live.all() else live,
+                self.B, vals, self._ch_kinds)
+        else:
+            if not live.all():
+                idx = live.nonzero()[0]
+                slots, bins_mod, vals = \
+                    slots[idx], bins_mod[idx], vals[:, idx]
+            slots_c, bins_c, rowcnt, vals_c = preaggregate(
+                slots, bins_mod, self._ch_kinds, vals)
         m = len(slots_c)
 
         # additive aggregates route through the Pallas MXU scatter (one-hot
@@ -566,6 +598,11 @@ class KeyedBinState:
         self.min_bin = None if lo < 0 else lo
         self.key_sorted = arrays["key_sorted"].astype(np.uint64)
         self.slot_of_sorted = arrays["slot_of_sorted"].astype(np.int64)
+        from ..native import NativeDir
+
+        self._ndir = NativeDir.create(max(self.next_slot, 8))
+        if self._ndir is not None:
+            self._ndir.load(self.key_sorted, self.slot_of_sorted)
         self.C = _bucket(max(self.next_slot, 8))
         self.slot_to_key = np.zeros(self.C, dtype=np.uint64)
         self.slot_to_key[:self.next_slot] = \

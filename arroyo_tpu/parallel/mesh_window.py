@@ -352,6 +352,9 @@ class MeshKeyedBinState:
         self.slot_of_sorted = np.zeros(0, dtype=np.int64)
         self.next_slot = 0
         self.slot_to_key = np.zeros(64, dtype=np.uint64)
+        from ..native import NativeDir
+
+        self._ndir = NativeDir.create(self.C)
         self.shard_counts = np.zeros(self.nk, dtype=np.int64)
 
         # window bookkeeping (absolute bins; device works base-relative)
@@ -692,6 +695,11 @@ class MeshKeyedBinState:
         self.base_bin = lo if lo >= 0 else None
         self.key_sorted = arrays["key_sorted"].astype(np.uint64)
         self.slot_of_sorted = arrays["slot_of_sorted"].astype(np.int64)
+        from ..native import NativeDir
+
+        self._ndir = NativeDir.create(max(self.next_slot, 64))
+        if self._ndir is not None:
+            self._ndir.load(self.key_sorted, self.slot_of_sorted)
         self.slot_to_key = np.zeros(
             _bucket(max(self.next_slot, 1), floor=64), np.uint64)
         self.slot_to_key[:self.next_slot] = \

@@ -72,14 +72,30 @@ class Schema:
     window: bool = False  # window_start/window_end present
     window_names: Set[str] = field(default_factory=set)  # aliases of the window
     event_time_col: str = "__timestamp"
+    # projection pushdown: source schemas carry a SHARED mutable set that
+    # resolve() records physical-column accesses into (clones alias it, so
+    # every reference to the table accumulates here); the planner hands the
+    # final set to the source connector so it can skip generating/decoding
+    # untouched columns — the DataFusion-planner pushdown analog
+    source_used: Optional[Set[str]] = None
 
     def clone(self) -> "Schema":
         return Schema(dict(self.columns), dict(self.structs),
                       set(self.aliases), self.window, set(self.window_names),
-                      self.event_time_col)
+                      self.event_time_col, self.source_used)
 
     def is_string(self, col: str) -> bool:
         return self.columns.get(col) == "s"
+
+    def _use(self, col: str) -> Tuple[str, str]:
+        if self.source_used is not None:
+            self.source_used.add(col)
+        return ("col", col)
+
+    def _use_struct(self, sd: "StructDef") -> Tuple[str, "StructDef"]:
+        if self.source_used is not None and sd.presence_col is not None:
+            self.source_used.add(sd.presence_col)
+        return ("struct", sd)
 
     def resolve(self, ref: ColumnRef) -> Tuple[str, Any]:
         """Resolve to ('col', phys) | ('struct', StructDef) | ('window', part)."""
@@ -89,28 +105,28 @@ class Schema:
             if nl in self.window_names or (nl == "window" and self.window):
                 return ("window", None)
             if n in self.columns:
-                return ("col", n)
+                return self._use(n)
             if nl in self.columns:
-                return ("col", nl)
+                return self._use(nl)
             if n in self.structs:
-                return ("struct", self.structs[n])
+                return self._use_struct(self.structs[n])
             if nl in self.structs:
-                return ("struct", self.structs[nl])
+                return self._use_struct(self.structs[nl])
             # case-insensitive fallback
             for c in self.columns:
                 if c.lower() == nl:
-                    return ("col", c)
+                    return self._use(c)
             raise SqlCompileError(f"unknown column {ref.display!r} "
                                   f"(have {sorted(self.columns)[:20]})")
         ql = q.lower()
         if ql in self.structs or q in self.structs:
             sd = self.structs.get(q) or self.structs[ql]
             if nl in sd.fields:
-                return ("col", sd.fields[nl])
+                return self._use(sd.fields[nl])
             raise SqlCompileError(f"struct {q} has no field {n}")
         if ql in self.window_names:
             if nl in ("start", "end"):
-                return ("col", f"window_{nl}")
+                return self._use(f"window_{nl}")
             raise SqlCompileError(f"window has no field {n}")
         if ql in {a.lower() for a in self.aliases}:
             return self.resolve(ColumnRef(n))

@@ -251,6 +251,7 @@ class Planner:
                 selects.append(s)
 
         self.parallelism = query_parallelism
+        self._pushdowns: List[Tuple[Dict[str, Any], set]] = []
         prog = Program()
         if inserts:
             for ins in inserts:
@@ -262,6 +263,11 @@ class Planner:
             planned.stream.sink("memory", {"name": "results"})
         else:
             raise SqlPlanError("no executable statement (SELECT/INSERT) found")
+        # projection pushdown: now that every expression has compiled, hand
+        # each source the union of physical columns the query touches
+        for op_cfg, used in self._pushdowns:
+            if used:
+                op_cfg["projection"] = sorted(used)
         return prog
 
     def _plan_insert(self, ins: Insert, prog: Program) -> None:
@@ -353,11 +359,27 @@ class Planner:
             return self._plan_join(tr, prog, scope)
         raise SqlPlanError(f"unsupported FROM clause {tr!r}")
 
+    # connectors whose sources honor a 'projection' config hint (the
+    # DataFusion projection-pushdown analog): the planner records every
+    # physical column the query resolves against the source schema and
+    # hands the final set to the connector, which skips generating or
+    # decoding untouched columns
+    PROJECTION_PUSHDOWN = {"nexmark"}
+
     def _plan_source(self, td: TableDef, prog: Program) -> Planned:
         stream = Stream.source(td.connector, td.config, program=prog,
                                parallelism=self.parallelism,
                                name=f"{td.name}_source")
         schema = td.schema.clone()
+        if td.connector in self.PROJECTION_PUSHDOWN:
+            used: set = set()
+            if td.event_time_field:
+                used.add(td.event_time_field.lower())
+            if td.watermark_field:
+                used.add(td.watermark_field.lower())
+            schema.source_used = used
+            op_cfg = prog.node(stream.tail).operator.spec.config
+            self._pushdowns.append((op_cfg, used))
 
         # generated (virtual) columns (tables.rs virtual fields)
         if td.generated:

@@ -44,6 +44,13 @@ CONNECTOR_OPTION_KEYS = {
 }
 
 
+def nexmark_lateness_micros(rate: float) -> int:
+    """Out-of-orderness bound of the nexmark generator: group size x
+    inter-event delay (see nexmark.py's (event_number * 953) % 50 shuffle).
+    Shared with bench.py's latency math — keep single-sourced."""
+    return max(int(50 * 1_000_000.0 / max(rate, 1.0)), 1000)
+
+
 def nexmark_table(config: Dict[str, Any]) -> TableDef:
     """Built-in nexmark virtual table: Event{person, auction, bid} structs
     flattened onto the generator's union columns."""
@@ -82,10 +89,8 @@ def nexmark_table(config: Dict[str, Any]) -> TableDef:
         },
     )
     rate = float(config.get("event_rate", 100_000.0))
-    # out-of-orderness bound: group size x inter-event delay (see nexmark.py)
-    lateness = max(int(50 * 1_000_000.0 / max(rate, 1.0)), 1000)
     return TableDef("nexmark", "nexmark", config, schema,
-                    default_lateness_micros=lateness)
+                    default_lateness_micros=nexmark_lateness_micros(rate))
 
 
 def impulse_table(config: Dict[str, Any]) -> TableDef:

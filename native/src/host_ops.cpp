@@ -89,3 +89,172 @@ int64_t arroyo_assign_bins(const int64_t* ts, int64_t n, int64_t slide,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Persistent key directory: open-addressing hash table key_hash -> slot.
+//
+// Replaces the sorted-array + np.searchsorted directory maintenance in
+// ops/keyed_bins.py (directory_insert): one O(n) linear-probe pass per
+// batch instead of O(n log C) binary search + merge sort.  The Python side
+// keeps slot_to_key/key_sorted as the checkpointable source of truth and
+// rebuilds this table on restore via arroyo_dir_load.
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+struct ArroyoDir {
+    uint64_t* keys;
+    int64_t* slots;   // -1 = empty
+    uint64_t cap;     // power of two
+    uint64_t mask;
+    uint64_t size;
+};
+
+static void dir_alloc(ArroyoDir* d, uint64_t cap) {
+    d->keys = new uint64_t[cap];
+    d->slots = new int64_t[cap];
+    d->cap = cap;
+    d->mask = cap - 1;
+    d->size = 0;
+    for (uint64_t i = 0; i < cap; i++) d->slots[i] = -1;
+}
+
+void* arroyo_dir_new(int64_t cap_hint) {
+    uint64_t cap = 64;
+    while ((int64_t)cap < cap_hint * 2) cap <<= 1;
+    ArroyoDir* d = new ArroyoDir;
+    dir_alloc(d, cap);
+    return d;
+}
+
+void arroyo_dir_free(void* h) {
+    ArroyoDir* d = (ArroyoDir*)h;
+    delete[] d->keys;
+    delete[] d->slots;
+    delete d;
+}
+
+static void dir_grow(ArroyoDir* d) {
+    uint64_t* ok = d->keys;
+    int64_t* os = d->slots;
+    uint64_t ocap = d->cap;
+    dir_alloc(d, ocap << 1);
+    for (uint64_t i = 0; i < ocap; i++) {
+        if (os[i] < 0) continue;
+        uint64_t j = splitmix64(ok[i]) & d->mask;
+        while (d->slots[j] >= 0) j = (j + 1) & d->mask;
+        d->keys[j] = ok[i];
+        d->slots[j] = os[i];
+        d->size++;
+    }
+    delete[] ok;
+    delete[] os;
+}
+
+// Bulk load explicit (key, slot) pairs (checkpoint restore).
+void arroyo_dir_load(void* h, const uint64_t* keys, const int64_t* slots,
+                     int64_t n) {
+    ArroyoDir* d = (ArroyoDir*)h;
+    for (int64_t i = 0; i < n; i++) {
+        if ((d->size + 1) * 10 > d->cap * 7) dir_grow(d);
+        uint64_t j = splitmix64(keys[i]) & d->mask;
+        while (d->slots[j] >= 0 && d->keys[j] != keys[i])
+            j = (j + 1) & d->mask;
+        if (d->slots[j] < 0) d->size++;
+        d->keys[j] = keys[i];
+        d->slots[j] = slots[i];
+    }
+}
+
+// Lookup-or-insert a batch.  Unknown keys get sequential slots starting at
+// next_slot, in first-appearance order; their hashes are appended to
+// out_new_keys.  Returns the number of new keys.
+int64_t arroyo_dir_insert(void* h, const uint64_t* kh, int64_t n,
+                          int64_t next_slot, int64_t* out_slots,
+                          uint64_t* out_new_keys) {
+    ArroyoDir* d = (ArroyoDir*)h;
+    int64_t n_new = 0;
+    for (int64_t i = 0; i < n; i++) {
+        if ((d->size + 1) * 10 > d->cap * 7) dir_grow(d);
+        uint64_t k = kh[i];
+        uint64_t j = splitmix64(k) & d->mask;
+        while (d->slots[j] >= 0 && d->keys[j] != k) j = (j + 1) & d->mask;
+        if (d->slots[j] < 0) {
+            d->keys[j] = k;
+            d->slots[j] = next_slot + n_new;
+            d->size++;
+            out_new_keys[n_new++] = k;
+        }
+        out_slots[i] = d->slots[j];
+    }
+    return n_new;
+}
+
+// Lookup only (emission-time key recovery); missing keys -> -1.
+void arroyo_dir_lookup(void* h, const uint64_t* kh, int64_t n,
+                       int64_t* out_slots) {
+    ArroyoDir* d = (ArroyoDir*)h;
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t k = kh[i];
+        uint64_t j = splitmix64(k) & d->mask;
+        while (d->slots[j] >= 0 && d->keys[j] != k) j = (j + 1) & d->mask;
+        out_slots[i] = d->slots[j] < 0 ? -1 : d->slots[j];
+    }
+}
+
+// ---------------------------------------------------------------------------
+// (slot, bin) cell pre-aggregation — the two-phase local half
+// (TumblingLocalAggregator analog) in one O(n) hash pass, replacing the
+// np.lexsort + reduceat path in ops/keyed_bins.py preaggregate().
+//
+//   kinds[c]: 0 = additive (sum/count), 1 = min, 2 = max
+//   vals is [n_ch, n] C-contiguous; live rows only are aggregated.
+//   Outputs are in first-appearance order; returns n_cells.
+// ---------------------------------------------------------------------------
+
+int64_t arroyo_agg_cells(const int64_t* slots, const int32_t* bins,
+                         const uint8_t* live, int64_t n, int64_t ring,
+                         const float* vals, const uint8_t* kinds,
+                         int32_t n_ch,
+                         int64_t* out_slot, int32_t* out_bin,
+                         float* out_cnt, float* out_vals) {
+    uint64_t cap = 64;
+    while ((int64_t)cap < n * 2) cap <<= 1;
+    const uint64_t mask = cap - 1;
+    uint64_t* ckey = new uint64_t[cap];
+    int64_t* cidx = new int64_t[cap];  // -1 = empty, else cell index
+    for (uint64_t i = 0; i < cap; i++) cidx[i] = -1;
+
+    int64_t n_cells = 0;
+    for (int64_t i = 0; i < n; i++) {
+        if (live && !live[i]) continue;
+        uint64_t key = (uint64_t)slots[i] * (uint64_t)ring + (uint64_t)bins[i];
+        uint64_t j = splitmix64(key) & mask;
+        while (cidx[j] >= 0 && ckey[j] != key) j = (j + 1) & mask;
+        int64_t c = cidx[j];
+        if (c < 0) {
+            c = n_cells++;
+            ckey[j] = key;
+            cidx[j] = c;
+            out_slot[c] = slots[i];
+            out_bin[c] = bins[i];
+            out_cnt[c] = 1.0f;
+            for (int32_t ch = 0; ch < n_ch; ch++)
+                out_vals[ch * n + c] = vals[ch * n + i];
+        } else {
+            out_cnt[c] += 1.0f;
+            for (int32_t ch = 0; ch < n_ch; ch++) {
+                float v = vals[ch * n + i];
+                float* acc = &out_vals[ch * n + c];
+                if (kinds[ch] == 1) { if (v < *acc) *acc = v; }
+                else if (kinds[ch] == 2) { if (v > *acc) *acc = v; }
+                else *acc += v;
+            }
+        }
+    }
+    delete[] ckey;
+    delete[] cidx;
+    return n_cells;
+}
+
+}  // extern "C"

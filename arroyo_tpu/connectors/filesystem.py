@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import io
 import json
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Literal, Optional, Tuple
 
 from pydantic import BaseModel
 
@@ -31,7 +31,9 @@ from .two_phase import TwoPhaseCommitterSink
 
 class FileSystemConfig(BaseModel):
     path: str  # directory URL: file:///..., memory://..., s3://... via fsspec
-    format: str = "json"  # 'json' (newline-delimited) | 'parquet'
+    # newline-delimited json | parquet; a typo must fail at plan time, not
+    # silently fall back to json
+    format: Literal["json", "parquet"] = "json"
     rows_per_file: int = 1_000_000  # roll part when exceeded
 
 

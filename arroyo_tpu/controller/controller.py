@@ -202,9 +202,16 @@ class ControllerServer:
     async def _drive(self, job: Job, n_workers: int, restore: bool) -> None:
         try:
             job.fsm.transition(JobState.COMPILING)
-            errors = job.program.validate()
-            if errors:
-                job.fsm.fail("; ".join(errors))
+            # AOT build pass (engine/aot.py): construct every physical
+            # operator so a bad pipeline fails HERE, not on a worker
+            # (states/compiling.rs contract); runs off-loop — expression
+            # compilation can trace
+            from ..engine.aot import compile_program
+
+            report = await asyncio.get_event_loop().run_in_executor(
+                None, compile_program, job.program)
+            if not report.ok:
+                job.fsm.fail("; ".join(report.errors))
                 return
             job.fsm.transition(JobState.SCHEDULING)
             await self.scheduler.start_workers(

@@ -31,6 +31,8 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional, Tuple
 
+import asyncio
+
 import numpy as np
 
 from ..graph.logical import (
@@ -146,6 +148,19 @@ class BinAggOperator(Operator):
                            if projection else None)
         self.top_n = top_n  # (partition_cols, sort_column, max_elements)
         self._key_cols: Tuple[str, ...] = ()
+        self._offload: Optional[bool] = None  # decided at first batch
+
+    def _offload_transfers(self) -> bool:
+        """Run device update/emit in an executor thread on accelerators:
+        host<->device transfers there can block for tens of ms (remote-
+        tunnel TPUs especially), and off the event loop sibling operators'
+        transfers overlap instead of serializing.  On the CPU backend
+        transfers are free, so the thread hop is pure overhead."""
+        if self._offload is None:
+            import jax
+
+            self._offload = jax.default_backend() != "cpu"
+        return self._offload
 
     def tables(self) -> List[TableDescriptor]:
         return []  # registered as a device table in on_start
@@ -169,13 +184,26 @@ class BinAggOperator(Operator):
         prev = self.state.next_slot
         slots = self.state._lookup_or_insert(batch.key_hash)
         self.keyvals.ensure(batch, slots, prev, self.state.next_slot)
-        self.state.update(batch.key_hash, batch.timestamp, batch.columns)
+        # safe to offload: this operator's messages are processed
+        # serially, so state is never touched concurrently
+        if self._offload_transfers():
+            await asyncio.get_event_loop().run_in_executor(
+                None, self.state.update, batch.key_hash, batch.timestamp,
+                batch.columns)
+        else:
+            self.state.update(batch.key_hash, batch.timestamp, batch.columns)
 
     async def handle_watermark(self, watermark: int, ctx: Context) -> None:
         from ..types import MAX_TIMESTAMP
 
         final = watermark >= int(MAX_TIMESTAMP) - 1
-        fired = self.state.fire_panes(watermark, final=final)
+        # pane emission device_get is the biggest device->host transfer in
+        # the pipeline (same offload rationale as update)
+        if self._offload_transfers():
+            fired = await asyncio.get_event_loop().run_in_executor(
+                None, lambda: self.state.fire_panes(watermark, final=final))
+        else:
+            fired = self.state.fire_panes(watermark, final=final)
         if fired is not None:
             await self._emit(fired, ctx)
         await ctx.broadcast(Message.wm(Watermark.event_time(watermark)))

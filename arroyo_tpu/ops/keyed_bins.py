@@ -237,17 +237,7 @@ def directory_insert(state, kh: np.ndarray, ensure_capacity) -> np.ndarray:
     ndir = getattr(state, "_ndir", None)
     if ndir is not None:
         slots, new_keys = ndir.insert(kh, state.next_slot)
-        if len(new_keys):
-            n_new = len(new_keys)
-            ensure_capacity(state.next_slot + n_new, new_keys)
-            new_slots = np.arange(state.next_slot, state.next_slot + n_new)
-            state.slot_to_key[new_slots] = new_keys
-            state.next_slot += n_new
-            merged = np.concatenate([state.key_sorted, new_keys])
-            merged_slots = np.concatenate([state.slot_of_sorted, new_slots])
-            order = np.argsort(merged, kind="stable")
-            state.key_sorted = merged[order]
-            state.slot_of_sorted = merged_slots[order]
+        _append_new_keys(state, new_keys, ensure_capacity)
         return slots
     uniq = np.unique(kh)
     pos = np.searchsorted(state.key_sorted, uniq)
@@ -256,19 +246,28 @@ def directory_insert(state, kh: np.ndarray, ensure_capacity) -> np.ndarray:
         state.key_sorted[pos_c] == uniq if len(state.key_sorted) else
         np.zeros(len(uniq), dtype=bool))
     new_keys = uniq[~known] if len(state.key_sorted) else uniq
-    if len(new_keys):
-        n_new = len(new_keys)
-        ensure_capacity(state.next_slot + n_new, new_keys)
-        new_slots = np.arange(state.next_slot, state.next_slot + n_new)
-        state.slot_to_key[new_slots] = new_keys
-        state.next_slot += n_new
-        merged = np.concatenate([state.key_sorted, new_keys])
-        merged_slots = np.concatenate([state.slot_of_sorted, new_slots])
-        order = np.argsort(merged, kind="stable")
-        state.key_sorted = merged[order]
-        state.slot_of_sorted = merged_slots[order]
+    _append_new_keys(state, new_keys, ensure_capacity)
     idx = np.searchsorted(state.key_sorted, kh)
     return state.slot_of_sorted[idx]
+
+
+def _append_new_keys(state, new_keys: np.ndarray, ensure_capacity) -> None:
+    """Register new keys: sequential slots from ``next_slot`` (the order
+    the native dir already assigned), slot_to_key update, and sorted-array
+    merge.  Shared by the native and numpy directory paths so the
+    checkpointable arrays stay bit-identical between builds."""
+    if not len(new_keys):
+        return
+    n_new = len(new_keys)
+    ensure_capacity(state.next_slot + n_new, new_keys)
+    new_slots = np.arange(state.next_slot, state.next_slot + n_new)
+    state.slot_to_key[new_slots] = new_keys
+    state.next_slot += n_new
+    merged = np.concatenate([state.key_sorted, new_keys])
+    merged_slots = np.concatenate([state.slot_of_sorted, new_slots])
+    order = np.argsort(merged, kind="stable")
+    state.key_sorted = merged[order]
+    state.slot_of_sorted = merged_slots[order]
 
 
 class KeyedBinState:

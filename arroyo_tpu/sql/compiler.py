@@ -87,56 +87,74 @@ class Schema:
     def is_string(self, col: str) -> bool:
         return self.columns.get(col) == "s"
 
-    def _use(self, col: str) -> Tuple[str, str]:
-        if self.source_used is not None:
+    def _use(self, col: str, record: bool = True) -> Tuple[str, str]:
+        if record and self.source_used is not None:
             self.source_used.add(col)
         return ("col", col)
 
-    def _use_struct(self, sd: "StructDef") -> Tuple[str, "StructDef"]:
-        if self.source_used is not None and sd.presence_col is not None:
-            self.source_used.add(sd.presence_col)
+    def _use_struct(self, sd: "StructDef", presence_only: bool = False,
+                    record: bool = True) -> Tuple[str, "StructDef"]:
+        if record and self.source_used is not None:
+            # a bare struct reference (SELECT bid, struct passthrough)
+            # keeps the WHOLE struct live: presence column and every field
+            # column (the projection operator passes fields through,
+            # planner._plan_projection).  ``presence_only`` is for
+            # `struct IS [NOT] NULL`, which reads just the presence column.
+            if sd.presence_col is not None:
+                self.source_used.add(sd.presence_col)
+            if not presence_only:
+                for phys in sd.fields.values():
+                    self.source_used.add(phys)
         return ("struct", sd)
 
-    def resolve(self, ref: ColumnRef) -> Tuple[str, Any]:
-        """Resolve to ('col', phys) | ('struct', StructDef) | ('window', part)."""
+    def resolve(self, ref: ColumnRef, presence_only: bool = False,
+                record: bool = True) -> Tuple[str, Any]:
+        """Resolve to ('col', phys) | ('struct', StructDef) | ('window', part).
+
+        ``record=False`` makes this a pure PROBE (planner shape checks)
+        that must not mark columns as used for projection pushdown."""
         q, n = ref.qualifier, ref.name
         nl = n.lower()
         if q is None:
             if nl in self.window_names or (nl == "window" and self.window):
                 return ("window", None)
             if n in self.columns:
-                return self._use(n)
+                return self._use(n, record)
             if nl in self.columns:
-                return self._use(nl)
+                return self._use(nl, record)
             if n in self.structs:
-                return self._use_struct(self.structs[n])
+                return self._use_struct(self.structs[n], presence_only,
+                                        record)
             if nl in self.structs:
-                return self._use_struct(self.structs[nl])
+                return self._use_struct(self.structs[nl], presence_only,
+                                        record)
             # case-insensitive fallback
             for c in self.columns:
                 if c.lower() == nl:
-                    return self._use(c)
+                    return self._use(c, record)
             raise SqlCompileError(f"unknown column {ref.display!r} "
                                   f"(have {sorted(self.columns)[:20]})")
         ql = q.lower()
         if ql in self.structs or q in self.structs:
             sd = self.structs.get(q) or self.structs[ql]
             if nl in sd.fields:
-                return self._use(sd.fields[nl])
+                return self._use(sd.fields[nl], record)
             raise SqlCompileError(f"struct {q} has no field {n}")
         if ql in self.window_names:
             if nl in ("start", "end"):
-                return self._use(f"window_{nl}")
+                return self._use(f"window_{nl}", record)
             raise SqlCompileError(f"window has no field {n}")
         if ql in {a.lower() for a in self.aliases}:
-            return self.resolve(ColumnRef(n))
+            return self.resolve(ColumnRef(n), presence_only, record)
         # qualifier might be a struct accessed through an alias chain a.b.c
         if "." in ql:
             parts = ql.split(".")
             if parts[-1] in self.structs:
-                return self.resolve(ColumnRef(n, parts[-1]))
+                return self.resolve(ColumnRef(n, parts[-1]),
+                                    presence_only, record)
             if parts[0] in {a.lower() for a in self.aliases}:
-                return self.resolve(ColumnRef(n, ".".join(parts[1:])))
+                return self.resolve(ColumnRef(n, ".".join(parts[1:])),
+                                    presence_only, record)
         raise SqlCompileError(f"cannot resolve qualifier {q!r} for column {n!r}")
 
 
@@ -199,6 +217,13 @@ class ExprCompiler:
             us = e.micros
             return lambda env: (us, None)
         if isinstance(e, ColumnRef):
+            # niladic SQL keywords (no parens in the grammar) arrive as
+            # bare column refs: CURRENT_DATE / CURRENT_TIME / CURRENT_TIMESTAMP
+            if (e.qualifier is None
+                    and e.name.lower() in ("current_date", "current_time",
+                                           "current_timestamp")
+                    and e.name.lower() not in self.schema.columns):
+                return self._compile_function(FunctionCall(e.name.lower(), []))
             kind, target = self.schema.resolve(e)
             if kind == "col":
                 self.used_cols.add(target)
@@ -263,9 +288,11 @@ class ExprCompiler:
             raise SqlCompileError(f"unary {e.op}")
         if isinstance(e, IsNull):
             inner_e = e.operand
-            # `struct IS NOT NULL` -> presence mask directly
+            # `struct IS NOT NULL` -> presence mask directly (and only the
+            # presence column counts as used for pushdown)
             if isinstance(inner_e, ColumnRef):
-                kind, target = self.schema.resolve(inner_e)
+                kind, target = self.schema.resolve(inner_e,
+                                                   presence_only=True)
                 if kind == "struct":
                     pc, pv = target.presence_col, target.presence_val
                     self.used_cols.add(pc)
@@ -542,6 +569,9 @@ class ExprCompiler:
         if name in HOST_FUNCTIONS:
             self.needs_host = True
             fn = HOST_FUNCTIONS[name]
+            if getattr(fn, "needs_env", False):
+                # per-row zero-arg fns (uuid, random) need the batch length
+                return lambda env: fn([a(env) for a in args], env)
             return lambda env: fn([a(env) for a in args])
         from .functions import SCALAR_UDFS
 

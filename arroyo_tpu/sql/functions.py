@@ -301,6 +301,20 @@ def _obj(v):
     return np.asarray(v, dtype=object)
 
 
+def _row_get(v, i):
+    """Row i of a column, or the value itself for scalar literals."""
+    if isinstance(v, str) or np.ndim(v) == 0:
+        return v.item() if isinstance(v, np.ndarray) else v
+    return v[i]
+
+
+def _n_rows(args) -> int:
+    for a, _m in args:
+        if not isinstance(a, str) and np.ndim(a) > 0:
+            return len(a)
+    return 1
+
+
 @host_fn("upper")
 def _upper(args):
     (v, m), = args
@@ -327,8 +341,9 @@ def _char_length(args):
 
 @host_fn("concat")
 def _concat(args):
-    n = len(args[0][0])
-    out = ["".join(str(a[0][i]) for a in args if a[0][i] is not None)
+    n = _n_rows(args)
+    out = ["".join(str(_row_get(a[0], i)) for a in args
+                   if _row_get(a[0], i) is not None)
            for i in range(n)]
     return _obj(out), _all_valid_mask([m for _, m in args])
 
@@ -679,3 +694,465 @@ def _get_first_json_object(args):
     return _json_path_walk(
         args, lambda o: _json.dumps(o) if isinstance(o, (dict, list))
         else o)
+
+
+# -- extended math (device) ---------------------------------------------------
+# hyperbolics / roots / angle conversion / integer math, completing the
+# reference's BuiltinScalarFunction math coverage (expressions.rs)
+
+def _register_math_ext():
+    import jax.numpy as jnp
+
+    for name, fn in [
+        ("sinh", jnp.sinh), ("cosh", jnp.cosh), ("tanh", jnp.tanh),
+        ("asinh", jnp.arcsinh), ("acosh", jnp.arccosh),
+        ("atanh", jnp.arctanh), ("cbrt", jnp.cbrt),
+        ("degrees", jnp.degrees), ("radians", jnp.radians),
+    ]:
+        DEVICE_FUNCTIONS[name] = _unary_math(fn)
+
+    DEVICE_FUNCTIONS["cot"] = _unary_math(lambda v: 1.0 / jnp.tan(v))
+
+    def atan2(args):
+        (y, my), (x, mx) = args
+        return jnp.arctan2(y, x), _all_valid_mask([my, mx])
+
+    DEVICE_FUNCTIONS["atan2"] = atan2
+
+    def log(args):
+        # Postgres: log(x) = log10; log(b, x) = log base b
+        if len(args) == 1:
+            (v, m), = args
+            return jnp.log10(v), m
+        (b, mb), (x, mx) = args
+        return jnp.log(x) / jnp.log(b), _all_valid_mask([mb, mx])
+
+    DEVICE_FUNCTIONS["log"] = log
+
+    def pi(args):
+        return jnp.pi, None
+
+    DEVICE_FUNCTIONS["pi"] = pi
+
+    def factorial(args):
+        (v, m), = args
+        # exact in int64 up to 20!; larger n overflows int64, so clamp
+        # (the reference's DataFusion factorial is int64 with the same cap)
+        n = jnp.clip(jnp.asarray(v, jnp.int64), 0, 20)
+        i = jnp.arange(1, 21, dtype=jnp.int64)
+        terms = jnp.where(i[None, :] <= n[..., None], i[None, :],
+                          jnp.int64(1))
+        return jnp.prod(terms, axis=-1), m
+
+    DEVICE_FUNCTIONS["factorial"] = factorial
+
+    def gcd(args):
+        from jax import lax
+
+        (a, ma), (b, mb) = args
+        x = jnp.abs(jnp.asarray(a, jnp.int64))
+        y = jnp.abs(jnp.asarray(b, jnp.int64))
+        x, y = jnp.broadcast_arrays(x, y)
+
+        # exact Euclid: loop until every lane terminates (worst case ~90
+        # iterations for int64 Fibonacci pairs — data-dependent, so a real
+        # while_loop, not an unrolled approximation)
+        def cond(s):
+            return jnp.any(s[1] != 0)
+
+        def body(s):
+            sx, sy = s
+            safe = jnp.where(sy == 0, 1, sy)
+            return (jnp.where(sy != 0, sy, sx),
+                    jnp.where(sy != 0, sx % safe, 0))
+
+        x, _ = lax.while_loop(cond, body, (x, y))
+        return x, _all_valid_mask([ma, mb])
+
+    DEVICE_FUNCTIONS["gcd"] = gcd
+
+    def lcm(args):
+        (a, ma), (b, mb) = args
+        g, m = gcd(args)
+        x = jnp.abs(jnp.asarray(a, jnp.int64))
+        y = jnp.abs(jnp.asarray(b, jnp.int64))
+        v = jnp.where(g != 0, x // jnp.where(g == 0, 1, g) * y, 0)
+        return v, m
+
+    DEVICE_FUNCTIONS["lcm"] = lcm
+
+
+_register_math_ext()
+
+
+# -- extended strings / binary (host) ----------------------------------------
+
+
+@host_fn("repeat")
+def _repeat(args):
+    (v, m), (n, mn) = args
+    rows = _n_rows(args)
+    out = []
+    for i in range(rows):
+        s, k = _row_get(v, i), _row_get(n, i)
+        out.append(s * max(int(k), 0) if s is not None else None)
+    return _obj(out), _all_valid_mask([m, mn])
+
+
+@host_fn("reverse")
+def _reverse(args):
+    (v, m), = args
+    rows = _n_rows(args)
+    return _obj([(_row_get(v, i) or "")[::-1] if _row_get(v, i) is not None
+                 else None for i in range(rows)]), m
+
+
+@host_fn("btrim")
+def _btrim(args):
+    v, m = args[0]
+    chars = None
+    if len(args) > 1:
+        cv = args[1][0]
+        chars = cv if isinstance(cv, str) else str(np.asarray(cv).reshape(-1)[0])
+    if isinstance(v, str) or np.ndim(v) == 0:
+        sv = _row_get(v, 0)
+        return np.asarray(sv.strip(chars) if sv is not None else None,
+                          dtype=object), m
+    return _obj([s.strip(chars) if s is not None else None for s in v]), m
+
+
+@host_fn("to_hex")
+def _to_hex(args):
+    (v, m), = args
+    vals = np.asarray(v)
+    if vals.ndim == 0:  # scalar literal: 0-d result broadcasts downstream
+        return np.asarray(format(int(vals), "x"), dtype=object), m
+    return _obj([format(int(x), "x") for x in vals.tolist()]), m
+
+
+@host_fn("encode")
+def _encode(args):
+    import base64
+
+    (v, m), (f, mf) = args
+    fmt = f if isinstance(f, str) else str(np.asarray(f).reshape(-1)[0])
+    fmt = fmt.lower()
+
+    def enc(s):
+        if s is None:
+            return None
+        raw = s.encode() if isinstance(s, str) else bytes(s)
+        if fmt == "hex":
+            return raw.hex()
+        if fmt == "base64":
+            return base64.b64encode(raw).decode()
+        raise ValueError(f"encode: unknown format {fmt!r}")
+
+    return _obj([enc(_row_get(v, i)) for i in range(_n_rows(args[:1]))]), \
+        _all_valid_mask([m, mf])
+
+
+@host_fn("decode")
+def _decode(args):
+    import base64
+
+    (v, m), (f, mf) = args
+    fmt = f if isinstance(f, str) else str(np.asarray(f).reshape(-1)[0])
+    fmt = fmt.lower()
+
+    def dec(s):
+        if s is None:
+            return None
+        if fmt == "hex":
+            return bytes.fromhex(s).decode(errors="replace")
+        if fmt == "base64":
+            return base64.b64decode(s).decode(errors="replace")
+        raise ValueError(f"decode: unknown format {fmt!r}")
+
+    return _obj([dec(_row_get(v, i)) for i in range(_n_rows(args[:1]))]), \
+        _all_valid_mask([m, mf])
+
+
+@host_fn("concat_ws")
+def _concat_ws(args):
+    sep_v = args[0][0]
+    sep = sep_v if isinstance(sep_v, str) else str(np.asarray(sep_v).reshape(-1)[0])
+    rest = args[1:]
+    n = _n_rows(rest)
+    out = [sep.join(str(_row_get(a[0], i)) for a in rest
+                    if _row_get(a[0], i) is not None)
+           for i in range(n)]
+    return _obj(out), None  # NULL args are skipped, result never NULL
+
+
+def _uuid(args, env):
+    import uuid as _u
+
+    n = len(env["__timestamp"])
+    return _obj([str(_u.uuid4()) for _ in range(n)]), None
+
+
+_uuid.needs_env = True
+HOST_FUNCTIONS["uuid"] = _uuid
+
+
+def _random(args, env):
+    n = len(env["__timestamp"])
+    return np.random.random(n), None
+
+
+_random.needs_env = True
+HOST_FUNCTIONS["random"] = _random
+
+
+@host_fn("digest")
+def _digest(args):
+    (v, m), (a, ma) = args
+    algo = a if isinstance(a, str) else str(np.asarray(a).reshape(-1)[0])
+    algo = algo.lower().replace("-", "")
+
+    def d(s):
+        if s is None:
+            return None
+        h = hashlib.new(algo)
+        h.update(s.encode() if isinstance(s, str) else bytes(s))
+        return h.hexdigest()
+
+    return _obj([d(_row_get(v, i)) for i in range(_n_rows(args[:1]))]), \
+        _all_valid_mask([m, ma])
+
+
+# -- extended datetime (host wallclock + device conversions) ------------------
+
+
+def _now(args, env):
+    import time as _t
+
+    return np.int64(int(_t.time() * 1e6)), None
+
+
+_now.needs_env = True
+HOST_FUNCTIONS["now"] = _now
+HOST_FUNCTIONS["current_timestamp"] = _now
+
+
+def _current_date(args, env):
+    import time as _t
+
+    micros = int(_t.time() * 1e6)
+    return np.int64(micros - micros % (86_400 * SECONDS)), None
+
+
+_current_date.needs_env = True
+HOST_FUNCTIONS["current_date"] = _current_date
+
+
+def _current_time(args, env):
+    import time as _t
+
+    micros = int(_t.time() * 1e6)
+    return np.int64(micros % (86_400 * SECONDS)), None
+
+
+_current_time.needs_env = True
+HOST_FUNCTIONS["current_time"] = _current_time
+
+
+def _register_datetime_ext():
+    import jax.numpy as jnp
+
+    def to_ts_seconds(args):
+        (v, m), = args
+        return jnp.asarray(v, jnp.int64) * SECONDS, m
+
+    def to_ts_millis(args):
+        (v, m), = args
+        return jnp.asarray(v, jnp.int64) * 1000, m
+
+    def to_ts_micros(args):
+        (v, m), = args
+        return jnp.asarray(v, jnp.int64), m
+
+    DEVICE_FUNCTIONS["to_timestamp_seconds"] = to_ts_seconds
+    DEVICE_FUNCTIONS["to_timestamp_millis"] = to_ts_millis
+    DEVICE_FUNCTIONS["to_timestamp_micros"] = to_ts_micros
+
+    def date_bin(args):
+        # date_bin(stride, ts, origin): floor ts into stride-sized bins
+        # anchored at origin (DataFusion semantics)
+        (stride, ms), (ts, mt) = args[0], args[1]
+        origin = args[2][0] if len(args) > 2 else 0
+        t = jnp.asarray(ts, jnp.int64)
+        s = jnp.asarray(stride, jnp.int64)
+        o = jnp.asarray(origin, jnp.int64)
+        return o + ((t - o) // s) * s, _all_valid_mask([ms, mt])
+
+    DEVICE_FUNCTIONS["date_bin"] = date_bin
+
+
+_register_datetime_ext()
+
+
+# -- arrays (host; object columns of python lists) ---------------------------
+# the reference exposes DataFusion's array family (expressions.rs
+# ArrayAppend/Concat/..); arrays travel as object columns of lists here
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple, np.ndarray)) else [x]
+
+
+@host_fn("make_array")
+def _make_array(args):
+    n = len(args[0][0]) if args and hasattr(args[0][0], "__len__") \
+        and not isinstance(args[0][0], str) else 1
+    out = []
+    for i in range(n):
+        out.append([a[0][i] if hasattr(a[0], "__len__")
+                    and not isinstance(a[0], str) else a[0] for a in args])
+    return _obj(out), _all_valid_mask([m for _, m in args])
+
+
+@host_fn("array_append")
+def _array_append(args):
+    (v, m), (x, mx) = args
+    xs = x if hasattr(x, "__len__") and not isinstance(x, str) \
+        else [x] * len(v)
+    return _obj([(_as_list(a) + [b]) if a is not None else None
+                 for a, b in zip(v, xs)]), _all_valid_mask([m, mx])
+
+
+@host_fn("array_prepend")
+def _array_prepend(args):
+    (x, mx), (v, m) = args
+    xs = x if hasattr(x, "__len__") and not isinstance(x, str) \
+        else [x] * len(v)
+    return _obj([([b] + _as_list(a)) if a is not None else None
+                 for a, b in zip(v, xs)]), _all_valid_mask([m, mx])
+
+
+@host_fn("array_concat")
+def _array_concat(args):
+    n = len(args[0][0])
+    out = []
+    for i in range(n):
+        row = []
+        for a, _m in args:
+            if a[i] is not None:
+                row.extend(_as_list(a[i]))
+        out.append(row)
+    return _obj(out), _all_valid_mask([m for _, m in args])
+
+
+@host_fn("array_contains")
+def _array_contains(args):
+    (v, m), (x, mx) = args
+    xs = x if hasattr(x, "__len__") and not isinstance(x, str) \
+        else [x] * len(v)
+    return np.array([b in _as_list(a) if a is not None else False
+                     for a, b in zip(v, xs)]), _all_valid_mask([m, mx])
+
+
+@host_fn("array_length")
+def _array_length(args):
+    v, m = args[0]
+    return np.array([len(_as_list(a)) if a is not None else 0
+                     for a in v], dtype=np.int64), m
+
+
+HOST_FUNCTIONS["cardinality"] = HOST_FUNCTIONS["array_length"]
+
+
+@host_fn("array_position")
+def _array_position(args):
+    (v, m), (x, mx) = args
+    xs = x if hasattr(x, "__len__") and not isinstance(x, str) \
+        else [x] * len(v)
+
+    def pos(a, b):
+        if a is None:
+            return 0
+        lst = _as_list(a)
+        return lst.index(b) + 1 if b in lst else 0  # 1-based; 0 = absent
+
+    out = np.array([pos(a, b) for a, b in zip(v, xs)], dtype=np.int64)
+    return out, _all_valid_mask([m, mx])
+
+
+@host_fn("array_positions")
+def _array_positions(args):
+    (v, m), (x, mx) = args
+    xs = x if hasattr(x, "__len__") and not isinstance(x, str) \
+        else [x] * len(v)
+    return _obj([[i + 1 for i, el in enumerate(_as_list(a)) if el == b]
+                 if a is not None else None
+                 for a, b in zip(v, xs)]), _all_valid_mask([m, mx])
+
+
+@host_fn("array_remove")
+def _array_remove(args):
+    (v, m), (x, mx) = args
+    xs = x if hasattr(x, "__len__") and not isinstance(x, str) \
+        else [x] * len(v)
+    return _obj([[el for el in _as_list(a) if el != b]
+                 if a is not None else None
+                 for a, b in zip(v, xs)]), _all_valid_mask([m, mx])
+
+
+@host_fn("array_replace")
+def _array_replace(args):
+    (v, m), (x, mx), (y, my) = args
+    n = len(v)
+    xs = x if hasattr(x, "__len__") and not isinstance(x, str) else [x] * n
+    ys = y if hasattr(y, "__len__") and not isinstance(y, str) else [y] * n
+    return _obj([[c if el == b else el for el in _as_list(a)]
+                 if a is not None else None
+                 for a, b, c in zip(v, xs, ys)]), \
+        _all_valid_mask([m, mx, my])
+
+
+@host_fn("array_to_string")
+def _array_to_string(args):
+    (v, m), (s, ms) = args
+    sep = s if isinstance(s, str) else str(np.asarray(s).reshape(-1)[0])
+    return _obj([sep.join(str(el) for el in _as_list(a))
+                 if a is not None else None
+                 for a in v]), _all_valid_mask([m, ms])
+
+
+@host_fn("trim_array")
+def _trim_array(args):
+    (v, m), (n, mn) = args
+    nn = np.broadcast_to(np.asarray(n).astype(int), (len(v),))
+    return _obj([_as_list(a)[:max(len(_as_list(a)) - int(k), 0)]
+                 if a is not None else None
+                 for a, k in zip(v, nn)]), _all_valid_mask([m, mn])
+
+
+@host_fn("array_ndims")
+def _array_ndims(args):
+    v, m = args[0]
+
+    def nd(a):
+        d = 0
+        while isinstance(a, (list, tuple)) and a:
+            d += 1
+            a = a[0]
+        return d if d else (1 if isinstance(a, (list, tuple)) else 0)
+
+    return np.array([nd(a) if a is not None else 0 for a in v],
+                    dtype=np.int64), m
+
+
+@host_fn("array_dims")
+def _array_dims(args):
+    v, m = args[0]
+
+    def dims(a):
+        out = []
+        while isinstance(a, (list, tuple)):
+            out.append(len(a))
+            a = a[0] if a else None
+        return out
+
+    return _obj([dims(a) if a is not None else None for a in v]), m

@@ -75,6 +75,18 @@ class SqlPlanError(ValueError):
     pass
 
 
+class _TeeSet:
+    """``add``-only set fanning out to several sides' used-column sets
+    (join output schemas: a column may belong to either source)."""
+
+    def __init__(self, sinks):
+        self.sinks = sinks
+
+    def add(self, item):
+        for s in self.sinks:
+            s.add(item)
+
+
 def _conjuncts(e: Expr) -> List[Expr]:
     """Flatten a predicate's top-level AND chain."""
     if isinstance(e, BinaryOp) and e.op == "and":
@@ -176,10 +188,18 @@ def _wrap_record(compiled: List[Tuple[str, Compiled]], passthrough: List[str]
         out: Dict[str, Any] = {}
         for name, c in compiled:
             v, _m = c.fn(cols)
-            if not hasattr(v, "shape") and not isinstance(v, np.ndarray):
-                # scalar literal: broadcast to batch length
+            if np.ndim(v) == 0:
+                # scalar result (python scalar OR 0-d array): broadcast.
+                # jnp handles traced values (this fn can run inside jit);
+                # np.full would choke on tracers
                 n = len(cols["__timestamp"])
-                v = np.full(n, v)
+                if isinstance(v, (np.ndarray, np.generic, int, float, bool,
+                                  str)):
+                    v = np.full(n, v)
+                else:
+                    import jax.numpy as jnp
+
+                    v = jnp.broadcast_to(v, (n,))
             out[name] = v
         for name in passthrough:
             if name in cols:
@@ -507,8 +527,12 @@ class Planner:
             needs_host = needs_host or c.needs_host
             compiled.append((name, c))
             new_schema.columns[name] = self._infer_kind(expr, schema)
-            if not (isinstance(expr, ColumnRef)
-                    and schema.resolve(expr) == ("col", name)):
+            try:
+                is_identity = (isinstance(expr, ColumnRef) and schema.resolve(
+                    expr, record=False) == ("col", name))
+            except SqlCompileError:  # niladic keyword refs (current_date)
+                is_identity = False
+            if not is_identity:
                 identity = False
 
         if identity and not compiled and passthrough:
@@ -573,7 +597,7 @@ class Planner:
                     continue
             if isinstance(e, ColumnRef):
                 try:
-                    if schema.resolve(e)[0] == "window":
+                    if schema.resolve(e, record=False)[0] == "window":
                         # re-aggregation keyed by the upstream window (q5's
                         # MaxBids: GROUP BY window): key on window_end and
                         # carry window_start through as a dependent key
@@ -617,7 +641,7 @@ class Planner:
                 continue
             if isinstance(expr, ColumnRef):
                 try:
-                    if schema.resolve(expr)[0] == "window":
+                    if schema.resolve(expr, record=False)[0] == "window":
                         window_item_names.append(name)
                         continue
                 except SqlCompileError:
@@ -1092,6 +1116,14 @@ class Planner:
             name = c if c not in schema.columns else f"r_{c}"
             schema.columns[name] = right.schema.columns[c]
         schema.structs = {**right.schema.structs, **left.schema.structs}
+        # pushdown: columns resolved against the JOINED schema may come
+        # from either side's source — record into both sides' used sets
+        # (over-inclusive on the side that doesn't own the column, which a
+        # connector treats as harmless)
+        tees = [s.source_used for s in (left.schema, right.schema)
+                if s.source_used is not None]
+        if tees:
+            schema.source_used = _TeeSet(tees)
         if left.schema.window and right.schema.window:
             schema.window = True
             schema.window_names = (left.schema.window_names
@@ -1137,7 +1169,7 @@ class Planner:
     def _is_window_ref(e: Expr, schema: Schema) -> bool:
         if isinstance(e, ColumnRef):
             try:
-                return schema.resolve(e)[0] == "window"
+                return schema.resolve(e, record=False)[0] == "window"
             except SqlCompileError:
                 return False
         return False

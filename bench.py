@@ -291,13 +291,9 @@ def main_child() -> None:
     # observed to evict mid-run (recompiles of identical shapes cost ~0.4s
     # each through the tunnel); a disk cache makes every compile a one-time
     # cost across bench invocations
-    try:
-        jax.config.update("jax_compilation_cache_dir",
-                          os.environ.get("JAX_COMPILATION_CACHE_DIR",
-                                         "/tmp/arroyo_jax_cache"))
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
-    except Exception:
-        pass  # older jax without the knob
+    from arroyo_tpu.engine.aot import enable_persistent_cache
+
+    enable_persistent_cache()
 
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         # the axon sitecustomize plugin imports jax at interpreter start

@@ -191,3 +191,39 @@ def test_projection_pushdown_output_identical():
     prog_full.sources()[0].operator.spec.config.pop("projection")
     without = run(prog_full)
     assert with_pushdown == without and len(with_pushdown) > 0
+
+
+def test_projection_pushdown_struct_and_join_keep_columns():
+    """A bare struct reference keeps the whole struct's columns; a join
+    records both sides' column usage (reviewer-found leaks)."""
+    from arroyo_tpu.sql import plan_sql
+
+    # bare struct passthrough: bid's fields must survive pushdown
+    prog = plan_sql("""
+    CREATE TABLE nexmark WITH (connector = 'nexmark', num_events = '100',
+                               rate_limited = 'false');
+    SELECT bid FROM nexmark WHERE bid is not null
+    """)
+    proj = prog.sources()[0].operator.spec.config.get("projection")
+    assert proj is not None
+    assert {"bid_auction", "bid_bidder", "bid_price",
+            "bid_datetime"} <= set(proj)
+
+    # join: columns used only in SELECT resolve against the joined schema
+    # and must still reach each side's source projection
+    prog2 = plan_sql("""
+    CREATE TABLE nexmark WITH (connector = 'nexmark', num_events = '100',
+                               rate_limited = 'false');
+    SELECT P.name as name, A.seller as seller
+    FROM (SELECT person.name as name, person.id as id,
+                 TUMBLE(INTERVAL '10' SECOND) as window
+          FROM nexmark WHERE person is not null GROUP BY 1, 2, 3) P
+    JOIN (SELECT auction.seller as seller,
+                 TUMBLE(INTERVAL '10' SECOND) as window
+          FROM nexmark WHERE auction is not null GROUP BY 1, 2) A
+    ON P.id = A.seller and P.window = A.window
+    """)
+    projs = [n.operator.spec.config.get("projection")
+             for n in prog2.sources()]
+    assert any(p and "person_name" in p for p in projs)
+    assert any(p and "auction_seller" in p for p in projs)

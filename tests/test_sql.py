@@ -658,3 +658,131 @@ def test_exec_canonical_q7_highest_bid():
                                         pr.tolist(), bd.tolist())
                  if p_ == mx[(t // W + 1) * W])
     assert got == exp and len(exp) > 0
+
+
+# -- extended scalar function library (expressions.rs parity batch) ----------
+
+
+def test_extended_math_functions():
+    p = SchemaProvider()
+    events_table(p)
+    out = run_sql("""
+      SELECT sinh(1.0) as sh, cosh(1.0) as ch, tanh(1.0) as th,
+             atan2(1.0, 1.0) as a2, cbrt(27.0) as cb, cot(1.0) as ct,
+             degrees(3.141592653589793) as dg, radians(180.0) as rd,
+             log(100.0) as lg10, log(2.0, 8.0) as lgb, pi() as pi_,
+             gcd(12, 18) as g, lcm(4, 6) as l, factorial(5) as f
+      FROM events WHERE k >= 0
+    """, p)
+    import math
+    r = {c: out.columns[c][0] for c in out.columns}
+    assert abs(r["sh"] - math.sinh(1)) < 1e-4
+    assert abs(r["ch"] - math.cosh(1)) < 1e-4
+    assert abs(r["th"] - math.tanh(1)) < 1e-4
+    assert abs(r["a2"] - math.atan2(1, 1)) < 1e-4
+    assert abs(r["cb"] - 3.0) < 1e-4
+    assert abs(r["ct"] - 1 / math.tan(1)) < 1e-4
+    assert abs(r["dg"] - 180.0) < 1e-3
+    assert abs(r["rd"] - math.pi) < 1e-4
+    assert abs(r["lg10"] - 2.0) < 1e-4
+    assert abs(r["lgb"] - 3.0) < 1e-4
+    assert abs(r["pi_"] - math.pi) < 1e-4
+    assert r["g"] == 6 and r["l"] == 12
+    assert abs(r["f"] - 120.0) < 1e-3
+
+
+def test_extended_string_functions():
+    p = SchemaProvider()
+    events_table(p)
+    out = run_sql("""
+      SELECT repeat(name, 2) as rep, reverse(name) as rev,
+             btrim('  x  ') as bt, to_hex(255) as hx,
+             encode(name, 'hex') as enc,
+             decode(encode(name, 'base64'), 'base64') as rt,
+             concat_ws('-', name, 'z') as cw,
+             digest(name, 'sha256') as dg
+      FROM events WHERE k >= 0
+    """, p)
+    import hashlib
+    name0 = out.columns["rt"][0]  # roundtrip preserves the name
+    assert out.columns["rep"][0] == name0 * 2
+    assert out.columns["rev"][0] == name0[::-1]
+    assert out.columns["bt"][0] == "x"
+    assert out.columns["hx"][0] == "ff"
+    assert out.columns["enc"][0] == name0.encode().hex()
+    assert out.columns["cw"][0] == f"{name0}-z"
+    assert out.columns["dg"][0] == hashlib.sha256(name0.encode()).hexdigest()
+
+
+def test_uuid_random_now():
+    p = SchemaProvider()
+    events_table(p)
+    out = run_sql("""
+      SELECT uuid() as u, random() as r, now() as n, current_date as d
+      FROM events WHERE k >= 0
+    """, p)
+    us = out.columns["u"]
+    assert len(set(us.tolist())) == len(us)  # unique per row
+    assert len(us[0]) == 36
+    rs = out.columns["r"]
+    assert ((rs >= 0) & (rs < 1)).all() and len(set(rs.tolist())) > 1
+    assert out.columns["n"][0] > 1_600_000_000 * 1_000_000
+    assert out.columns["d"][0] % (86_400 * 1_000_000) == 0
+
+
+def test_timestamp_conversions_and_date_bin():
+    p = SchemaProvider()
+    events_table(p)
+    out = run_sql("""
+      SELECT to_timestamp_seconds(10) as s, to_timestamp_millis(10) as ms,
+             to_timestamp_micros(10) as us,
+             date_bin(INTERVAL '2' SECOND, v * 1000000, 0) as db
+      FROM events WHERE k >= 0
+    """, p)
+    assert out.columns["s"][0] == 10_000_000
+    assert out.columns["ms"][0] == 10_000
+    assert out.columns["us"][0] == 10
+    assert (out.columns["db"] % 2_000_000 == 0).all()
+
+
+def test_array_functions():
+    p = SchemaProvider()
+    events_table(p)
+    out = run_sql("""
+      SELECT make_array(k, v) as arr,
+             array_append(make_array(k), v) as app,
+             array_contains(make_array(k, v), k) as has,
+             array_length(make_array(k, v, k)) as ln,
+             array_position(make_array(k, v), v) as pos,
+             array_to_string(make_array(k, v), ',') as s,
+             array_remove(make_array(k, v, k), k) as rm,
+             trim_array(make_array(k, v), 1) as tr
+      FROM events WHERE k >= 0
+    """, p)
+    k0 = out.columns["arr"][0][0]
+    v0 = out.columns["arr"][0][1]
+    assert list(out.columns["app"][0]) == [k0, v0]
+    assert bool(out.columns["has"][0]) is True
+    assert out.columns["ln"][0] == 3
+    assert out.columns["s"][0] == f"{k0},{v0}"
+    assert list(out.columns["rm"][0]) == [v0] or k0 == v0
+    assert list(out.columns["tr"][0]) == [k0]
+
+
+def test_gcd_lcm_factorial_exactness():
+    """Reviewer-verified numeric edge cases: deep Euclid chains, int64
+    lcm magnitudes, exact integer factorial, scalar-literal string fns."""
+    p = SchemaProvider()
+    events_table(p)
+    out = run_sql("""
+      SELECT gcd(1836311903, 1134903170) as g_fib,
+             lcm(100000, 99999) as l_big,
+             factorial(15) as f15,
+             reverse('abc') as rev, repeat('ab', 3) as rep
+      FROM events WHERE k >= 0
+    """, p)
+    assert out.columns["g_fib"][0] == 1  # consecutive Fibonacci: ~44 steps
+    assert out.columns["l_big"][0] == 9_999_900_000  # > 2^31
+    assert out.columns["f15"][0] == 1_307_674_368_000  # exact int64
+    assert out.columns["rev"][0] == "cba"
+    assert out.columns["rep"][0] == "ababab"

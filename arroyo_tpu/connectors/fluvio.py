@@ -39,6 +39,7 @@ class FluvioConfig(BaseModel):
     endpoint: Optional[str] = None  # None = 'default cluster' (needs client)
     offset: Literal["earliest", "latest"] = "earliest"  # when no stored state
     format: str = "json"
+    format_options: Dict[str, Any] = {}
     batch_size: Optional[int] = None
     max_messages: Optional[int] = None  # bounded runs (tests)
 
@@ -58,7 +59,7 @@ class FluvioSource(SourceOperator):
     def __init__(self, cfg: Dict[str, Any]):
         super().__init__("fluvio_source")
         self.cfg = FluvioConfig(**cfg)
-        self.fmt = make_format(self.cfg.format)
+        self.fmt = make_format(self.cfg.format, **self.cfg.format_options)
 
     def tables(self) -> List[TableDescriptor]:
         # table 'f': partition -> next offset to read (source.rs:44-46)
@@ -128,7 +129,7 @@ class FluvioSink(Operator):
     def __init__(self, cfg: Dict[str, Any]):
         super().__init__("fluvio_sink")
         self.cfg = FluvioConfig(**cfg)
-        self.fmt = make_format(self.cfg.format)
+        self.fmt = make_format(self.cfg.format, **self.cfg.format_options)
 
     async def on_start(self, ctx: Context) -> None:
         # resolve the producer up front so a bad endpoint fails at operator

@@ -49,6 +49,7 @@ class SseConfig(BaseModel):
     events: Optional[str] = None  # comma-separated event-type filter
     headers: Optional[str] = None
     format: str = "json"
+    format_options: Dict[str, Any] = {}
 
 
 class SseSource(SourceOperator):
@@ -64,7 +65,7 @@ class SseSource(SourceOperator):
     def __init__(self, cfg: Dict[str, Any]):
         super().__init__("sse_source")
         self.cfg = SseConfig(**cfg)
-        self.fmt: Format = make_format(self.cfg.format)
+        self.fmt: Format = make_format(self.cfg.format, **self.cfg.format_options)
 
     def tables(self) -> List[TableDescriptor]:
         return [global_table("e", "sse last event id")]
@@ -149,6 +150,7 @@ class PollingHttpConfig(BaseModel):
     body: Optional[str] = None
     headers: Optional[str] = None
     format: str = "json"
+    format_options: Dict[str, Any] = {}
     emit_behavior: str = "all"  # 'all' | 'changed' (dedupe identical bodies)
     max_polls: Optional[int] = None  # tests / bounded runs
 
@@ -160,7 +162,7 @@ class PollingHttpSource(SourceOperator):
     def __init__(self, cfg: Dict[str, Any]):
         super().__init__("polling_http_source")
         self.cfg = PollingHttpConfig(**cfg)
-        self.fmt = make_format(self.cfg.format)
+        self.fmt = make_format(self.cfg.format, **self.cfg.format_options)
 
     def tables(self) -> List[TableDescriptor]:
         return [global_table("h", "polling http state")]
@@ -202,6 +204,7 @@ class WebhookConfig(BaseModel):
     endpoint: str
     headers: Optional[str] = None
     format: str = "json"
+    format_options: Dict[str, Any] = {}
     max_inflight: int = 50
 
 
@@ -213,7 +216,7 @@ class WebhookSink(Operator):
     def __init__(self, cfg: Dict[str, Any]):
         super().__init__("webhook_sink")
         self.cfg = WebhookConfig(**cfg)
-        self.fmt = make_format(self.cfg.format)
+        self.fmt = make_format(self.cfg.format, **self.cfg.format_options)
         self._session = None
         self._inflight: set = set()
 

@@ -41,6 +41,7 @@ class KafkaConfig(BaseModel):
     offset: str = "earliest"  # 'earliest' | 'latest' when no stored state
     read_mode: str = "read_committed"
     batch_size: Optional[int] = None
+    format_options: Dict[str, Any] = {}  # e.g. avro schema / framing opts
     client_configs: Dict[str, str] = {}
     max_messages: Optional[int] = None  # bounded runs (tests)
 
@@ -147,7 +148,7 @@ class KafkaSource(SourceOperator):
     def __init__(self, cfg: Dict[str, Any]):
         super().__init__("kafka_source")
         self.cfg = KafkaConfig(**cfg)
-        self.fmt = make_format(self.cfg.format)
+        self.fmt = make_format(self.cfg.format, **self.cfg.format_options)
 
     def tables(self) -> List[TableDescriptor]:
         # table 's': partition -> last-read offset (source/mod.rs:155-175)
@@ -226,7 +227,7 @@ class KafkaSink(TwoPhaseCommitterSink):
     def __init__(self, cfg: Dict[str, Any]):
         super().__init__("kafka_sink")
         self.cfg = KafkaConfig(**cfg)
-        self.fmt = make_format(self.cfg.format)
+        self.fmt = make_format(self.cfg.format, **self.cfg.format_options)
         self._txn_id: Optional[str] = None
 
     def _broker(self) -> InMemoryKafkaBroker:

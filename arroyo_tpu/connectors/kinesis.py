@@ -40,6 +40,7 @@ class KinesisConfig(BaseModel):
     stream_name: str
     region: str = "us-east-1"
     format: str = "json"
+    format_options: Dict[str, Any] = {}
     batch_size: Optional[int] = None
     max_messages: Optional[int] = None  # bounded runs (tests)
     offset: Literal["earliest", "latest"] = "earliest"
@@ -212,7 +213,7 @@ class KinesisSource(SourceOperator):
     def __init__(self, cfg: Dict[str, Any]):
         super().__init__("kinesis_source")
         self.cfg = KinesisConfig(**cfg)
-        self.fmt = make_format(self.cfg.format)
+        self.fmt = make_format(self.cfg.format, **self.cfg.format_options)
 
     def tables(self) -> List[TableDescriptor]:
         # table 's': shard_id -> last-read sequence number
@@ -316,7 +317,7 @@ class KinesisSink(Operator):
     def __init__(self, cfg: Dict[str, Any]):
         super().__init__("kinesis_sink")
         self.cfg = KinesisConfig(**cfg)
-        self.fmt = make_format(self.cfg.format)
+        self.fmt = make_format(self.cfg.format, **self.cfg.format_options)
 
     async def on_start(self, ctx: Context) -> None:
         self.client = _client_for(self.cfg)

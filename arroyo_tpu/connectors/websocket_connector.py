@@ -24,13 +24,14 @@ class WebsocketConfig(BaseModel):
     endpoint: str
     subscription_message: Optional[str] = None
     format: str = "json"
+    format_options: Dict[str, Any] = {}
 
 
 class WebsocketSource(SourceOperator):
     def __init__(self, cfg: Dict[str, Any]):
         super().__init__("websocket_source")
         self.cfg = WebsocketConfig(**cfg)
-        self.fmt = make_format(self.cfg.format)
+        self.fmt = make_format(self.cfg.format, **self.cfg.format_options)
 
     def tables(self) -> List[TableDescriptor]:
         return [global_table("w", "websocket message count")]

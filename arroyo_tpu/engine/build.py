@@ -22,6 +22,7 @@ from .operators_basic import (
     GlobalKeyOperator,
     KeyByOperator,
     UdfOperator,
+    UnionOperator,
     WatermarkOperator,
 )
 
@@ -51,6 +52,7 @@ _BUILDERS[OpKind.EXPRESSION] = lambda op: ExpressionOperator(op.name, op.expr)
 _BUILDERS[OpKind.UDF] = lambda op: UdfOperator(op.name, op.expr)
 _BUILDERS[OpKind.FLAT_MAP] = lambda op: FlatMapOperator(op.name, op.expr)
 _BUILDERS[OpKind.FLATTEN] = lambda op: FlattenOperator(op.name)
+_BUILDERS[OpKind.UNION] = lambda op: UnionOperator(op.name)
 _BUILDERS[OpKind.WATERMARK] = lambda op: WatermarkOperator(op.name, op.spec)
 _BUILDERS[OpKind.KEY_BY] = lambda op: KeyByOperator(op.name, op.key_cols)
 _BUILDERS[OpKind.GLOBAL_KEY] = lambda op: GlobalKeyOperator(op.name)

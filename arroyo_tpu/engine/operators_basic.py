@@ -64,6 +64,18 @@ class UdfOperator(Operator):
         await ctx.collect(eval_host_expr(self.fn, batch))
 
 
+class UnionOperator(Operator):
+    """UNION ALL merge: batches from every input side pass through
+    unchanged; the runner's WatermarkHolder takes the min watermark across
+    inputs.  The reference has no union support (pipeline.rs:393)."""
+
+    def __init__(self, name: str):
+        super().__init__(name)
+
+    async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        await ctx.collect(batch)
+
+
 class FlattenOperator(Operator):
     """Expand list-valued column '__flatten' rows into multiple rows
     (FlattenOperator, operators/mod.rs)."""

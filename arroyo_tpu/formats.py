@@ -330,6 +330,8 @@ def make_format(name: str, **opts: Any) -> Format:
         return JsonFormat(debezium=(name == "debezium_json"), **opts)
     if name in ("raw", "raw_string"):
         return RawStringFormat()
+    if name == "avro":
+        return AvroFormat(**opts)
     raise ValueError(f"unknown format: {name!r}")
 
 
@@ -363,3 +365,178 @@ def columns_from_json_schema(schema: Dict[str, Any]) -> List[Dict[str, str]]:
     if not cols:
         raise ValueError("schema has no supported properties")
     return cols
+
+
+# ---------------------------------------------------------------------------
+# Avro (binary encoding, pure python)
+# ---------------------------------------------------------------------------
+
+
+def _zigzag_encode(n: int) -> bytes:
+    """Avro long: zigzag + varint."""
+    z = (n << 1) ^ (n >> 63)
+    out = bytearray()
+    while True:
+        b = z & 0x7F
+        z >>= 7
+        if z:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _zigzag_decode(buf: bytes, pos: int) -> Tuple[int, int]:
+    shift = 0
+    acc = 0
+    while True:
+        b = buf[pos]
+        pos += 1
+        acc |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            break
+        shift += 7
+    return (acc >> 1) ^ -(acc & 1), pos
+
+
+def avro_schema_for_rows(rows: Sequence[Dict[str, Any]],
+                         name: str = "Record") -> Dict[str, Any]:
+    """Infer an Avro record schema from sample rows (nullable unions for
+    every field, mirroring json_schema_for_rows)."""
+    fields: Dict[str, str] = {}
+    for r in rows:
+        for k, v in r.items():
+            if isinstance(v, bool):
+                t = "boolean"
+            elif isinstance(v, (int, np.integer)):
+                t = "long"
+            elif isinstance(v, (float, np.floating)):
+                t = "double"
+            elif v is None:
+                continue
+            else:
+                t = "string"
+            prev = fields.get(k)
+            fields[k] = t if prev in (None, t) else "string"
+    return {"type": "record", "name": name,
+            "fields": [{"name": k, "type": ["null", t]}
+                       for k, t in fields.items()]}
+
+
+class AvroFormat(Format):
+    """Avro binary serde against a record schema.
+
+    The reference leaves Avro as a TODO (formats.rs:11-131 handles json/raw
+    only); this implements the single-record binary encoding with optional
+    Confluent wire framing (magic 0 + 4-byte schema id), the layout Kafka
+    schema-registry producers emit.  Schemas: every field is a nullable
+    union ``["null", T]`` with T in {boolean, long, double, string, bytes}.
+    """
+
+    def __init__(self, schema: Optional[Dict[str, Any]] = None,
+                 confluent_schema_registry: bool = False,
+                 schema_id: int = 0, **_ignored):
+        if isinstance(schema, str):
+            schema = json.loads(schema)
+        self.schema = schema
+        self.confluent = confluent_schema_registry
+        self.schema_id = schema_id
+
+    SUPPORTED = {"boolean", "int", "long", "float", "double", "string",
+                 "bytes"}
+
+    def _field_types(self, schema=None) -> List[Tuple[str, str]]:
+        schema = schema or self.schema
+        if schema is None:
+            raise ValueError("avro format needs a schema")
+        out = []
+        for f in schema["fields"]:
+            t = f["type"]
+            # the wire layout implemented here is exactly ["null", T]
+            # unions (null = branch 0); anything else would be silently
+            # mis-framed, so reject it loudly
+            if not (isinstance(t, list) and len(t) == 2 and t[0] == "null"):
+                raise ValueError(
+                    f"avro field {f['name']!r}: only [\"null\", T] unions "
+                    f"are supported (got {t!r})")
+            t = t[1]
+            if isinstance(t, dict):
+                t = "long" if t.get("logicalType") else t.get("type", "string")
+            if t not in self.SUPPORTED:
+                raise ValueError(
+                    f"avro field {f['name']!r}: unsupported type {t!r}")
+            out.append((f["name"], t))
+        return out
+
+    # -- encode -------------------------------------------------------
+
+    def _encode_value(self, t: str, v: Any) -> bytes:
+        import struct
+
+        if t == "boolean":
+            return b"\x01" if v else b"\x00"
+        if t in ("long", "int"):
+            return _zigzag_encode(int(v))
+        if t == "double":
+            return struct.pack("<d", float(v))
+        if t == "float":
+            return struct.pack("<f", float(v))
+        if t == "bytes":
+            raw = bytes(v)
+            return _zigzag_encode(len(raw)) + raw
+        # string (default)
+        raw = str(v).encode()
+        return _zigzag_encode(len(raw)) + raw
+
+    def serialize(self, rows: Sequence[Dict[str, Any]]) -> List[bytes]:
+        # no configured schema: infer per call (Format contract says
+        # stateless; a job needing a stable cross-batch schema must
+        # configure one)
+        fts = self._field_types(self.schema
+                                or avro_schema_for_rows(rows))
+        out = []
+        header = (b"\x00" + self.schema_id.to_bytes(4, "big")
+                  if self.confluent else b"")
+        for r in rows:
+            buf = bytearray(header)
+            for name, t in fts:
+                v = r.get(name)
+                if v is None:
+                    buf += _zigzag_encode(0)  # union branch 0 = null
+                else:
+                    buf += _zigzag_encode(1)  # union branch 1 = T
+                    buf += self._encode_value(t, v)
+            out.append(bytes(buf))
+        return out
+
+    # -- decode -------------------------------------------------------
+
+    def _decode_value(self, t: str, buf: bytes, pos: int) -> Tuple[Any, int]:
+        import struct
+
+        if t == "boolean":
+            return buf[pos] != 0, pos + 1
+        if t in ("long", "int"):
+            return _zigzag_decode(buf, pos)
+        if t == "double":
+            return struct.unpack_from("<d", buf, pos)[0], pos + 8
+        if t == "float":
+            return struct.unpack_from("<f", buf, pos)[0], pos + 4
+        n, pos = _zigzag_decode(buf, pos)
+        raw = buf[pos:pos + n]
+        return (raw if t == "bytes" else raw.decode()), pos + n
+
+    def deserialize(self, payloads: Sequence[bytes]) -> List[Dict[str, Any]]:
+        fts = self._field_types()
+        rows = []
+        for p in payloads:
+            pos = 5 if self.confluent else 0
+            row: Dict[str, Any] = {}
+            for name, t in fts:
+                branch, pos = _zigzag_decode(p, pos)
+                if branch == 0:
+                    row[name] = None
+                else:
+                    row[name], pos = self._decode_value(t, p, pos)
+            rows.append(row)
+        return rows

@@ -155,6 +155,7 @@ class OpKind(Enum):
     UPDATING = "updating"
     NON_WINDOW_AGGREGATOR = "non_window_aggregator"
     UPDATING_KEY = "updating_key"
+    UNION = "union"  # N-ary stream merge (the reference bails on unions)
 
 
 class JoinType(Enum):
@@ -608,6 +609,32 @@ class Stream:
         self.program.add_edge(self.tail, nid, EdgeType.SHUFFLE_JOIN_LEFT, key_schema=ks)
         self.program.add_edge(other.tail, nid, EdgeType.SHUFFLE_JOIN_RIGHT, key_schema=ks)
         return Stream(self.program, nid, self.keyed)
+
+    def union(self, other: "Stream", name: str = "union",
+              parallelism: Optional[int] = None) -> "Stream":
+        """Merge two streams (UNION ALL): batches from both flow through
+        unchanged; the watermark is the min across inputs (WatermarkHolder
+        semantics).  The reference has no union support
+        (arroyo-sql/src/pipeline.rs:393)."""
+        assert self.program is other.program, "union streams must share a Program"
+        if other.tail == self.tail:
+            # self-union: nx.DiGraph would collapse the duplicate (src,
+            # dst) edge and silently drop the duplication — route one side
+            # through a pass-through node
+            dup = LogicalOperator(OpKind.UNION, f"{name}_dup")
+            dup_id = self.program.add_node(
+                dup, self.program.node(other.tail).parallelism)
+            self.program.add_edge(other.tail, dup_id, EdgeType.FORWARD,
+                                  key_schema="()")
+            other = Stream(self.program, dup_id, None)
+        op = LogicalOperator(OpKind.UNION, name)
+        par = parallelism or self.program.node(self.tail).parallelism
+        nid = self.program.add_node(op, par)
+        self.program.add_edge(self.tail, nid, EdgeType.SHUFFLE,
+                              key_schema="()")
+        self.program.add_edge(other.tail, nid, EdgeType.SHUFFLE,
+                              key_schema="()")
+        return Stream(self.program, nid, None)
 
     # -- updating ----------------------------------------------------------
 

@@ -180,6 +180,9 @@ class Select:
     limit: Optional[int] = None
     distinct: bool = False
     ctes: List[Tuple[str, "Select"]] = field(default_factory=list)
+    # UNION ALL chain (the reference bails on unions, pipeline.rs:393 —
+    # supporting them is deliberate over-parity)
+    union_all: Optional["Select"] = None
 
 
 @dataclass

@@ -215,6 +215,14 @@ class Parser:
                     break
         sel = self.parse_select_body()
         sel.ctes = ctes + sel.ctes
+        cur = sel
+        while self.eat_kw("union"):
+            if not self.eat_kw("all"):
+                raise SqlParseError(
+                    "UNION (distinct) over streams is unbounded-state; "
+                    "use UNION ALL")
+            cur.union_all = self.parse_select_body()
+            cur = cur.union_all
         return sel
 
     def parse_select_body(self) -> Select:

@@ -349,6 +349,28 @@ class Planner:
 
         if sel.order_by and sel.limit is not None:
             planned = self._plan_top_n(sel, planned)
+
+        if sel.union_all is not None:
+            if sel.union_all.order_by or sel.union_all.limit is not None:
+                # trailing ORDER BY/LIMIT would bind to the last branch
+                # only — reject rather than silently cap one branch
+                raise SqlPlanError(
+                    "ORDER BY/LIMIT after UNION ALL must be applied via an "
+                    "outer SELECT (e.g. SELECT * FROM (... UNION ALL ...) "
+                    "ORDER BY ... LIMIT ...)")
+            # branches see the same scope (incl. this select's CTEs)
+            other = self.plan_select(sel.union_all, prog, scope)
+            ours = {(c, k) for c, k in planned.schema.columns.items()
+                    if not c.startswith("__")}
+            theirs = {(c, k) for c, k in other.schema.columns.items()
+                      if not c.startswith("__")}
+            if ours != theirs:
+                raise SqlPlanError(
+                    f"UNION ALL branches must produce the same columns and "
+                    f"types ({sorted(ours)} vs {sorted(theirs)})")
+            merged = planned.stream.union(
+                other.stream, name=f"union_{self._next_id()}")
+            planned = Planned(merged, planned.schema.clone())
         return planned
 
     def _plan_table_ref(self, tr: TableRef, prog: Program,

@@ -168,6 +168,23 @@ class SchemaProvider:
             if col.generated_as is not None:
                 generated.append((col.name.lower(), kind, col.generated_as))
 
+        # the connector consumes the serde format too (it constructs the
+        # Format); planner-only options stay stripped
+        cfg["format"] = fmt
+        if fmt == "avro" and "format_options" not in cfg and ct.columns:
+            # DDL drives the serde: synthesize the Avro record schema from
+            # the declared columns (nullable unions)
+            avro_t = {"i": "long", "f": "double", "b": "boolean",
+                      "s": "string", "t": "long"}
+            cfg["format_options"] = {"schema": {
+                "type": "record", "name": ct.name,
+                "fields": [
+                    {"name": c.name.lower(),
+                     "type": ["null", avro_t.get(
+                         TYPE_KIND.get(c.type, "s"), "string")]}
+                    for c in ct.columns if c.generated_as is None],
+            }}
+
         td = TableDef(
             ct.name.lower(), connector, cfg, schema,
             is_source=(typ == "source"), is_sink=(typ == "sink"),

@@ -786,3 +786,134 @@ def test_gcd_lcm_factorial_exactness():
     assert out.columns["f15"][0] == 1_307_674_368_000  # exact int64
     assert out.columns["rev"][0] == "cba"
     assert out.columns["rep"][0] == "ababab"
+
+
+def test_union_all_sql_and_stream():
+    """UNION ALL — deliberate over-parity: the reference bails on unions
+    (arroyo-sql/src/pipeline.rs:393)."""
+    p = SchemaProvider()
+    events_table(p)
+    out = run_sql("""
+      SELECT k, v FROM events WHERE k < 2
+      UNION ALL
+      SELECT k, v FROM events WHERE k >= 2
+    """, p)
+    # partition + union = the whole table, duplicates preserved
+    whole = run_sql("SELECT k, v FROM events", p)
+    assert sorted(zip(out.columns["k"].tolist(), out.columns["v"].tolist())) \
+        == sorted(zip(whole.columns["k"].tolist(),
+                      whole.columns["v"].tolist()))
+
+    # three-branch chain
+    out3 = run_sql("""
+      SELECT k FROM events WHERE k = 0
+      UNION ALL SELECT k FROM events WHERE k = 0
+      UNION ALL SELECT k FROM events WHERE k = 0
+    """, p)
+    base = run_sql("SELECT k FROM events WHERE k = 0", p)
+    assert len(out3) == 3 * len(base)
+
+    # mismatched columns rejected
+    with pytest.raises(Exception):
+        run_sql("SELECT k FROM events UNION ALL SELECT v, k FROM events", p)
+
+    # plain UNION is an explicit, honest error
+    with pytest.raises(Exception):
+        run_sql("SELECT k FROM events UNION SELECT k FROM events", p)
+
+
+def test_union_windowed_aggregate_downstream():
+    """Aggregates work over a union: watermark is the min across branches."""
+    p = SchemaProvider()
+    events_table(p)
+    out = run_sql("""
+      WITH both_halves as (
+        SELECT k, v FROM events WHERE v < 25
+        UNION ALL
+        SELECT k, v FROM events WHERE v >= 25
+      )
+      SELECT k, TUMBLE(INTERVAL '2' SECOND) as window, count(*) as cnt
+      FROM both_halves GROUP BY 1, 2
+    """, p)
+    ref = run_sql("""
+      SELECT k, TUMBLE(INTERVAL '2' SECOND) as window, count(*) as cnt
+      FROM events GROUP BY 1, 2
+    """, p)
+    got = sorted(zip(out.columns["k"].tolist(),
+                     out.columns["window_start"].tolist(),
+                     out.columns["cnt"].tolist()))
+    want = sorted(zip(ref.columns["k"].tolist(),
+                      ref.columns["window_start"].tolist(),
+                      ref.columns["cnt"].tolist()))
+    assert got == want and len(got) > 0
+
+
+def test_create_table_format_reaches_connector():
+    """format='avro' in CREATE TABLE WITH(...) must flow to the connector
+    (it was silently dropped to json), and the DDL columns drive the
+    synthesized Avro record schema."""
+    from arroyo_tpu.connectors.kafka import InMemoryKafkaBroker
+    from arroyo_tpu.formats import AvroFormat
+
+    InMemoryKafkaBroker.reset("sqlav")
+    broker = InMemoryKafkaBroker.get("sqlav")
+    broker.create_topic("ev", partitions=1)
+    schema = {"type": "record", "name": "ev",
+              "fields": [{"name": "i", "type": ["null", "long"]},
+                         {"name": "s", "type": ["null", "string"]}]}
+    enc = AvroFormat(schema=schema)
+    for i in range(30):
+        [p] = enc.serialize([{"i": i, "s": f"r{i}"}])
+        broker.produce("ev", p, partition=0)
+
+    out = run_sql("""
+      CREATE TABLE ev (i bigint, s text) WITH (
+        connector = 'kafka', bootstrap_servers = 'memory://sqlav',
+        topic = 'ev', format = 'avro', max_messages = '30');
+      SELECT i, s FROM ev
+    """)
+    assert sorted(out.columns["i"].tolist()) == list(range(30))
+    assert out.columns["s"][0].startswith("r")
+
+
+def test_union_reviewer_edge_cases():
+    """CTE visibility in union branches, self-union duplication, trailing
+    ORDER BY rejection, type compatibility (reviewer-found)."""
+    p = SchemaProvider()
+    events_table(p)
+
+    # CTE visible in the second branch
+    out = run_sql("""
+      WITH x AS (SELECT k, v FROM events)
+      SELECT k, v FROM x WHERE k < 2
+      UNION ALL
+      SELECT k, v FROM x WHERE k >= 2
+    """, p)
+    whole = run_sql("SELECT k, v FROM events", p)
+    assert len(out) == len(whole)
+
+    # self-union through the fluent API duplicates rows
+    from arroyo_tpu import Batch, Stream
+    from arroyo_tpu.connectors.memory import clear_sink, sink_output
+    from arroyo_tpu.engine.engine import LocalRunner
+    import numpy as np
+
+    clear_sink("su")
+    src = Batch(np.arange(5, dtype=np.int64),
+                {"v": np.arange(5, dtype=np.int64)})
+    s = (Stream.source("memory", {"batches": [src]})
+         .map(lambda c: {"v": c["v"]}, name="id"))
+    prog = s.union(s).sink("memory", {"name": "su"})
+    LocalRunner(prog).run()
+    got = sorted(r for b in sink_output("su") for r in b.columns["v"].tolist())
+    assert got == sorted(list(range(5)) * 2)  # every row twice
+
+    # trailing ORDER BY/LIMIT is rejected with guidance
+    with pytest.raises(Exception, match="outer SELECT"):
+        run_sql("""SELECT k FROM events UNION ALL
+                   SELECT k FROM events ORDER BY k LIMIT 3""", p)
+
+    # same names, different types -> rejected
+    with pytest.raises(Exception, match="columns and"):
+        run_sql("""SELECT k, name FROM events UNION ALL
+                   SELECT k, v as name FROM events""", p)

@@ -102,6 +102,22 @@ FROM nexmark WHERE bid is not null GROUP BY 1, 2</textarea>
     <pre id="output">select a job's "tail" to stream results…</pre>
   </section>
   <section style="grid-column: 1 / 3">
+    <h2>Connection tables
+      <span style="color:var(--dim)">(connector creation wizard)</span></h2>
+    <div style="display:flex;gap:10px;align-items:center;flex-wrap:wrap">
+      <select id="conn_sel"></select>
+      <input id="ct_name" placeholder="table name" style="width:160px">
+      <select id="ct_type"><option>source</option><option>sink</option></select>
+      <button onclick="createConnTable()">Create</button>
+      <span id="ct_msg" style="color:var(--dim)"></span>
+    </div>
+    <div id="conn_form" style="display:grid;
+         grid-template-columns:repeat(auto-fill, minmax(220px, 1fr));
+         gap:8px;margin-top:10px"></div>
+    <table style="margin-top:10px"><thead><tr><th>name</th><th>connector</th>
+      <th>type</th><th></th></tr></thead><tbody id="ctrows"></tbody></table>
+  </section>
+  <section style="grid-column: 1 / 3">
     <h2>Job detail <span id="jobinfo" style="color:var(--dim)"></span></h2>
     <div id="charts">select a job's "watch" for live operator rates…</div>
     <div style="display:grid;grid-template-columns:1fr 1fr;gap:12px;
@@ -347,6 +363,71 @@ async function tail(pid, jid) {
     }
   }
 }
+
+let connectors = [];
+async function loadConnectors() {
+  connectors = (await (await fetch('/v1/connectors')).json()).data
+    .filter((c) => c.config_schema);
+  $('conn_sel').innerHTML = connectors.map(
+    (c) => `<option value="${esc(c.id)}">${esc(c.id)}</option>`).join('');
+  $('conn_sel').onchange = renderConnForm;
+  renderConnForm();
+}
+function renderConnForm() {
+  const meta = connectors.find((c) => c.id === $('conn_sel').value);
+  if (!meta) return;
+  const props = meta.config_schema.properties || {};
+  const req = new Set(meta.config_schema.required || []);
+  $('conn_form').innerHTML = Object.entries(props).map(([k, spec]) => {
+    const ph = (spec.type || (spec.anyOf ? 'optional' : '')) +
+      (spec.default !== undefined && spec.default !== null
+        ? ' (default ' + esc(JSON.stringify(spec.default)) + ')' : '');
+    return `<label style="font-size:12px;color:var(--dim)">` +
+      `${esc(k)}${req.has(k) ? ' *' : ''}<br>` +
+      `<input data-cfg="${esc(k)}" placeholder="${esc(ph)}" ` +
+      `style="width:100%"></label>`;
+  }).join('');
+}
+async function createConnTable() {
+  const meta = connectors.find((c) => c.id === $('conn_sel').value);
+  const props = (meta && meta.config_schema.properties) || {};
+  const cfg = {};
+  for (const inp of document.querySelectorAll('[data-cfg]')) {
+    if (inp.value === '') continue;
+    const spec = props[inp.dataset.cfg] || {};
+    const t = spec.type;
+    // object/array fields (format_options, client_configs) must post as
+    // real JSON values, not strings
+    cfg[inp.dataset.cfg] = (t === 'object' || t === 'array')
+      ? JSON.parse(inp.value) : inp.value;
+  }
+  const body = {name: $('ct_name').value, connector: $('conn_sel').value,
+                table_type: $('ct_type').value, config: cfg};
+  const resp = await fetch('/v1/connection_tables',
+    {method: 'POST', headers: {'content-type': 'application/json'},
+     body: JSON.stringify(body)});
+  const out = await resp.json();
+  $('ct_msg').textContent = resp.ok ? 'created'
+    : (out.error || JSON.stringify(out));
+  $('ct_msg').className = resp.ok ? '' : 'err';
+  refreshConnTables();
+}
+async function refreshConnTables() {
+  const data = (await (await fetch('/v1/connection_tables')).json()).data
+    || [];
+  $('ctrows').innerHTML = data.map((t) =>
+    `<tr><td>${esc(t.name)}</td><td>${esc(t.connector)}</td>` +
+    `<td>${esc(t.table_type || '')}</td>` +
+    `<td><a href="#" onclick="delConnTable('${esc(t.id)}');return false">` +
+    `delete</a></td></tr>`).join('');
+}
+async function delConnTable(id) {
+  await fetch('/v1/connection_tables/' + id, {method: 'DELETE'});
+  refreshConnTables();
+}
+loadConnectors();
+refreshConnTables();
+setInterval(refreshConnTables, 5000);
 
 refresh();
 setInterval(refresh, 2000);

@@ -464,10 +464,15 @@ class ApiServer:
 
         @r.get("/v1/connectors")
         async def connectors(req: Request):
+            # config_schema plays the reference's table_config role
+            # (connector-schemas/*/table.json served via the metadata
+            # crate): the console renders creation forms from it
             return {"data": [{
                 "id": m.name, "name": m.name,
                 "source": m.supports_source, "sink": m.supports_sink,
                 "description": m.description,
+                "config_schema": (m.config_model.model_json_schema()
+                                  if m.config_model else None),
             } for m in list_connectors()]}
 
         @r.post("/v1/connection_tables")

@@ -461,7 +461,10 @@ class AvroFormat(Format):
                     f"are supported (got {t!r})")
             t = t[1]
             if isinstance(t, dict):
-                t = "long" if t.get("logicalType") else t.get("type", "string")
+                # logical types annotate an underlying type whose WIRE
+                # encoding is authoritative (uuid -> string, decimal ->
+                # bytes, timestamp-micros -> long)
+                t = t.get("type", "string")
             if t not in self.SUPPORTED:
                 raise ValueError(
                     f"avro field {f['name']!r}: unsupported type {t!r}")
@@ -530,7 +533,9 @@ class AvroFormat(Format):
         fts = self._field_types()
         rows = []
         for p in payloads:
-            pos = 5 if self.confluent else 0
+            # confluent framing guard (mirrors JsonFormat): only strip the
+            # 5-byte header when it is actually present
+            pos = 5 if (self.confluent and len(p) >= 5 and p[0] == 0) else 0
             row: Dict[str, Any] = {}
             for name, t in fts:
                 branch, pos = _zigzag_decode(p, pos)

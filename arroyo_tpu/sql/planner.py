@@ -347,6 +347,14 @@ class Planner:
         if sel.having is not None and not _has_aggregates(sel):
             planned = self._filter(planned, sel.having, "having")
 
+        if sel.union_all is not None and (sel.order_by
+                                          or sel.limit is not None):
+            # a leading ORDER BY/LIMIT would be planned as a branch-local
+            # TopN before the union — ambiguous; standard SQL requires
+            # parens here
+            raise SqlPlanError(
+                "ORDER BY/LIMIT on a UNION ALL branch must be wrapped "
+                "in a subquery (SELECT * FROM (...) LIMIT ...)")
         if sel.order_by and sel.limit is not None:
             planned = self._plan_top_n(sel, planned)
 

@@ -965,3 +965,25 @@ def test_avro_rejects_unsupported_schema_shapes():
     f = AvroFormat()
     f.serialize([{"a": 1}])
     assert f.schema is None
+
+
+def test_avro_logical_types_and_framing_guard():
+    """logicalType fields use their UNDERLYING type's wire encoding; a
+    confluent-mode decoder only strips a header that is present."""
+    from arroyo_tpu.formats import AvroFormat
+
+    schema = {"type": "record", "name": "r", "fields": [
+        {"name": "u", "type": ["null", {"type": "string",
+                                        "logicalType": "uuid"}]},
+        {"name": "ts", "type": ["null", {"type": "long",
+                                         "logicalType": "timestamp-micros"}]},
+    ]}
+    rows = [{"u": "ab-cd", "ts": 123456}]
+    f = AvroFormat(schema=schema)
+    assert AvroFormat(schema=schema).deserialize(f.serialize(rows)) == rows
+
+    # unframed payload with confluent=True decodes intact (guarded strip)
+    fc = AvroFormat(schema=schema, confluent_schema_registry=True)
+    plain = f.serialize(rows)
+    if plain[0][0] != 0:  # only meaningful when no accidental magic byte
+        assert fc.deserialize(plain) == rows

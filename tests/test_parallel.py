@@ -303,3 +303,52 @@ def test_mesh_out_of_order_before_fire(rng):
     assert got == {3 * SEC: (1, 9), 4 * SEC: (1, 9),
                    11 * SEC: (1, 5), 12 * SEC: (1, 5)}
     assert st.late_rows == 0
+
+
+def _run_sql_q8_shape(monkeypatch, mesh: str):
+    """q8-shaped windowed join (two tumbling counts joined per window)
+    through the SQL engine with the mesh forced on/off."""
+    from arroyo_tpu import Batch
+    from arroyo_tpu.connectors.memory import clear_sink, sink_output
+    from arroyo_tpu.engine.engine import LocalRunner
+    from arroyo_tpu.sql import SchemaProvider, plan_sql
+
+    monkeypatch.setenv("ARROYO_MESH", mesh)
+    rng = np.random.default_rng(23)
+    n = 2000
+    ts = np.sort(rng.integers(0, 4 * SEC, n)).astype(np.int64)
+    p = SchemaProvider()
+    p.add_memory_table("ev", {"u": "i", "s": "i"}, [
+        Batch(ts, {"u": rng.integers(0, 12, n).astype(np.int64),
+                   "s": rng.integers(0, 12, n).astype(np.int64)})])
+    clear_sink("results")
+    prog = plan_sql("""
+      SELECT P.u as u, P.np as np, A.na as na
+      FROM (
+        SELECT u, TUMBLE(INTERVAL '1' SECOND) as window, count(*) as np
+        FROM ev GROUP BY 1, 2
+      ) AS P
+      JOIN (
+        SELECT s, TUMBLE(INTERVAL '1' SECOND) as window, count(*) as na
+        FROM ev GROUP BY 1, 2
+      ) AS A
+      ON P.u = A.s and P.window = A.window
+    """, p)
+    LocalRunner(prog).run()
+    out = Batch.concat(sink_output("results"))
+    return sorted(zip(out.columns["u"].tolist(),
+                      out.columns["np"].tolist(),
+                      out.columns["na"].tolist()))
+
+
+def test_sql_q8_join_mesh_matches_single_device(monkeypatch):
+    """The q8-shaped join pipeline: both tumbling-count inputs run with
+    mesh-sharded state; the joined output must match single-device exactly."""
+    import jax
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    mesh_out = _run_sql_q8_shape(monkeypatch, "auto")
+    single_out = _run_sql_q8_shape(monkeypatch, "off")
+    assert mesh_out == single_out
+    assert len(mesh_out) > 0

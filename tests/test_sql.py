@@ -917,3 +917,11 @@ def test_union_reviewer_edge_cases():
     with pytest.raises(Exception, match="columns and"):
         run_sql("""SELECT k, name FROM events UNION ALL
                    SELECT k, v as name FROM events""", p)
+
+
+def test_union_leading_order_by_rejected():
+    p = SchemaProvider()
+    events_table(p)
+    with pytest.raises(Exception, match="subquery"):
+        run_sql("""SELECT k FROM events ORDER BY k LIMIT 3
+                   UNION ALL SELECT k FROM events""", p)

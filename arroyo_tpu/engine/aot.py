@@ -73,12 +73,34 @@ def compile_program(program) -> CompileReport:
 
 def enable_persistent_cache(cache_dir: Optional[str] = None) -> str:
     """Point jax at an on-disk compilation cache (idempotent).  Returns
-    the directory in use."""
+    the directory in use.
+
+    The default directory is keyed by the host's CPU model: XLA:CPU AOT
+    blobs embed machine features, and loading a blob compiled on a
+    different CPU generation risks SIGILL (observed via a shared /tmp
+    across heterogeneous hosts)."""
     import jax
 
     d = (cache_dir or os.environ.get("JAX_COMPILATION_CACHE_DIR")
-         or os.environ.get("ARROYO_COMPILE_CACHE")
-         or "/tmp/arroyo_jax_cache")
+         or os.environ.get("ARROYO_COMPILE_CACHE"))
+    if d is None:
+        import hashlib
+        import platform
+
+        try:  # CPU model distinguishes generations; platform alone doesn't
+            with open("/proc/cpuinfo") as f:
+                info = f.read()
+            # x86 exposes 'model name'; ARM exposes 'CPU part'/'Features'
+            # instead — hash whichever identifying lines exist
+            model = "".join(
+                ln for ln in info.splitlines()
+                if ln.startswith(("model name", "CPU part", "Features",
+                                  "flags")))[:2048]
+        except OSError:
+            model = ""
+        key = hashlib.md5(
+            (platform.machine() + model).encode()).hexdigest()[:8]
+        d = f"/tmp/arroyo_jax_cache_{key}"
     try:
         jax.config.update("jax_compilation_cache_dir", d)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)

@@ -987,3 +987,37 @@ def test_avro_logical_types_and_framing_guard():
     plain = f.serialize(rows)
     if plain[0][0] != 0:  # only meaningful when no accidental magic byte
         assert fc.deserialize(plain) == rows
+
+
+def test_kinesis_shardless_subtask_does_not_stall_watermark(request):
+    """parallelism > shards: the shardless subtask declares itself IDLE so
+    windows still fire from the active subtask's data (reviewer-found
+    stall; the reference broadcasts Watermark::Idle the same way)."""
+    from arroyo_tpu.connectors.kinesis import (
+        register_test_client,
+        unregister_test_client,
+    )
+    from arroyo_tpu.graph.logical import AggKind, AggSpec
+
+    fake = FakeKinesis(shards=1)
+    # timestamps spread over 3s so a 1s tumbling window closes in-stream
+    for i in range(30):
+        fake.seed("idlestream", 0, [{"i": i, "ts": i * 100_000}])
+    register_test_client("idlestream", fake)
+    request.addfinalizer(lambda: unregister_test_client("idlestream"))
+    clear_sink("idle-out")
+
+    prog = (Stream.source("kinesis", {"stream_name": "idlestream",
+                                      "batch_size": 8, "max_messages": 30},
+                          parallelism=2)
+            .udf(lambda c: {**c, "__timestamp": c["ts"]}, name="evt")
+            .watermark(max_lateness_micros=0)
+            .key_by("i")
+            .tumbling_aggregate(1_000_000,
+                                [AggSpec(AggKind.COUNT, None, "cnt")],
+                                parallelism=1)
+            .sink("memory", {"name": "idle-out"}))
+    LocalRunner(prog).run()
+    total = sum(int(c) for b in sink_output("idle-out")
+                for c in b.columns["cnt"].tolist())
+    assert total == 30  # every record aggregated; no watermark deadlock

@@ -138,3 +138,43 @@ def test_watermarks_propagate():
     )
     LocalRunner(prog).run()
     assert len(collect_rows("t5")) == 100
+
+
+def test_pipeline_determinism_across_runs():
+    """SURVEY §5: in place of the reference's (absent) race detection, the
+    build leans on determinism — the same pipeline over the same input
+    must produce bit-identical float aggregates run after run."""
+    import numpy as np
+
+    from arroyo_tpu import Batch, Stream
+    from arroyo_tpu.connectors.memory import clear_sink, sink_output
+    from arroyo_tpu.engine.engine import LocalRunner
+    from arroyo_tpu.graph.logical import AggKind, AggSpec
+
+    rng = np.random.default_rng(3)
+    n = 20_000
+    ts = np.sort(rng.integers(0, 3_000_000, n)).astype(np.int64)
+    src = Batch(ts, {"k": rng.integers(0, 64, n).astype(np.int64),
+                     "v": rng.random(n)})
+
+    def run_once():
+        clear_sink("det")
+        prog = (Stream.source("memory", {"batches": [src]})
+                .watermark(max_lateness_micros=0)
+                .key_by("k")
+                .sliding_aggregate(1_000_000, 250_000, [
+                    AggSpec(AggKind.SUM, "v", "s"),
+                    AggSpec(AggKind.AVG, "v", "a"),
+                    AggSpec(AggKind.MIN, "v", "lo"),
+                    AggSpec(AggKind.MAX, "v", "hi"),
+                ])
+                .sink("memory", {"name": "det"}))
+        LocalRunner(prog).run()
+        out = Batch.concat(sink_output("det"))
+        order = np.lexsort((out.columns["window_end"],
+                            np.asarray(out.key_hash, dtype=np.uint64)))
+        return {c: out.columns[c][order] for c in ("s", "a", "lo", "hi")}
+
+    a, b = run_once(), run_once()
+    for c in a:
+        np.testing.assert_array_equal(a[c], b[c])  # BIT-identical

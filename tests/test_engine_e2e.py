@@ -144,11 +144,6 @@ def test_pipeline_determinism_across_runs():
     """SURVEY §5: in place of the reference's (absent) race detection, the
     build leans on determinism — the same pipeline over the same input
     must produce bit-identical float aggregates run after run."""
-    import numpy as np
-
-    from arroyo_tpu import Batch, Stream
-    from arroyo_tpu.connectors.memory import clear_sink, sink_output
-    from arroyo_tpu.engine.engine import LocalRunner
     from arroyo_tpu.graph.logical import AggKind, AggSpec
 
     rng = np.random.default_rng(3)

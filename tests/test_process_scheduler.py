@@ -85,3 +85,68 @@ def test_process_scheduler_stop_kills_workers(tmp_path):
         await ctrl.stop()
 
     asyncio.run(scenario())
+
+
+def test_worker_kill_mid_run_recovers_exactly_once(tmp_path, monkeypatch):
+    """Fault injection the reference lacks: SIGKILL a real worker process
+    mid-stream; the controller must detect the dead worker, restart the
+    job from the last checkpoint, and the output must be exactly-once."""
+    import os
+    import signal
+
+    monkeypatch.setenv("HEARTBEAT_INTERVAL_SECS", "0.3")
+    monkeypatch.setenv("HEARTBEAT_TIMEOUT_SECS", "2.0")
+    monkeypatch.setenv("CHECKPOINT_INTERVAL_SECS", "0.5")
+    from arroyo_tpu.config import reset_config
+
+    reset_config()
+    out_path = tmp_path / "out.jsonl"
+    N = 40_000
+
+    async def scenario():
+        sched = ProcessScheduler()
+        ctrl = ControllerServer(sched)
+        await ctrl.start()
+        prog = (
+            Stream.source("impulse", {"event_rate": 8000.0,
+                                      "message_count": N,
+                                      "event_time_interval_micros": 1000,
+                                      "batch_size": 256})
+            .watermark(max_lateness_micros=0)
+            .map(lambda c: {"counter": c["counter"],
+                            "bucket": c["counter"] % 5}, name="b")
+            .key_by("bucket")
+            .tumbling_aggregate(
+                500 * 1000, [AggSpec(AggKind.COUNT, None, "cnt")])
+            .sink("single_file", {"path": str(out_path)})
+        )
+        job_id = await ctrl.submit_job(
+            prog, checkpoint_url=f"file://{tmp_path}/ckpt", n_workers=1)
+        try:
+            # wait until at least one checkpoint has completed
+            for _ in range(600):
+                if (ctrl.jobs[job_id].last_successful_epoch or 0) >= 1:
+                    break
+                await asyncio.sleep(0.05)
+            assert (ctrl.jobs[job_id].last_successful_epoch or 0) >= 1
+
+            # SIGKILL the worker process, mid-stream
+            [pid_s] = sched.workers_for_job(job_id)
+            os.kill(int(pid_s.split("-", 1)[1]), signal.SIGKILL)
+
+            state = await ctrl.wait_for_state(job_id, JobState.FINISHED,
+                                              timeout=120)
+        finally:
+            await sched.stop_workers(job_id)
+            await ctrl.stop()
+        return state
+
+    try:
+        state = asyncio.run(scenario())
+    finally:
+        # drop the cached fast-heartbeat config so later tests re-read the
+        # (restored) env
+        reset_config()
+    assert state == JobState.FINISHED
+    rows = [json.loads(line) for line in open(out_path)]
+    assert sum(r["cnt"] for r in rows) == N  # exactly-once across the kill

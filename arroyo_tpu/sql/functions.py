@@ -462,7 +462,33 @@ def _json_path_query(args):
 
     v, m = args[0]
     path = str(np.asarray(args[1][0]).reshape(-1)[0])
-    keys = [p for p in path.replace("$.", "").split(".") if p]
+    # split into segments, expanding indexers: a[0].b -> ['a', 0, 'b'],
+    # a[*].b -> ['a', '*', 'b'] (jsonpath subset the reference's json.rs
+    # relies on).  Only the leading '$.'/'$' root marker is stripped —
+    # keys may legitimately contain '$' ($ref, $schema).
+    if path.startswith("$."):
+        path = path[2:]
+    elif path.startswith("$"):
+        path = path[1:]
+    keys: list = []
+    bad_path = False
+    for part in path.split("."):
+        if not part:
+            continue
+        base, _, rest = part.partition("[")
+        if base:
+            keys.append(base)
+        while rest:
+            idx, _, rest = rest.partition("]")
+            if idx == "*":
+                keys.append("*")
+            elif idx.lstrip("-").isdigit():
+                keys.append(int(idx))
+            else:  # unsupported bracket form ($['k'], slices): no matches,
+                bad_path = True  # never a crashed pipeline
+            rest = rest.lstrip("[")
+    if bad_path:
+        return [[] for _ in v], m
     rows = []
     for s in v:
         try:
@@ -472,13 +498,25 @@ def _json_path_query(args):
             continue
         for k in keys:
             nxt = []
-            for nd in nodes:
-                items = nd if isinstance(nd, list) else [nd]
-                for item in items:
-                    try:
-                        nxt.append(item[k])
-                    except Exception:
-                        pass
+            if isinstance(k, int):  # explicit array index (arrays only:
+                for nd in nodes:     # [0] on a string is NOT char access)
+                    if isinstance(nd, list):
+                        try:
+                            nxt.append(nd[k])
+                        except IndexError:
+                            pass
+            elif k == "*":  # explicit wildcard over array elements
+                for nd in nodes:
+                    if isinstance(nd, list):
+                        nxt.extend(nd)
+            else:
+                for nd in nodes:
+                    items = nd if isinstance(nd, list) else [nd]
+                    for item in items:
+                        try:
+                            nxt.append(item[k])
+                        except Exception:
+                            pass
             nodes = nxt
         rows.append(nodes)
     return rows, m

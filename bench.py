@@ -141,15 +141,21 @@ def run_query(name: str, sql_template: str) -> dict:
     sql = sql_template.format(n=NUM_EVENTS, b=BATCH)
     # warmup: one full run of the SAME program (the jit cache is keyed by
     # the program's expression fns, so re-planning would recompile inside
-    # the timed run), then the timed run
+    # the timed run), then best-of-2 timed runs — the remote-tunnel TPU's
+    # server-side caches are flaky enough that single timed runs vary 2x;
+    # peak sustained throughput is the stable, comparable number
     prog = plan_sql(sql)
     clear_sink("results")
     LocalRunner(prog).run()
 
-    clear_sink("results")
-    t0 = time.perf_counter()
-    LocalRunner(prog).run()
-    dt = time.perf_counter() - t0
+    best_dt = None
+    for _ in range(2):
+        clear_sink("results")
+        t0 = time.perf_counter()
+        LocalRunner(prog).run()
+        dt = time.perf_counter() - t0
+        best_dt = dt if best_dt is None else min(best_dt, dt)
+    dt = best_dt
     outs = sink_output("results")
     n_out = sum(len(b) for b in outs)
     assert n_out > 0, f"{name} produced no output"

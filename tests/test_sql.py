@@ -925,3 +925,41 @@ def test_union_leading_order_by_rejected():
     with pytest.raises(Exception, match="subquery"):
         run_sql("""SELECT k FROM events ORDER BY k LIMIT 3
                    UNION ALL SELECT k FROM events""", p)
+
+
+def test_json_path_indexers():
+    """jsonpath array indexers: [n] and [*] segments (json.rs parity)."""
+    import numpy as np
+
+    from arroyo_tpu.sql.functions import HOST_FUNCTIONS
+
+    gj = HOST_FUNCTIONS["get_json_objects"]
+    v = np.array(['{"a": [{"b": 1}, {"b": 2}], "c": [10, 20]}'], dtype=object)
+    assert list(gj([(v, None), ("$.a[*].b", None)])[0][0]) == ["1", "2"]
+    assert list(gj([(v, None), ("$.a[0].b", None)])[0][0]) == ["1"]
+    assert list(gj([(v, None), ("$.a[1].b", None)])[0][0]) == ["2"]
+    assert list(gj([(v, None), ("$.c[1]", None)])[0][0]) == ["20"]
+    assert list(gj([(v, None), ("$.a[5].b", None)])[0][0]) == []
+
+    first = HOST_FUNCTIONS["get_first_json_object"]
+    out, _ = first([(v, None), ("$.a[1]", None)])
+    assert "2" in out[0]
+
+
+def test_json_path_indexer_edge_cases():
+    """Reviewer-reproduced: bad bracket forms yield no matches (never a
+    crash), '$'-containing keys survive, [n] never indexes strings."""
+    import numpy as np
+
+    from arroyo_tpu.sql.functions import HOST_FUNCTIONS
+
+    gj = HOST_FUNCTIONS["get_json_objects"]
+    ref = np.array(['{"a": {"$ref": 7}}'], dtype=object)
+    assert list(gj([(ref, None), ("$.a.$ref", None)])[0][0]) == ["7"]
+
+    s = np.array(['{"c": "hello"}'], dtype=object)
+    assert list(gj([(s, None), ("$.c[0]", None)])[0][0]) == []
+
+    for bad in ("$['c']", "$.a[1:3]", "$.a[]"):
+        out, _ = gj([(s, None), (bad, None)])
+        assert list(out[0]) == []  # no match, no exception

@@ -482,10 +482,13 @@ def _json_path_query(args):
             idx, _, rest = rest.partition("]")
             if idx == "*":
                 keys.append("*")
-            elif idx.lstrip("-").isdigit():
-                keys.append(int(idx))
-            else:  # unsupported bracket form ($['k'], slices): no matches,
-                bad_path = True  # never a crashed pipeline
+            else:
+                try:
+                    keys.append(int(idx))
+                except ValueError:
+                    # unsupported bracket form ($['k'], slices, '--1'):
+                    # no matches, never a crashed pipeline
+                    bad_path = True
             rest = rest.lstrip("[")
     if bad_path:
         return [[] for _ in v], m

@@ -71,7 +71,8 @@ def compile_program(program) -> CompileReport:
     return report
 
 
-def enable_persistent_cache(cache_dir: Optional[str] = None) -> str:
+def enable_persistent_cache(cache_dir: Optional[str] = None,
+                            suffix: Optional[str] = None) -> str:
     """Point jax at an on-disk compilation cache (idempotent).  Returns
     the directory in use.
 
@@ -101,6 +102,11 @@ def enable_persistent_cache(cache_dir: Optional[str] = None) -> str:
         key = hashlib.md5(
             (platform.machine() + model).encode()).hexdigest()[:8]
         d = f"/tmp/arroyo_jax_cache_{key}"
+        if suffix:
+            # XLA:CPU AOT blobs also embed target OPTIONS (e.g.
+            # prefer-no-gather under a TPU-tunnel session) — segregate by
+            # resolved backend so flag contexts never share blobs
+            d += f"_{suffix}"
     try:
         jax.config.update("jax_compilation_cache_dir", d)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)

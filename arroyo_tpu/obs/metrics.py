@@ -100,3 +100,25 @@ def snapshot(name_prefix: str = "arroyo_worker_") -> Dict[str, float]:
                 s.labels.items()))
             out[f"{s.name}{{{labels}}}"] = s.value
     return out
+
+
+TABLE_SIZE = "arroyo_worker_table_size_keys"
+# the reference's labels plus job_id: without it, same-named operators of
+# different jobs sharing a process registry would clobber each other
+TABLE_LABELS = ("job_id", "operator_id", "task_id", "table_char")
+_table_gauge: Optional[Gauge] = None
+
+
+def table_size_gauge(task_info, table_char: str) -> Gauge:
+    """Per-table key-count gauge (arroyo-state/src/metrics.rs
+    TABLE_SIZE_GAUGE: name + labels match the reference exactly)."""
+    global _table_gauge
+    with _lock:
+        if _table_gauge is None:
+            _table_gauge = Gauge(TABLE_SIZE, "Number of keys in the table",
+                                 TABLE_LABELS, registry=REGISTRY)
+    return _table_gauge.labels(
+        job_id=task_info.job_id,
+        operator_id=task_info.operator_id,
+        task_id=str(task_info.task_index),
+        table_char=table_char)

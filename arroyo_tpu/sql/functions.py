@@ -482,13 +482,12 @@ def _json_path_query(args):
             idx, _, rest = rest.partition("]")
             if idx == "*":
                 keys.append("*")
+            elif re.fullmatch(r"-?\d+", idx):
+                keys.append(int(idx))
             else:
-                try:
-                    keys.append(int(idx))
-                except ValueError:
-                    # unsupported bracket form ($['k'], slices, '--1'):
-                    # no matches, never a crashed pipeline
-                    bad_path = True
+                # unsupported bracket form ($['k'], slices, '--1', '+1',
+                # '1_0'): no matches, never a crashed pipeline
+                bad_path = True
             rest = rest.lstrip("[")
     if bad_path:
         return [[] for _ in v], m

@@ -125,6 +125,27 @@ class StateStore:
             return None
         return self.backend.restore_watermark(self.task_info, self.restore_epoch)
 
+    def _update_size_gauges(self) -> None:
+        """Per-table key-count gauges, refreshed at each barrier — the
+        reference's arroyo_worker_table_size_keys with (operator_id,
+        task_id, table_char) labels (arroyo-state/src/metrics.rs)."""
+        try:
+            from ..obs.metrics import table_size_gauge
+        except Exception:  # metrics optional in embedded contexts
+            return
+        for name, table in self.tables.items():
+            try:
+                if hasattr(table, "n_keys"):  # KEY count, not entry count
+                    size = table.n_keys()
+                elif hasattr(table, "__len__"):
+                    size = len(table)
+                else:
+                    size = None
+            except TypeError:
+                size = None
+            if size is not None:
+                table_size_gauge(self.task_info, name).set(size)
+
     # -- checkpoint --------------------------------------------------------
 
     def checkpoint(self, epoch: int,
@@ -144,6 +165,7 @@ class StateStore:
                     desc, entries=table.snapshot(),
                     deletes=self._pending_deletes.get(name))
         self._pending_deletes.clear()
+        self._update_size_gauges()
         meta = self.backend.write_subtask_checkpoint(
             self.task_info, epoch, snaps, watermark)
         # Tables with CommitWrites behavior surface their snapshot to the

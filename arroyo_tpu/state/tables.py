@@ -173,6 +173,11 @@ class KeyTimeMultiMap:
     def __len__(self) -> int:
         return sum(len(vs) for bt in self._data.values() for vs in bt.values())
 
+    def n_keys(self) -> int:
+        """Distinct-key count (the size the reference's table gauge
+        reports, key_time_multi_map.rs)."""
+        return len({k for bt in self._data.values() for k in bt})
+
 
 class GlobalKeyedState:
     """kv state visible across all subtasks — used for source offsets

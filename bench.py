@@ -299,7 +299,9 @@ def main_child() -> None:
     # cost across bench invocations
     from arroyo_tpu.engine.aot import enable_persistent_cache
 
-    enable_persistent_cache()
+    enable_persistent_cache(
+        suffix="cpu" if os.environ.get("JAX_PLATFORMS", "") == "cpu"
+        else "acc")
 
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         # the axon sitecustomize plugin imports jax at interpreter start

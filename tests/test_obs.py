@@ -169,3 +169,46 @@ def test_tx_queue_gauges_wired():
             if k.startswith("arroyo_worker_tx_queue_rem")}
     assert any(v > 0 for v in sizes.values()), sizes
     assert any(v > 0 for v in rems.values()), rems
+
+
+def test_table_size_gauge_updates_at_checkpoint(tmp_path):
+    """arroyo_worker_table_size_keys (the reference's per-table state-size
+    gauge) reflects key counts after a checkpoint barrier."""
+    import asyncio
+
+    from arroyo_tpu import Stream
+    from arroyo_tpu.engine.engine import Engine
+    from arroyo_tpu.graph.logical import AggKind, AggSpec
+    from arroyo_tpu.obs.metrics import snapshot
+    from arroyo_tpu.types import StopMode
+
+    prog = (Stream.source("impulse", {"event_rate": 50_000.0,
+                                      "message_count": 50_000,
+                                      "event_time_interval_micros": 1000,
+                                      "batch_size": 512})
+            .watermark(max_lateness_micros=0)
+            .map(lambda c: {"counter": c["counter"],
+                            "bucket": c["counter"] % 9}, name="b")
+            .key_by("bucket")
+            .tumbling_aggregate(1_000_000,
+                                [AggSpec(AggKind.COUNT, None, "cnt")])
+            .sink("blackhole", {}))
+
+    async def run():
+        eng = Engine.for_local(prog, "gauge-job",
+                               checkpoint_url=f"file://{tmp_path}/ck")
+        running = eng.start()
+        await asyncio.sleep(0.1)
+        await running.checkpoint(1)
+        assert await running.wait_for_checkpoint(1)
+        vals = snapshot("arroyo_worker_table_size_keys")
+        await running.stop(StopMode.IMMEDIATE)
+        try:
+            await running.join()
+        except RuntimeError:
+            pass
+        return vals
+
+    vals = asyncio.run(run())
+    assert vals, "no table-size gauges recorded"
+    assert any(v > 0 for v in vals.values())

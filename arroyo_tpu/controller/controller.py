@@ -167,20 +167,28 @@ class ControllerServer:
         """Rescaling path (states/rescaling.rs): checkpoint-stop, update
         parallelism, reschedule with state re-sharded by key range."""
         job = self.jobs[job_id]
+        # worker count from the controller's own registry, BEFORE the
+        # stop: schedulers' live listings are empty once workers exit
+        n_workers = max(len(job.workers), 1)
         job.fsm.transition(JobState.RESCALING)
         await self._trigger_checkpoint(job, then_stop=True)
-        await self._await_workers_finished(job, timeout=30)
+        if not await self._await_workers_finished(job, timeout=30):
+            # the stop-checkpoint did not complete: DON'T restore from an
+            # older epoch with the new topology (rewound sources would
+            # duplicate output past the restore point) — abort the rescale
+            # and recover the job at its CURRENT parallelism
+            logger.warning("rescale of %s aborted: stop-checkpoint "
+                           "incomplete", job_id)
+            if job.fsm.try_recover("rescale stop-checkpoint incomplete"):
+                await self._restart_workers(job, n_workers, force_stop=True)
+            raise TimeoutError(
+                f"rescale of {job_id} aborted (stop-checkpoint incomplete); "
+                "job recovered at its previous parallelism")
+        # fresh workers sized for the NEW parallelism (the old ones were
+        # checkpoint-stopped above); restore re-shards state by key range
         job.program.update_parallelism(overrides)
         job.n_subtasks = sum(n.parallelism for n in job.program.nodes())
-        job.workers.clear()
-        job.finished_tasks.clear()
-        job.fsm.transition(JobState.SCHEDULING)
-        # workers_for_job can do blocking IO (the k8s scheduler lists
-        # pods) — keep it off the controller's event loop
-        prev = await asyncio.get_event_loop().run_in_executor(
-            None, self.scheduler.workers_for_job, job_id)
-        await self._schedule(job, n_workers=len(prev) or 1, restore=True)
-        job.fsm.transition(JobState.RUNNING)
+        await self._restart_workers(job, n_workers, force_stop=False)
 
     def job_state(self, job_id: str) -> JobState:
         return self.jobs[job_id].fsm.state
@@ -311,6 +319,11 @@ class ControllerServer:
                 elif state in (JobState.CHECKPOINT_STOPPING,
                                JobState.STOPPING):
                     job.fsm.transition(JobState.STOPPED)
+                elif state in (JobState.RESCALING, JobState.SCHEDULING):
+                    # mid-rescale: the OLD workers drained; keep
+                    # supervising — fresh workers are about to register
+                    # (returning here orphaned post-rescale jobs)
+                    continue
                 return
             if state != JobState.RUNNING:
                 continue
@@ -337,7 +350,13 @@ class ControllerServer:
         n_workers = max(len(job.workers), 1)
         await self._broadcast_workers(job, "StopExecution", {
             "job_id": job.job_id, "stop_mode": "immediate"}, ignore_errors=True)
-        await self.scheduler.stop_workers(job.job_id, force=True)
+        await self._restart_workers(job, n_workers, force_stop=True)
+
+    async def _restart_workers(self, job: Job, n_workers: int,
+                               force_stop: bool) -> None:
+        """Shared stop -> clear -> Scheduling -> start -> schedule -> Running
+        tail of recovery and rescale (single source for slot sizing)."""
+        await self.scheduler.stop_workers(job.job_id, force=force_stop)
         job.workers.clear()
         job.finished_tasks.clear()
         job.trackers.clear()
@@ -397,12 +416,14 @@ class ControllerServer:
                 logger.debug("broadcast %s to %s failed: %s", method,
                              w.worker_id, e)
 
-    async def _await_workers_finished(self, job: Job, timeout: float) -> None:
+    async def _await_workers_finished(self, job: Job,
+                                      timeout: float) -> bool:
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
             if all(w.finished for w in job.workers.values()):
-                return
+                return True
             await asyncio.sleep(0.05)
+        return False
 
     # -- ControllerGrpc handlers ------------------------------------------
 

@@ -47,7 +47,8 @@ VALID_TRANSITIONS = {
                                    JobState.FAILED},
     JobState.STOPPING: {JobState.STOPPED, JobState.FAILED},
     JobState.RECOVERING: {JobState.SCHEDULING, JobState.FAILED},
-    JobState.RESCALING: {JobState.SCHEDULING, JobState.FAILED},
+    JobState.RESCALING: {JobState.SCHEDULING, JobState.RECOVERING,
+                         JobState.FAILED},
     JobState.FINISHING: {JobState.FINISHED, JobState.FAILED},
 }
 

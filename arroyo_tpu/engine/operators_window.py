@@ -166,10 +166,14 @@ class BinAggOperator(Operator):
         return []  # registered as a device table in on_start
 
     async def on_start(self, ctx: Context) -> None:
+        from ..ops.keyed_bins import filter_canonical_snapshot
+
         def snap():
             return self.state.snapshot() | self.keyvals.snapshot()
 
-        def restore(arrays):
+        def restore(arrays, _kr=ctx.task_info.key_range):
+            # rescale re-partitioning: keep only the keys this subtask owns
+            arrays = filter_canonical_snapshot(arrays, _kr)
             self.state.restore(arrays)
             self.keyvals.restore(arrays)
 

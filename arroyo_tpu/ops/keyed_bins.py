@@ -631,3 +631,136 @@ class KeyedBinState:
             counts[slots[:, None], cols[None, :]] = bin_counts
         self.values = jnp.asarray(values)
         self.counts = jnp.asarray(counts)
+
+
+def filter_canonical_snapshot(arrays: Dict[str, np.ndarray],
+                              key_range: Tuple[int, int]
+                              ) -> Dict[str, np.ndarray]:
+    """Restrict a canonical bin-state snapshot (snapshot()/restore()
+    format, incl. the operator's kv_* key-column arrays) to the keys a
+    subtask OWNS under its key range.
+
+    Restore-time re-partitioning (parquet.rs:194-218 analog): on a
+    rescale every new subtask reads the full device-table snapshot, and
+    without this filter each would hold (and re-fire panes for) every
+    key — duplicate output.  Entry/batch tables are range-filtered in the
+    backend; the canonical array format is filtered here where its slot
+    relationships are understood."""
+    lo, hi = np.uint64(key_range[0]), np.uint64(key_range[1])
+    slot_to_key = arrays["slot_to_key"].astype(np.uint64)
+    n_old = len(slot_to_key)
+    own_slot = (slot_to_key >= lo) & (slot_to_key <= hi)
+    if own_slot.all():
+        return arrays  # 1:1 restore: nothing to drop
+    old_slots = own_slot.nonzero()[0]  # kept keys, old slot order
+    kept_keys = slot_to_key[old_slots]
+
+    out = dict(arrays)
+    out["slot_to_key"] = kept_keys
+    order = np.argsort(kept_keys, kind="stable")
+    out["key_sorted"] = kept_keys[order]
+    # new slots are positions in old-slot order
+    out["slot_of_sorted"] = np.arange(len(kept_keys), dtype=np.int64)[order]
+
+    bin_keys = arrays["bin_keys"].astype(np.uint64)
+    own_row = (bin_keys >= lo) & (bin_keys <= hi)
+    out["bin_keys"] = bin_keys[own_row]
+    out["bin_vals"] = arrays["bin_vals"][:, own_row]
+    out["bin_counts"] = arrays["bin_counts"][own_row]
+
+    meta = arrays["meta"].copy()
+    meta[0] = len(kept_keys)
+    out["meta"] = meta
+
+    # operator key-column values are indexed by OLD slot: gather into the
+    # new slot order
+    for name, arr in arrays.items():
+        if name.startswith("kv_") and name != "kv_size":
+            if len(arr) >= n_old:
+                out[name] = arr[old_slots]
+            else:  # short kv array (sized to kv_size): clip indices
+                sel = old_slots[old_slots < len(arr)]
+                out[name] = arr[sel]
+    if "kv_size" in arrays:
+        out["kv_size"] = np.array([len(kept_keys)])
+    return out
+
+
+def merge_canonical_snapshots(a: Dict[str, np.ndarray],
+                              b: Dict[str, np.ndarray]
+                              ) -> Dict[str, np.ndarray]:
+    """Merge two canonical bin-state snapshots from DIFFERENT parent
+    subtasks (disjoint key ranges) into one, for restore-time
+    re-partitioning (a rescale N->M reads every parent overlapping the
+    new range; parquet.rs:194-218).  A naive dict merge would keep only
+    one parent's arrays — silent state loss."""
+    if not a:
+        return b
+    if not b:
+        return a
+    am, bm = a["meta"], b["meta"]
+    if am[0] == 0:
+        return b
+    if bm[0] == 0:
+        return a
+
+    # unified linear-column span over absolute bins [lo, hi]
+    spans = []
+    for arrs, m in ((a, am), (b, bm)):
+        lo = int(m[1])
+        span = arrs["bin_vals"].shape[-1]
+        spans.append((lo, span))
+    los = [lo for lo, s in spans if lo >= 0]
+    his = [lo + s - 1 for lo, s in spans if lo >= 0]
+    lo_u = min(los) if los else -1
+    hi_u = max(his) if his else -1
+    width = (hi_u - lo_u + 1) if lo_u >= 0 else 0
+
+    n_ch = a["bin_vals"].shape[0]
+    parts_keys, parts_vals, parts_counts = [], [], []
+    kv_parts: Dict[str, List[np.ndarray]] = {}
+    slot_parts: List[np.ndarray] = []
+    for arrs, (lo, span) in ((a, spans[0]), (b, spans[1])):
+        keys = arrs["bin_keys"].astype(np.uint64)
+        vals = np.asarray(arrs["bin_vals"], dtype=np.float32)
+        counts = np.asarray(arrs["bin_counts"])
+        if width and len(keys):
+            pv = np.zeros((n_ch, len(keys), width), np.float32)
+            pc = np.zeros((len(keys), width), counts.dtype)
+            if lo >= 0 and span:
+                off = lo - lo_u
+                pv[:, :, off:off + span] = vals
+                pc[:, off:off + span] = counts
+            vals, counts = pv, pc
+        parts_keys.append(keys)
+        parts_vals.append(vals)
+        parts_counts.append(counts)
+        slot_parts.append(arrs["slot_to_key"].astype(np.uint64))
+        for k, v in arrs.items():
+            if k.startswith("kv_") and k != "kv_size":
+                kv_parts.setdefault(k, []).append(
+                    v[:int(arrs["meta"][0])] if len(v) >= int(arrs["meta"][0])
+                    else v)
+
+    out: Dict[str, np.ndarray] = {}
+    out["bin_keys"] = np.concatenate(parts_keys)
+    out["bin_vals"] = (np.concatenate(parts_vals, axis=1) if width else
+                       a["bin_vals"][:, :0])
+    out["bin_counts"] = (np.concatenate(parts_counts, axis=0) if width else
+                         a["bin_counts"][:0])
+    slot_to_key = np.concatenate(slot_parts)
+    out["slot_to_key"] = slot_to_key
+    order = np.argsort(slot_to_key, kind="stable")
+    out["key_sorted"] = slot_to_key[order]
+    out["slot_of_sorted"] = np.arange(len(slot_to_key), dtype=np.int64)[order]
+    for k, vs in kv_parts.items():
+        out[k] = np.concatenate(vs) if len(vs) > 1 else vs[0]
+    out["kv_size"] = np.array([len(slot_to_key)])
+    # panes fired under the SAME aligned barrier: parents agree; max is
+    # the safe choice if they ever differ (never re-fire an emitted pane)
+    out["meta"] = np.array([
+        len(slot_to_key), lo_u,
+        max(int(am[2]), int(bm[2])),
+        max(int(am[3]), int(bm[3])),
+    ], dtype=np.int64)
+    return out

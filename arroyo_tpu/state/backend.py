@@ -466,7 +466,10 @@ class ParquetBackend(BackingStore):
                         merged.batch = (s.batch if merged.batch is None
                                         else Batch.concat([merged.batch, s.batch]))
                     if s.arrays:
-                        merged.arrays = {**(merged.arrays or {}), **s.arrays}
+                        from ..ops.keyed_bins import merge_canonical_snapshots
+
+                        merged.arrays = merge_canonical_snapshots(
+                            merged.arrays or {}, s.arrays)
                 out[name] = merged
         return out
 
@@ -545,7 +548,10 @@ class InMemoryBackend(BackingStore):
                         acc.batch = (snap.batch if acc.batch is None
                                      else Batch.concat([acc.batch, snap.batch]))
                     if snap.arrays:
-                        acc.arrays = {**(acc.arrays or {}), **snap.arrays}
+                        from ..ops.keyed_bins import merge_canonical_snapshots
+
+                        acc.arrays = merge_canonical_snapshots(
+                            acc.arrays or {}, snap.arrays)
         return out
 
     def restore_watermark(self, task, epoch):

@@ -125,7 +125,7 @@ class StateStore:
             return None
         return self.backend.restore_watermark(self.task_info, self.restore_epoch)
 
-    def _update_size_gauges(self) -> None:
+    def _update_size_gauges(self, snaps: Dict[str, "TableSnapshot"]) -> None:
         """Per-table key-count gauges, refreshed at each barrier — the
         reference's arroyo_worker_table_size_keys with (operator_id,
         task_id, table_char) labels (arroyo-state/src/metrics.rs)."""
@@ -135,13 +135,20 @@ class StateStore:
             return
         for name, table in self.tables.items():
             try:
-                if hasattr(table, "n_keys"):  # KEY count, not entry count
+                if isinstance(table, DeviceTable):
+                    # key count from the canonical snapshot just taken
+                    # (meta[0] = occupied key slots)
+                    arrays = (snaps.get(name).arrays
+                              if snaps.get(name) else None) or {}
+                    meta = arrays.get("meta")
+                    size = int(meta[0]) if meta is not None else None
+                elif hasattr(table, "n_keys"):  # KEY count, not entries
                     size = table.n_keys()
                 elif hasattr(table, "__len__"):
                     size = len(table)
                 else:
                     size = None
-            except TypeError:
+            except (TypeError, IndexError):
                 size = None
             if size is not None:
                 table_size_gauge(self.task_info, name).set(size)
@@ -165,7 +172,7 @@ class StateStore:
                     desc, entries=table.snapshot(),
                     deletes=self._pending_deletes.get(name))
         self._pending_deletes.clear()
-        self._update_size_gauges()
+        self._update_size_gauges(snaps)
         meta = self.backend.write_subtask_checkpoint(
             self.task_info, epoch, snaps, watermark)
         # Tables with CommitWrites behavior surface their snapshot to the

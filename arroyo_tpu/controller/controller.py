@@ -172,7 +172,13 @@ class ControllerServer:
         n_workers = max(len(job.workers), 1)
         job.fsm.transition(JobState.RESCALING)
         await self._trigger_checkpoint(job, then_stop=True)
-        if not await self._await_workers_finished(job, timeout=30):
+        stop_ok = await self._await_workers_finished(job, timeout=30)
+        # the stop must ALSO have produced a completed checkpoint at the
+        # stop epoch: a broadcast-failure fallback (plain graceful stop)
+        # or a finished-before-finalize race would otherwise restore an
+        # OLDER epoch under the new topology -> duplicate output
+        stop_ok = stop_ok and job.last_successful_epoch == job.epoch
+        if not stop_ok:
             # the stop-checkpoint did not complete: DON'T restore from an
             # older epoch with the new topology (rewound sources would
             # duplicate output past the restore point) — abort the rescale
@@ -319,8 +325,9 @@ class ControllerServer:
                 elif state in (JobState.CHECKPOINT_STOPPING,
                                JobState.STOPPING):
                     job.fsm.transition(JobState.STOPPED)
-                elif state in (JobState.RESCALING, JobState.SCHEDULING):
-                    # mid-rescale: the OLD workers drained; keep
+                elif state in (JobState.RESCALING, JobState.SCHEDULING,
+                               JobState.RECOVERING):
+                    # mid-rescale/recovery: the OLD workers drained; keep
                     # supervising — fresh workers are about to register
                     # (returning here orphaned post-rescale jobs)
                     continue

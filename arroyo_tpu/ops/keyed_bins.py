@@ -676,11 +676,14 @@ def filter_canonical_snapshot(arrays: Dict[str, np.ndarray],
     # new slot order
     for name, arr in arrays.items():
         if name.startswith("kv_") and name != "kv_size":
-            if len(arr) >= n_old:
-                out[name] = arr[old_slots]
-            else:  # short kv array (sized to kv_size): clip indices
-                sel = old_slots[old_slots < len(arr)]
-                out[name] = arr[sel]
+            if len(arr) < n_old:
+                # the snapshot invariant is kv rows == occupied slots; a
+                # short array silently mis-aligned would emit WRONG key
+                # columns — fail loudly instead
+                raise ValueError(
+                    f"canonical snapshot kv array {name!r} has {len(arr)} "
+                    f"rows for {n_old} slots")
+            out[name] = arr[old_slots]
     if "kv_size" in arrays:
         out["kv_size"] = np.array([len(kept_keys)])
     return out

@@ -312,3 +312,52 @@ GROUP BY 1, tumble(interval '1 second')"""})
                           json={"stop": "immediate"})
 
     _run(loop, go())
+
+
+def test_rest_rescale_running_pipeline(api_env):
+    """PATCH /v1/pipelines/{id} with a new parallelism on a RUNNING job
+    drives the controller's live rescale (checkpoint-stop, re-shard,
+    resume) through the public API; the job still finishes cleanly."""
+    loop, controller, base = api_env
+
+    sql = """
+    CREATE TABLE impulse WITH (connector = 'impulse',
+      event_rate = '8000', message_count = '40000', batch_size = '256',
+      event_time_interval_micros = '1000');
+    SELECT counter % 5 as bucket, TUMBLE(INTERVAL '1' SECOND) as window,
+           count(*) as cnt
+    FROM impulse GROUP BY 1, 2
+    """
+
+    async def scenario():
+        async with httpx.AsyncClient(base_url=base) as c:
+            r = await c.post("/v1/pipelines",
+                             json={"name": "rescale-me", "query": sql})
+            assert r.status_code == 200, r.text
+            pl = r.json()
+            job_id = pl["jobs"][0]["id"]
+
+            # wait until Running, let it make progress
+            for _ in range(200):
+                r = await c.get("/v1/jobs")
+                job = next(j for j in r.json()["data"] if j["id"] == job_id)
+                if job["state"] == "Running":
+                    break
+                await asyncio.sleep(0.05)
+            assert job["state"] == "Running", job
+            await asyncio.sleep(0.8)
+
+            r = await c.patch(f"/v1/pipelines/{pl['id']}",
+                              json={"parallelism": 2})
+            assert r.status_code == 200, r.text
+            assert r.json()["parallelism"] == 2
+
+            for _ in range(400):
+                r = await c.get("/v1/jobs")
+                job = next(j for j in r.json()["data"] if j["id"] == job_id)
+                if job["state"] in ("Finished", "Stopped", "Failed"):
+                    break
+                await asyncio.sleep(0.1)
+            assert job["state"] == "Finished", job
+
+    _run(loop, scenario())

@@ -186,6 +186,14 @@ class Select:
 
 
 @dataclass
+class Explain:
+    """EXPLAIN <select> — emits the planned operator DAG as rows (the
+    reference bails on EXPLAIN, pipeline.rs:432)."""
+
+    query: "Select"
+
+
+@dataclass
 class ColumnDef:
     name: str
     type: str

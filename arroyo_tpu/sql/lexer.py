@@ -14,6 +14,7 @@ KEYWORDS = {
     "left", "right", "full", "outer", "cross", "on", "with", "create",
     "table", "insert", "into", "values", "distinct", "between", "like",
     "asc", "desc", "union", "all", "exists", "generated", "always",
+    "explain",
     "virtual", "stored", "primary", "key", "if", "over", "partition",
 }
 

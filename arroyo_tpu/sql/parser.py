@@ -8,6 +8,7 @@ from __future__ import annotations
 from typing import List, Optional, Tuple
 
 from .ast_nodes import (
+    Explain,
     Between,
     BinaryOp,
     Case,
@@ -115,6 +116,9 @@ class Parser:
         return stmts
 
     def parse_statement(self):
+        if self.at_kw("explain"):
+            self.next()
+            return Explain(self.parse_select())
         if self.at_kw("create"):
             return self.parse_create_table()
         if self.at_kw("insert"):

@@ -41,6 +41,7 @@ from .ast_nodes import (
     ColumnRef,
     CreateTable,
     DerivedTable,
+    Explain,
     Expr,
     FunctionCall,
     Insert,
@@ -262,6 +263,7 @@ class Planner:
         program: Optional[Program] = None
         inserts: List[Insert] = []
         selects: List[Select] = []
+        explains: List[Explain] = []
         for s in stmts:
             if isinstance(s, CreateTable):
                 self.provider.add_create_table(s)
@@ -269,9 +271,17 @@ class Planner:
                 inserts.append(s)
             elif isinstance(s, Select):
                 selects.append(s)
+            elif isinstance(s, Explain):
+                explains.append(s)
 
         self.parallelism = query_parallelism
         self._pushdowns: List[Tuple[Dict[str, Any], set]] = []
+        if explains:
+            if inserts or selects or len(explains) > 1:
+                raise SqlPlanError(
+                    "EXPLAIN must be the only executable statement in a "
+                    "script (CREATE TABLEs are fine)")
+            return self._plan_explain(explains[0])
         prog = Program()
         if inserts:
             for ins in inserts:
@@ -380,6 +390,46 @@ class Planner:
                 other.stream, name=f"union_{self._next_id()}")
             planned = Planned(merged, planned.schema.clone())
         return planned
+
+    def _plan_explain(self, ex: Explain) -> Program:
+        """EXPLAIN <select>: plan the inner query, then return a program
+        that EMITS the planned DAG as rows (operator_id, operator,
+        parallelism, inputs) — database-style, runs through any runner/
+        console.  The reference bails on EXPLAIN (pipeline.rs:432)."""
+        from ..types import Batch
+
+        inner = Program()
+        planned = self.plan_select(ex.query, inner, {})
+        # the SAME terminal a bare SELECT gets (preview sink) + the same
+        # post-planning pushdown injection, so EXPLAIN shows the plan
+        # that would actually run
+        planned.stream.sink("memory", {"name": "results"})
+        for op_cfg, used in self._pushdowns:
+            if used:
+                op_cfg["projection"] = sorted(used)
+        self._pushdowns = []
+        rows = []
+        for node_id in inner.topo_order():
+            node = inner.node(node_id)
+            preds = [inner.node(p).operator_id
+                     for p in inner.graph.predecessors(node_id)]
+            rows.append({
+                "operator_id": node.operator_id,
+                "operator": node.operator.kind.value,
+                "name": node.operator.name,
+                "parallelism": node.parallelism,
+                "inputs": ", ".join(preds),
+            })
+        cols = {k: np.array([r[k] for r in rows], dtype=object)
+                for k in ("operator_id", "operator", "name", "inputs")}
+        cols["parallelism"] = np.array(
+            [r["parallelism"] for r in rows], dtype=np.int64)
+        batch = Batch(np.zeros(len(rows), dtype=np.int64), cols)
+        prog = Program()
+        (Stream.source("memory", {"batches": [batch]}, program=prog,
+                       name="explain")
+         .sink("memory", {"name": "results"}))
+        return prog
 
     def _plan_table_ref(self, tr: TableRef, prog: Program,
                         scope: Dict[str, Planned]) -> Planned:

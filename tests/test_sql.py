@@ -963,3 +963,28 @@ def test_json_path_indexer_edge_cases():
     for bad in ("$['c']", "$.a[1:3]", "$.a[]"):
         out, _ = gj([(s, None), (bad, None)])
         assert list(out[0]) == []  # no match, no exception
+
+
+def test_explain_emits_plan_rows():
+    """EXPLAIN SELECT ... runs as a pipeline emitting the planned operator
+    DAG as rows (the reference bails on EXPLAIN, pipeline.rs:432)."""
+    p = SchemaProvider()
+    events_table(p)
+    out = run_sql("""
+      EXPLAIN SELECT k, TUMBLE(INTERVAL '2' SECOND) as window,
+                     count(*) as cnt
+      FROM events GROUP BY 1, 2
+    """, p)
+    ops = list(out.columns["operator"])
+    assert "connector_source" in ops
+    assert any("aggregator" in o or "window" in o for o in ops)
+    assert out.columns["parallelism"].dtype.kind == "i"
+    # inputs column wires the DAG
+    assert any(out.columns["inputs"][i] for i in range(len(out)))
+
+
+def test_explain_rejects_mixed_statements():
+    p = SchemaProvider()
+    events_table(p)
+    with pytest.raises(Exception, match="only executable"):
+        run_sql("SELECT k FROM events; EXPLAIN SELECT k FROM events", p)

@@ -189,6 +189,16 @@ def channel_input(aggs: Tuple[AggSpec, ...], ch_kinds: Tuple[str, ...],
     return np.where(ok, raw, np.float32(ident)).astype(np.float32)
 
 
+def channel_inits(ch_kinds: Tuple[str, ...]) -> np.ndarray:
+    """Per-channel aggregation identity values ([n_ch] f32), carried
+    inside canonical snapshots so topology-level merges can pad
+    uncovered bin spans with the right identity (+inf for MIN, -inf for
+    MAX) instead of 0 — a 0-pad makes a post-rescale MIN/MAX window
+    wrongly emit 0 for bins one parent never held."""
+    return np.array([_init_value(AggKind(k)) for k in ch_kinds],
+                    dtype=np.float32)
+
+
 def preaggregate(kh: np.ndarray, bins: np.ndarray,
                  ch_kinds: Tuple[str, ...], vals: np.ndarray
                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
@@ -583,6 +593,7 @@ class KeyedBinState:
             "bin_keys": self.slot_to_key[:n],
             "bin_vals": values[:, :n][:, :, cols],
             "bin_counts": counts[:n][:, cols],
+            "ch_init": channel_inits(self._ch_kinds),
             "key_sorted": self.key_sorted,
             "slot_of_sorted": self.slot_of_sorted,
             "slot_to_key": self.slot_to_key[:n],
@@ -720,6 +731,21 @@ def merge_canonical_snapshots(a: Dict[str, np.ndarray],
     width = (hi_u - lo_u + 1) if lo_u >= 0 else 0
 
     n_ch = a["bin_vals"].shape[0]
+    # per-channel aggregation identities: bins one parent never held must
+    # pad to +inf/-inf for MIN/MAX channels, not 0 (a 0-pad would make a
+    # merged window emit min/max == 0 for keys spanning the gap)
+    ch_init = None
+    for arrs in (a, b):
+        if "ch_init" in arrs:
+            ch_init = np.asarray(arrs["ch_init"], dtype=np.float32)
+            break
+    if ch_init is None or len(ch_init) != n_ch:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "merging bin-state snapshots without ch_init (pre-upgrade "
+            "checkpoint): MIN/MAX channels pad uncovered bins with 0")
+        ch_init = np.zeros(n_ch, dtype=np.float32)
     parts_keys, parts_vals, parts_counts = [], [], []
     kv_parts: Dict[str, List[np.ndarray]] = {}
     slot_parts: List[np.ndarray] = []
@@ -728,7 +754,8 @@ def merge_canonical_snapshots(a: Dict[str, np.ndarray],
         vals = np.asarray(arrs["bin_vals"], dtype=np.float32)
         counts = np.asarray(arrs["bin_counts"])
         if width and len(keys):
-            pv = np.zeros((n_ch, len(keys), width), np.float32)
+            pv = np.broadcast_to(ch_init[:, None, None],
+                                 (n_ch, len(keys), width)).copy()
             pc = np.zeros((len(keys), width), counts.dtype)
             if lo >= 0 and span:
                 off = lo - lo_u
@@ -759,6 +786,7 @@ def merge_canonical_snapshots(a: Dict[str, np.ndarray],
     for k, vs in kv_parts.items():
         out[k] = np.concatenate(vs) if len(vs) > 1 else vs[0]
     out["kv_size"] = np.array([len(slot_to_key)])
+    out["ch_init"] = ch_init
     # panes fired under the SAME aligned barrier: parents agree; max is
     # the safe choice if they ever differ (never re-fire an emitted pane)
     out["meta"] = np.array([

@@ -49,6 +49,7 @@ from ..ops.keyed_bins import (
     _bucket,
     _init_value,
     build_channels,
+    channel_inits,
     channel_input,
     directory_insert,
     preaggregate,
@@ -667,6 +668,7 @@ class MeshKeyedBinState:
             "bin_keys": keys[real],
             "bin_vals": bins[:, real][:, :, first:first + span],
             "bin_counts": counts[real][:, first:first + span],
+            "ch_init": channel_inits(self._ch_kinds),
             "key_sorted": self.key_sorted,
             "slot_of_sorted": self.slot_of_sorted,
             "slot_to_key": self.slot_to_key[:self.next_slot],

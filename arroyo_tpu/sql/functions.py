@@ -138,6 +138,8 @@ def _register_math():
     def nullif(args):
         (a, ma), (b, mb) = args
         eq = a == b
+        if isinstance(eq, bool):  # scalar literals: ~True is -2, not False
+            eq = np.bool_(eq)
         mask = ~eq if ma is None else (ma & ~eq)
         return a, mask
 
@@ -313,6 +315,19 @@ def _n_rows(args) -> int:
         if not isinstance(a, str) and np.ndim(a) > 0:
             return len(a)
     return 1
+
+
+def _row_is_valid(a, i) -> bool:
+    """Row i of a (value, mask) pair is non-NULL: the value is not a host
+    None AND its validity mask (device-side NULLs) allows it."""
+    v, m = a
+    if _row_get(v, i) is None:
+        return False
+    if m is None:
+        return True
+    mm = np.asarray(m)
+    return bool(mm.reshape(-1)[i] if mm.ndim and mm.shape[0] > 1 else
+                mm.reshape(-1)[0] if mm.ndim else mm)
 
 
 @host_fn("upper")
@@ -776,13 +791,16 @@ def _register_math_ext():
 
     def factorial(args):
         (v, m), = args
-        # exact in int64 up to 20!; larger n overflows int64, so clamp
-        # (the reference's DataFusion factorial is int64 with the same cap)
-        n = jnp.clip(jnp.asarray(v, jnp.int64), 0, 20)
+        # exact in int64 up to 20!; n > 20 overflows int64, so those rows
+        # become NULL (the reference's DataFusion int64 factorial errors
+        # on overflow — a masked-out row is our non-aborting analog)
+        n = jnp.asarray(v, jnp.int64)
+        ok = n <= 20
+        nc = jnp.clip(n, 0, 20)
         i = jnp.arange(1, 21, dtype=jnp.int64)
-        terms = jnp.where(i[None, :] <= n[..., None], i[None, :],
+        terms = jnp.where(i[None, :] <= nc[..., None], i[None, :],
                           jnp.int64(1))
-        return jnp.prod(terms, axis=-1), m
+        return jnp.prod(terms, axis=-1), (ok if m is None else m & ok)
 
     DEVICE_FUNCTIONS["factorial"] = factorial
 
@@ -864,10 +882,16 @@ def _btrim(args):
 @host_fn("to_hex")
 def _to_hex(args):
     (v, m), = args
+
+    def hx(x):
+        # negatives render as 64-bit two's complement ('ffffffffffffffff'
+        # for -1), matching Postgres/DataFusion — not '-<hex>'
+        return format(int(x) & 0xFFFFFFFFFFFFFFFF, "x")
+
     vals = np.asarray(v)
     if vals.ndim == 0:  # scalar literal: 0-d result broadcasts downstream
-        return np.asarray(format(int(vals), "x"), dtype=object), m
-    return _obj([format(int(x), "x") for x in vals.tolist()]), m
+        return np.asarray(hx(vals), dtype=object), m
+    return _obj([hx(x) for x in vals.tolist()]), m
 
 
 @host_fn("encode")
@@ -904,10 +928,17 @@ def _decode(args):
         if s is None:
             return None
         if fmt == "hex":
-            return bytes.fromhex(s).decode(errors="replace")
-        if fmt == "base64":
-            return base64.b64decode(s).decode(errors="replace")
-        raise ValueError(f"decode: unknown format {fmt!r}")
+            raw = bytes.fromhex(s)
+        elif fmt == "base64":
+            raw = base64.b64decode(s)
+        else:
+            raise ValueError(f"decode: unknown format {fmt!r}")
+        # valid UTF-8 round-trips as str; anything else stays raw bytes
+        # rather than being mangled through replacement characters
+        try:
+            return raw.decode("utf-8")
+        except UnicodeDecodeError:
+            return raw
 
     return _obj([dec(_row_get(v, i)) for i in range(_n_rows(args[:1]))]), \
         _all_valid_mask([m, mf])
@@ -915,14 +946,25 @@ def _decode(args):
 
 @host_fn("concat_ws")
 def _concat_ws(args):
-    sep_v = args[0][0]
-    sep = sep_v if isinstance(sep_v, str) else str(np.asarray(sep_v).reshape(-1)[0])
+    (sep_v, sep_m) = args[0]
     rest = args[1:]
-    n = _n_rows(rest)
-    out = [sep.join(str(_row_get(a[0], i)) for a in rest
-                    if _row_get(a[0], i) is not None)
-           for i in range(n)]
-    return _obj(out), None  # NULL args are skipped, result never NULL
+    n = _n_rows(args)
+    out = []
+    valid = np.ones(n, dtype=bool)
+    for i in range(n):
+        # the separator is evaluated per row (it may be a column), and a
+        # NULL separator yields a NULL result (Postgres/DataFusion) —
+        # NULL value args, by contrast, are merely skipped
+        sep = _row_get(sep_v, i)
+        if sep is None or (sep_m is not None
+                           and not bool(np.asarray(sep_m).reshape(-1)[
+                               i if np.ndim(sep_m) else 0])):
+            out.append(None)
+            valid[i] = False
+            continue
+        out.append(str(sep).join(str(_row_get(a[0], i)) for a in rest
+                                 if _row_is_valid(a, i)))
+    return _obj(out), (None if valid.all() else valid)
 
 
 def _uuid(args, env):

@@ -181,6 +181,36 @@ def _has_aggregates(sel: Select) -> bool:
     return bool(c.aggs) or bool(sel.group_by)
 
 
+def _apply_validity(v, m):
+    """Materialize a SQL validity mask into the projected column: None for
+    object/string/host-bool rows, NaN for numerics (the engine's null
+    convention; nullable int results are promoted to f64, exact to 2^53;
+    traced-bool results become f64 0.0/1.0/NaN — the only null-capable
+    dtype available inside jit)."""
+    if isinstance(v, (str, bytes)) or (
+            isinstance(v, np.ndarray) and v.dtype.kind in "USO"):
+        mm = np.asarray(m, dtype=bool)
+        if mm.ndim == 0 and np.ndim(v) == 0:
+            return (v.item() if isinstance(v, np.ndarray) else v) \
+                if bool(mm) else None
+        n = mm.shape[0] if mm.ndim else np.shape(v)[0]
+        out = np.empty(n, dtype=object)
+        out[:] = np.broadcast_to(np.asarray(v, dtype=object), (n,))
+        out[~np.broadcast_to(mm, (n,))] = None
+        return out
+    if isinstance(v, np.ndarray) and v.dtype == np.bool_ \
+            and not hasattr(m, "aval"):
+        out = v.astype(object)
+        out[~np.broadcast_to(np.asarray(m, dtype=bool), v.shape)] = None
+        return out
+    import jax.numpy as jnp
+
+    arr = jnp.asarray(v)
+    if not jnp.issubdtype(arr.dtype, jnp.floating):
+        arr = arr.astype(jnp.float64)
+    return jnp.where(jnp.asarray(m), arr, jnp.nan)
+
+
 def _wrap_record(compiled: List[Tuple[str, Compiled]], passthrough: List[str]
                  ) -> Callable:
     """Build a cols->cols projection fn from compiled items."""
@@ -188,14 +218,18 @@ def _wrap_record(compiled: List[Tuple[str, Compiled]], passthrough: List[str]
     def fn(cols: Dict[str, Any]) -> Dict[str, Any]:
         out: Dict[str, Any] = {}
         for name, c in compiled:
-            v, _m = c.fn(cols)
+            v, m = c.fn(cols)
+            if m is not None:
+                v = _apply_validity(v, m)
             if np.ndim(v) == 0:
                 # scalar result (python scalar OR 0-d array): broadcast.
                 # jnp handles traced values (this fn can run inside jit);
                 # np.full would choke on tracers
                 n = len(cols["__timestamp"])
-                if isinstance(v, (np.ndarray, np.generic, int, float, bool,
-                                  str)):
+                if v is None:  # scalar NULL (e.g. nullif of equal literals)
+                    v = np.full(n, None, dtype=object)
+                elif isinstance(v, (np.ndarray, np.generic, int, float, bool,
+                                    str)):
                     v = np.full(n, v)
                 else:
                     import jax.numpy as jnp

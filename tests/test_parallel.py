@@ -175,6 +175,45 @@ def test_mesh_snapshot_restore_rescale(rng):
     assert got == exp
 
 
+def test_merge_snapshots_min_max_across_disjoint_spans():
+    """Rescale-merge two parent snapshots whose bin SPANS differ: the
+    merged state must pad each channel with its aggregation identity,
+    not 0 — a 0-pad makes MIN (and MAX over negatives) wrongly emit 0
+    for windows spanning bins the key's parent never held."""
+    from arroyo_tpu.ops.keyed_bins import (KeyedBinState,
+                                           merge_canonical_snapshots)
+
+    def fill(keys, ts, vals):
+        st = KeyedBinState(AGGS, SEC, 2 * SEC, capacity=64)
+        kh = hash_columns([np.asarray(keys, dtype=np.int64)])
+        st.update(kh, np.asarray(ts, dtype=np.int64),
+                  {"v": np.asarray(vals, dtype=np.int64)})
+        return kh, st.snapshot()
+
+    # parent A: key 1 with data in bins 10-11 (all values >= 5)
+    kh_a, snap_a = fill([1, 1], [10 * SEC, 11 * SEC], [5, 9])
+    # parent B: key 2 with data in bins 12-13 (all values negative)
+    kh_b, snap_b = fill([2, 2], [12 * SEC, 13 * SEC], [-7, -3])
+
+    merged = merge_canonical_snapshots(
+        {k: np.asarray(v) for k, v in snap_a.items()},
+        {k: np.asarray(v) for k, v in snap_b.items()})
+    st = KeyedBinState(AGGS, SEC, 2 * SEC, capacity=64)
+    st.restore(merged)
+    f = st.fire_panes(1 << 60, final=True)
+    assert f is not None
+    kk, oc, wend, _ = f
+    got = {(int(kk[j]), int(wend[j])):
+           (int(oc["cnt"][j]), int(oc["total"][j]),
+            int(oc["lo"][j]), int(oc["hi"][j]))
+           for j in range(len(kk))}
+    all_ts = np.array([10 * SEC, 11 * SEC, 12 * SEC, 13 * SEC], np.int64)
+    all_kh = np.concatenate([kh_a[:1], kh_a[1:], kh_b[:1], kh_b[1:]])
+    all_vals = np.array([5, 9, -7, -3], np.int64)
+    exp = oracle_windows(all_ts, all_kh, all_vals, 2 * SEC, SEC)
+    assert got == exp
+
+
 def test_make_bin_state_selects_mesh(monkeypatch):
     import jax
 

@@ -788,6 +788,47 @@ def test_gcd_lcm_factorial_exactness():
     assert out.columns["rep"][0] == "ababab"
 
 
+def test_scalar_fn_null_and_edge_semantics():
+    """Advisor-flagged edge cases: factorial overflow is NULL (not a
+    clamped wrong value), to_hex renders negatives as 64-bit two's
+    complement, a column-valued concat_ws separator is read per row, and
+    a NULL separator yields NULL (Postgres/DataFusion semantics)."""
+    p = SchemaProvider()
+    events_table(p)
+    out = run_sql("""
+      SELECT factorial(21) as fo, factorial(3) as f3,
+             to_hex(-1) as h1, to_hex(-255) as h255,
+             concat_ws(name, 'L', 'R') as cw,
+             concat_ws(nullif('x', 'x'), 'L', 'R') as cwn
+      FROM events WHERE k >= 0
+    """, p)
+    assert np.isnan(out.columns["fo"]).all()  # 21! overflows int64 -> NULL
+    assert (out.columns["f3"] == 6).all()
+    assert out.columns["h1"][0] == "ffffffffffffffff"
+    assert out.columns["h255"][0] == "ffffffffffffff01"
+    names = out.columns["cw"]
+    assert all(s.startswith("L") and s.endswith("R") and len(s) > 2
+               for s in names.tolist())  # per-row column separator
+    assert all(v is None for v in out.columns["cwn"].tolist())
+
+
+def test_decode_non_utf8_returns_bytes():
+    """decode() of a non-UTF-8 payload must return the raw bytes, not
+    replacement-character-mangled text."""
+    import base64
+
+    p = SchemaProvider()
+    events_table(p)
+    payload = base64.b64encode(b"\xff\xfe\x01").decode()
+    out = run_sql(f"""
+      SELECT decode('{payload}', 'base64') as raw,
+             decode(encode(name, 'hex'), 'hex') as rt
+      FROM events WHERE k >= 0
+    """, p)
+    assert out.columns["raw"][0] == b"\xff\xfe\x01"
+    assert isinstance(out.columns["rt"][0], str)  # UTF-8 round-trips as str
+
+
 def test_union_all_sql_and_stream():
     """UNION ALL — deliberate over-parity: the reference bails on unions
     (arroyo-sql/src/pipeline.rs:393)."""

@@ -503,15 +503,76 @@ class TumblingTopNOperator(Operator):
         self.buffer.evict_before(end)
 
 
+def _null_column(n: int, like: Optional[np.ndarray] = None,
+                 kind: str = "") -> np.ndarray:
+    """A NULL-filled column: None for object/string columns, NaN (f64)
+    for everything else — the engine's null conventions."""
+    stringy = (kind == "s" if like is None
+               else (like.dtype == object or like.dtype.kind in "US"))
+    if stringy:
+        return np.full(n, None, dtype=object)
+    return np.full(n, np.nan, dtype=np.float64)
+
+
+def _join_name_maps(l_names, r_names, l_prefix: str = "",
+                    r_prefix: str = ""):
+    """Column-name mapping for a join output (left names win; colliding
+    right names get the ``r_`` prefix) — one definition so matched-pair,
+    padded, and retraction batches of the same join all agree."""
+    lmap: Dict[str, str] = {}
+    for c in l_names:
+        lmap[c] = (l_prefix + c) if (c in r_names or l_prefix) else c
+    rmap: Dict[str, str] = {}
+    taken = set(lmap.values())
+    for c in r_names:
+        name = (r_prefix + c) if (c in l_names or r_prefix) else c
+        if name in taken:
+            name = "r_" + name
+        rmap[c] = name
+        taken.add(name)
+    return lmap, rmap
+
+
+class _SideTemplate:
+    """Column template for null-padding one side of an outer join: prefers
+    the dtypes of batches actually seen on that side, falls back to the
+    planner-provided (name, kind) schema before any batch arrives."""
+
+    def __init__(self, spec_cols: Tuple[Tuple[str, str], ...]):
+        self.spec_cols = tuple(spec_cols)
+        self.seen: Optional[Dict[str, np.dtype]] = None
+
+    def observe(self, batch: Batch) -> None:
+        self.seen = {c: v.dtype for c, v in batch.columns.items()}
+
+    def names(self) -> List[str]:
+        if self.seen is not None:
+            return list(self.seen)
+        return [c for c, _k in self.spec_cols]
+
+    def null_cols(self, n: int) -> Dict[str, np.ndarray]:
+        if self.seen is not None:
+            return {c: _null_column(n, like=np.empty(0, dtype=dt))
+                    for c, dt in self.seen.items()}
+        return {c: _null_column(n, kind=k) for c, k in self.spec_cols}
+
+
 class WindowJoinOperator(Operator):
     """Windowed stream-stream hash join (SURVEY kernel #3): both sides
     buffered, joined per fired window by sorted-merge on key hash
-    (WindowedHashJoin, joins.rs:14-181)."""
+    (WindowedHashJoin, joins.rs:14-181).  Outer kinds null-pad the
+    unmatched side per fired window — append-only, no retractions, since
+    each window fires exactly once (the reference's list-merge codegen,
+    arroyo-sql/src/expressions.rs:134-230)."""
 
-    def __init__(self, name: str, typ):
+    def __init__(self, name: str, typ, join_type: JoinType = JoinType.INNER,
+                 left_cols: Tuple[Tuple[str, str], ...] = (),
+                 right_cols: Tuple[Tuple[str, str], ...] = ()):
         super().__init__(name)
         self.typ = typ
+        self.join_type = join_type
         self.width, self.slide = _window_params(typ)
+        self._tmpl = (_SideTemplate(left_cols), _SideTemplate(right_cols))
 
     def tables(self) -> List[TableDescriptor]:
         return [
@@ -527,6 +588,7 @@ class WindowJoinOperator(Operator):
 
     async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
         assert batch.key_hash is not None, "window join requires keyed inputs"
+        self._tmpl[side].observe(batch)
         (self.left if side == 0 else self.right).append(batch)
         first_end = (batch.timestamp // self.slide + 1) * self.slide
         if isinstance(self.typ, SlidingWindow):
@@ -544,8 +606,18 @@ class WindowJoinOperator(Operator):
         start = end - self.width
         l = self.left.query_range(start, end)
         r = self.right.query_range(start, end)
-        if l is not None and r is not None and len(l) and len(r):
-            out = join_batches(l, r, end)
+        how = self.join_type
+        have_l, have_r = (l is not None and len(l)), (r is not None and len(r))
+        fire = ((have_l and have_r)
+                or (have_l and how in (JoinType.LEFT, JoinType.FULL))
+                or (have_r and how in (JoinType.RIGHT, JoinType.FULL)))
+        if fire:
+            if not have_l:
+                l = _empty_like_side(self._tmpl[0], r)
+            if not have_r:
+                r = _empty_like_side(self._tmpl[1], l)
+            out = join_batches(l, r, end, how=how,
+                               tmpl=(self._tmpl[0], self._tmpl[1]))
             if len(out):
                 await ctx.collect(out)
         evict_to = end - self.width + self.slide
@@ -553,10 +625,49 @@ class WindowJoinOperator(Operator):
         self.right.evict_before(evict_to)
 
 
+def _empty_like_side(tmpl: "_SideTemplate", other: Batch) -> Batch:
+    """A 0-row batch shaped like one join side (for windows where that
+    side saw no data)."""
+    cols = {c: v[:0] for c, v in tmpl.null_cols(0).items()}
+    return Batch(np.zeros(0, dtype=np.int64), cols,
+                 np.zeros(0, dtype=np.uint64), other.key_cols)
+
+
+def _match_pairs(lk: np.ndarray, rk_sorted: np.ndarray
+                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """(lidx, ridx_into_sorted, per-left-row match counts) for an equi-join
+    of left key hashes against an already-sorted right key array."""
+    left_start = np.searchsorted(rk_sorted, lk, side="left")
+    left_end = np.searchsorted(rk_sorted, lk, side="right")
+    counts = left_end - left_start
+    lidx = np.repeat(np.arange(len(lk)), counts)
+    offs = np.arange(len(lidx)) - np.repeat(
+        np.cumsum(counts) - counts, counts)
+    ridx = np.repeat(left_start, counts) + offs
+    return lidx, ridx, counts
+
+
+def _concat_col(parts: List[np.ndarray]) -> np.ndarray:
+    """Concatenate column fragments, promoting to object when any
+    fragment is (None-padded rows mix with typed rows)."""
+    if any(p.dtype == object for p in parts):
+        out = np.empty(sum(len(p) for p in parts), dtype=object)
+        at = 0
+        for p in parts:
+            out[at:at + len(p)] = p
+            at += len(p)
+        return out
+    return np.concatenate(parts)
+
+
 def join_batches(l: Batch, r: Batch, end: int,
                  l_prefix: str = "", r_prefix: str = "",
-                 how: JoinType = JoinType.INNER) -> Batch:
-    """Sorted-merge equi-join of two keyed batches on key_hash.
+                 how: JoinType = JoinType.INNER,
+                 tmpl: Optional[Tuple["_SideTemplate", "_SideTemplate"]] = None
+                 ) -> Batch:
+    """Sorted-merge equi-join of two keyed batches on key_hash, with
+    LEFT/RIGHT/FULL null-padding of unmatched rows (the reference's
+    windowed list-merge, arroyo-sql/src/expressions.rs:134-230).
 
     Match counting and position arithmetic are vectorized; pair expansion is
     np.repeat (the result size is data-dependent, so it stays on host — the
@@ -564,46 +675,73 @@ def join_batches(l: Batch, r: Batch, end: int,
     lo = np.argsort(l.key_hash, kind="stable")
     ro = np.argsort(r.key_hash, kind="stable")
     lk, rk = l.key_hash[lo], r.key_hash[ro]
-    # for each left row, the range of matching right rows
-    left_start = np.searchsorted(rk, lk, side="left")
-    left_end = np.searchsorted(rk, lk, side="right")
-    counts = left_end - left_start
-    lidx = np.repeat(np.arange(len(lk)), counts)
-    # right indices: start + offset within each run
-    offs = np.arange(len(lidx)) - np.repeat(
-        np.cumsum(counts) - counts, counts)
-    ridx = np.repeat(left_start, counts) + offs
+    lidx, ridx, counts = _match_pairs(lk, rk)
 
     l_rows = l.select(lo[lidx])
     r_rows = r.select(ro[ridx])
+    lmap, rmap = _join_name_maps(l_rows.columns, r_rows.columns,
+                                 l_prefix, r_prefix)
 
-    cols: Dict[str, np.ndarray] = {}
+    parts: List[Tuple[Dict[str, np.ndarray], np.ndarray]] = []  # (cols, kh)
+    matched_cols: Dict[str, np.ndarray] = {}
     for c, v in l_rows.columns.items():
-        cols[(l_prefix + c) if (c in r_rows.columns or l_prefix) else c] = v
+        matched_cols[lmap[c]] = v
     for c, v in r_rows.columns.items():
-        name = (r_prefix + c) if (c in l_rows.columns or r_prefix) else c
-        if name in cols:
-            name = "r_" + name
-        cols[name] = v
+        matched_cols[rmap[c]] = v
+    parts.append((matched_cols, l_rows.key_hash))
 
-    if how in (JoinType.LEFT, JoinType.FULL):
-        pass  # outer variants emitted by JoinWithExpiration's updating path
-    ts = np.full(len(l_rows), end - 1, dtype=np.int64)
-    return Batch(ts, cols, l_rows.key_hash, l.key_cols)
+    if how in (JoinType.LEFT, JoinType.FULL) and (counts == 0).any():
+        un = l.select(lo[counts == 0])
+        pad = ((tmpl[1].null_cols(len(un))) if tmpl is not None
+               else {c: _null_column(len(un), like=v)
+                     for c, v in r.columns.items()})
+        cols = {lmap[c]: v for c, v in un.columns.items()}
+        for c, v in pad.items():
+            cols[rmap.get(c, c)] = v
+        parts.append((cols, un.key_hash))
+    if how in (JoinType.RIGHT, JoinType.FULL):
+        r_matched = np.zeros(len(r.key_hash), dtype=bool)
+        if len(ridx):
+            r_matched[ro[ridx]] = True
+        if not r_matched.all():
+            un = r.select(~r_matched)
+            pad = ((tmpl[0].null_cols(len(un))) if tmpl is not None
+                   else {c: _null_column(len(un), like=v)
+                         for c, v in l.columns.items()})
+            cols = {lmap.get(c, c): v for c, v in pad.items()}
+            for c, v in un.columns.items():
+                cols[rmap[c]] = v
+            parts.append((cols, un.key_hash))
+
+    if len(parts) == 1:
+        cols, kh = parts[0]
+        ts = np.full(len(kh), end - 1, dtype=np.int64)
+        return Batch(ts, cols, kh, l.key_cols)
+    names = list(parts[0][0])
+    out_cols = {c: _concat_col([p[0][c] for p in parts]) for c in names}
+    kh = np.concatenate([p[1] for p in parts])
+    ts = np.full(len(kh), end - 1, dtype=np.int64)
+    return Batch(ts, out_cols, kh, l.key_cols)
 
 
 class JoinWithExpirationOperator(Operator):
     """Unwindowed stream-stream join with TTL state
     (join_with_expiration.rs:14-483).  Inner joins emit append rows; outer
-    joins emit updating (__op) rows with retractions when a match replaces a
-    null-padded emission."""
+    joins emit updating (``__op``) rows: an arriving row with no opposite
+    match emits a null-padded CREATE, and when the FIRST opposite-side row
+    for that key later arrives, the padded rows are retracted (DELETE) and
+    replaced by joined CREATEs — the reference's ``UpdatingData::Update
+    {old, new}`` model (join_with_expiration.rs:80-95, 162-218)."""
 
     def __init__(self, name: str, left_ttl: int, right_ttl: int,
-                 join_type: JoinType):
+                 join_type: JoinType,
+                 left_cols: Tuple[Tuple[str, str], ...] = (),
+                 right_cols: Tuple[Tuple[str, str], ...] = ()):
         super().__init__(name)
         self.left_ttl = left_ttl
         self.right_ttl = right_ttl
         self.join_type = join_type
+        self._tmpl = (_SideTemplate(left_cols), _SideTemplate(right_cols))
 
     def tables(self) -> List[TableDescriptor]:
         return [
@@ -617,17 +755,100 @@ class JoinWithExpirationOperator(Operator):
         self.left = ctx.state.get_batch_buffer("l")
         self.right = ctx.state.get_batch_buffer("r")
 
+    def _orient(self, mine_rows: Batch, opp_cols: Dict[str, np.ndarray],
+                side: int, end: int, op: Optional[int],
+                kh: Optional[np.ndarray] = None) -> Batch:
+        """Build an output batch from rows of MY side joined against
+        already-named opposite-side columns, in left-right orientation."""
+        my_tmpl_names = list(mine_rows.columns)
+        opp_names = list(opp_cols)
+        if side == 0:
+            lmap, rmap = _join_name_maps(my_tmpl_names, opp_names)
+            cols = {lmap[c]: v for c, v in mine_rows.columns.items()}
+            for c, v in opp_cols.items():
+                cols[rmap[c]] = v
+        else:
+            lmap, rmap = _join_name_maps(opp_names, my_tmpl_names)
+            cols = {lmap[c]: v for c, v in opp_cols.items()}
+            for c, v in mine_rows.columns.items():
+                cols[rmap[c]] = v
+        if op is not None:
+            cols[UPDATE_OP_COLUMN] = np.full(len(mine_rows), op, np.int8)
+        ts = np.full(len(mine_rows), end - 1, dtype=np.int64)
+        return Batch(ts, cols,
+                     mine_rows.key_hash if kh is None else kh,
+                     mine_rows.key_cols)
+
     async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
         assert batch.key_hash is not None
+        if not len(batch):
+            return
+        how = self.join_type
+        self._tmpl[side].observe(batch)
         mine, other = ((self.left, self.right) if side == 0
                        else (self.right, self.left))
+        my_tmpl, opp_tmpl = self._tmpl[side], self._tmpl[1 - side]
+        # is MY side / the OPPOSITE side null-padded when unmatched?
+        my_outer = how in ((JoinType.LEFT, JoinType.FULL) if side == 0
+                           else (JoinType.RIGHT, JoinType.FULL))
+        opp_outer = how in ((JoinType.RIGHT, JoinType.FULL) if side == 0
+                            else (JoinType.LEFT, JoinType.FULL))
+        updating = how != JoinType.INNER
+        op_create = UpdateOp.CREATE.value if updating else None
+
         opp = other.all()
-        if opp is not None and len(opp) and len(batch):
-            end = int(batch.timestamp.max()) + 1
-            out = (join_batches(batch, opp, end) if side == 0
-                   else join_batches(opp, batch, end))
-            if len(out):
+        have_opp = opp is not None and len(opp)
+        end = int(batch.timestamp.max()) + 1
+
+        # 1. retract padded opposite rows: keys NEW to my buffer that
+        #    match existing opposite rows previously emitted as
+        #    (null, opp) — the reference's first_left/first_right Update.
+        #    Caveat shared with the reference: "new" is judged from the
+        #    CURRENT buffer, so after TTL eviction a re-arriving key can
+        #    retract a padded row that was already retracted (the
+        #    reference's first_right is likewise recomputed from post-
+        #    eviction state, join_with_expiration.rs:420-430) — accepted
+        #    as parity behavior for expired-state edge cases
+        if opp_outer and have_opp:
+            mine_all = mine.all()
+            batch_keys = np.unique(batch.key_hash)
+            if mine_all is not None and len(mine_all):
+                new_keys = batch_keys[~np.isin(batch_keys,
+                                               mine_all.key_hash)]
+            else:
+                new_keys = batch_keys
+            if len(new_keys):
+                hit = np.isin(opp.key_hash, new_keys)
+                if hit.any():
+                    # the hit rows are OPPOSITE-side rows whose padded
+                    # (null, row) emission is now stale; my side is the pad
+                    padded = opp.select(hit)
+                    pad = my_tmpl.null_cols(len(padded))
+                    out = self._orient(padded, pad, 1 - side, end,
+                                       UpdateOp.DELETE.value)
+                    await ctx.collect(out)
+
+        # 2. joined CREATEs for matched pairs
+        if have_opp:
+            ro = np.argsort(opp.key_hash, kind="stable")
+            lidx, ridx, counts = _match_pairs(batch.key_hash,
+                                              opp.key_hash[ro])
+            if len(lidx):
+                my_rows = batch.select(lidx)
+                opp_rows = opp.select(ro[ridx])
+                out = self._orient(my_rows, dict(opp_rows.columns), side,
+                                   end, op_create)
                 await ctx.collect(out)
+        else:
+            counts = np.zeros(len(batch), dtype=np.int64)
+
+        # 3. null-padded CREATEs for my unmatched rows
+        if my_outer and (counts == 0).any():
+            un = batch.select(counts == 0)
+            pad = opp_tmpl.null_cols(len(un))
+            out = self._orient(un, pad, side, end, op_create)
+            await ctx.collect(out)
+
         mine.append(batch)
 
     async def handle_watermark(self, watermark: int, ctx: Context) -> None:
@@ -831,7 +1052,11 @@ def _build_topn(op: LogicalOperator) -> Operator:
 
 @register_builder(OpKind.WINDOW_JOIN)
 def _build_window_join(op: LogicalOperator) -> Operator:
-    return WindowJoinOperator(op.name, op.spec.typ)
+    s = op.spec
+    return WindowJoinOperator(op.name, s.typ,
+                              getattr(s, "join_type", JoinType.INNER),
+                              getattr(s, "left_cols", ()),
+                              getattr(s, "right_cols", ()))
 
 
 @register_builder(OpKind.JOIN_WITH_EXPIRATION)
@@ -841,7 +1066,8 @@ def _build_join_exp(op: LogicalOperator) -> Operator:
         return SemiJoinOperator(op.name, s.left_expiration_micros,
                                 s.right_expiration_micros)
     return JoinWithExpirationOperator(op.name, s.left_expiration_micros,
-                                      s.right_expiration_micros, s.join_type)
+                                      s.right_expiration_micros, s.join_type,
+                                      s.left_cols, s.right_cols)
 
 
 @register_builder(OpKind.NON_WINDOW_AGGREGATOR)

@@ -237,6 +237,23 @@ class JoinWithExpirationSpec:
     left_expiration_micros: int
     right_expiration_micros: int
     join_type: JoinType = JoinType.INNER
+    # visible (name, kind) column schemas per side so outer joins can
+    # null-pad the missing side even before any batch has arrived from it
+    left_cols: Tuple[Tuple[str, str], ...] = ()
+    right_cols: Tuple[Tuple[str, str], ...] = ()
+
+
+@dataclass
+class WindowJoinSpec:
+    """Operator::WindowJoin — windowed stream-stream hash join; outer
+    kinds null-pad the unmatched side per fired window (append-only, no
+    retractions — each window fires once), matching the reference's
+    list-merge codegen (arroyo-sql/src/expressions.rs:134-230)."""
+
+    typ: WindowType
+    join_type: JoinType = JoinType.INNER
+    left_cols: Tuple[Tuple[str, str], ...] = ()
+    right_cols: Tuple[Tuple[str, str], ...] = ()
 
 
 @dataclass
@@ -584,10 +601,14 @@ class Stream:
     # -- joins -------------------------------------------------------------
 
     def window_join(self, other: "Stream", window: WindowType,
+                    join_type: JoinType = JoinType.INNER,
+                    left_cols: Tuple[Tuple[str, str], ...] = (),
+                    right_cols: Tuple[Tuple[str, str], ...] = (),
                     name: str = "window_join",
                     parallelism: Optional[int] = None) -> "Stream":
         assert self.program is other.program, "join streams must share a Program"
-        spec = WindowSpec(window)
+        spec = WindowJoinSpec(window, join_type, tuple(left_cols),
+                              tuple(right_cols))
         op = LogicalOperator(OpKind.WINDOW_JOIN, name, spec=spec)
         par = parallelism or self.program.node(self.tail).parallelism
         nid = self.program.add_node(op, par)
@@ -599,9 +620,13 @@ class Stream:
     def join_with_expiration(self, other: "Stream", left_expiration_micros: int,
                              right_expiration_micros: int,
                              join_type: JoinType = JoinType.INNER,
+                             left_cols: Tuple[Tuple[str, str], ...] = (),
+                             right_cols: Tuple[Tuple[str, str], ...] = (),
                              name: str = "join", parallelism: Optional[int] = None) -> "Stream":
         assert self.program is other.program
-        spec = JoinWithExpirationSpec(left_expiration_micros, right_expiration_micros, join_type)
+        spec = JoinWithExpirationSpec(left_expiration_micros,
+                                      right_expiration_micros, join_type,
+                                      tuple(left_cols), tuple(right_cols))
         op = LogicalOperator(OpKind.JOIN_WITH_EXPIRATION, name, spec=spec)
         par = parallelism or self.program.node(self.tail).parallelism
         nid = self.program.add_node(op, par)

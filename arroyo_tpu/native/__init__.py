@@ -51,6 +51,18 @@ def _build() -> bool:
         return False
 
 
+_ABI_VERSION = 2  # must match arroyo_abi_version() in host_ops.cpp
+
+
+def _abi_ok(lib: ctypes.CDLL) -> bool:
+    try:
+        fn = lib.arroyo_abi_version
+        fn.restype = ctypes.c_int64
+        return int(fn()) == _ABI_VERSION
+    except (AttributeError, OSError):
+        return False  # pre-versioning build: signatures may have changed
+
+
 def _load() -> Optional[ctypes.CDLL]:
     if os.environ.get("ARROYO_NATIVE", "1") in ("0", "false", "no"):
         return None
@@ -58,6 +70,8 @@ def _load() -> Optional[ctypes.CDLL]:
         return None
     try:
         lib = ctypes.CDLL(_SO)
+        if not _abi_ok(lib):
+            raise OSError(f"stale ABI (want v{_ABI_VERSION})")
     except OSError as e:  # stale/foreign-arch binary: rebuild once
         logger.warning("reloading native lib after load failure: %s", e)
         try:
@@ -68,7 +82,25 @@ def _load() -> Optional[ctypes.CDLL]:
         if not _build():
             return None
         try:
-            lib = ctypes.CDLL(_SO)
+            # dlopen caches by pathname, so re-CDLL of _SO would return
+            # the stale mapping we just detected — load the rebuilt
+            # library through a unique temp copy instead (unlinked after
+            # dlopen; the mapping survives on Linux)
+            import shutil
+            import tempfile
+
+            fd, tmp = tempfile.mkstemp(
+                suffix=".so", dir=os.path.dirname(_SO))
+            os.close(fd)
+            shutil.copy2(_SO, tmp)
+            try:
+                lib = ctypes.CDLL(tmp)
+            finally:
+                os.unlink(tmp)
+            if not _abi_ok(lib):
+                logger.warning("native lib ABI mismatch after rebuild; "
+                               "numpy fallbacks")
+                return None
         except OSError as e2:
             logger.warning("native lib unusable, numpy fallbacks: %s", e2)
             return None
@@ -87,7 +119,7 @@ def _load() -> Optional[ctypes.CDLL]:
         ctypes.c_int64, i32p, u8p,
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
     lib.arroyo_assign_bins.restype = ctypes.c_int64
-    f32p = np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS")
+    f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
     lib.arroyo_dir_new.argtypes = [ctypes.c_int64]
     lib.arroyo_dir_new.restype = ctypes.c_void_p
     lib.arroyo_dir_free.argtypes = [ctypes.c_void_p]
@@ -100,7 +132,7 @@ def _load() -> Optional[ctypes.CDLL]:
                                       i64p]
     lib.arroyo_agg_cells.argtypes = [
         i64p, i32p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
-        f32p, u8p, ctypes.c_int32, i64p, i32p, f32p, f32p]
+        f64p, u8p, ctypes.c_int32, i64p, i32p, f64p, f64p]
     lib.arroyo_agg_cells.restype = ctypes.c_int64
     return lib
 
@@ -238,21 +270,22 @@ def agg_cells(slots: np.ndarray, bins: np.ndarray,
               vals: np.ndarray, ch_kinds: Tuple[str, ...]
               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """(slot, bin)-cell pre-aggregation in one native hash pass: returns
-    (cell_slots, cell_bins, cell_rowcounts f32, cell_vals [n_ch, n_cells])
+    (cell_slots, cell_bins, cell_rowcounts f64, cell_vals [n_ch, n_cells])
     — the lexsort+reduceat ``preaggregate`` path's fast twin.  ``live``
-    filters rows; returns cells in first-appearance order."""
+    filters rows; returns cells in first-appearance order.  Accumulation
+    is f64 (exact int sums to 2^53 — the numeric-fidelity policy)."""
     assert _lib is not None
     s = np.ascontiguousarray(slots, dtype=np.int64)
     b = np.ascontiguousarray(bins, dtype=np.int32)
     n = len(s)
-    v = np.ascontiguousarray(vals, dtype=np.float32)
+    v = np.ascontiguousarray(vals, dtype=np.float64)
     kinds = np.array([1 if k == "min" else 2 if k == "max" else 0
                       for k in ch_kinds], dtype=np.uint8)
     n_ch = len(ch_kinds)
     out_slot = np.empty(n, dtype=np.int64)
     out_bin = np.empty(n, dtype=np.int32)
-    out_cnt = np.empty(n, dtype=np.float32)
-    out_vals = np.empty((n_ch, n), dtype=np.float32)
+    out_cnt = np.empty(n, dtype=np.float64)
+    out_vals = np.empty((n_ch, n), dtype=np.float64)
     lv = (None if live is None
           else np.ascontiguousarray(live, dtype=np.uint8))
     lp = lv.ctypes.data_as(ctypes.c_void_p) if lv is not None else None

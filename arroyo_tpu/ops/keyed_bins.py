@@ -35,6 +35,16 @@ from ..graph.logical import AggKind, AggSpec
 NEG_INF = float(jnp.finfo(jnp.float32).min)
 POS_INF = float(jnp.finfo(jnp.float32).max)
 
+# Numeric-fidelity policy (VERDICT r2 #5; the reference aggregates in exact
+# i64/f64, aggregating_window.rs): all XLA-path accumulation channels are
+# float64 — int64 SUM/COUNT stay exact to 2^53, MIN/MAX preserve full int64
+# comparisons below that, AVG divides exactly-summed numerators.  The Pallas
+# MXU path keeps its bf16 hi/lo compensated scatter per batch but lands the
+# deltas in this f64 state, so only within-batch rounding (~2^-16 relative)
+# remains.  min/max identities stay at the f32 extremes — they are
+# identities for any value of magnitude < 3.4e38.
+ACC_DTYPE = np.float64
+
 
 def _init_value(kind: AggKind) -> float:
     if kind == AggKind.MIN:
@@ -48,11 +58,11 @@ def _init_value(kind: AggKind) -> float:
 def _update_kernel(kinds: Tuple[str, ...], C: int, B: int, n: int):
     @jax.jit
     def run(values, counts, packed):
-        # ONE packed f32[k+3, n] input (one host->device transfer — a
+        # ONE packed f64[k+3, n] input (one host->device transfer — a
         # tunneled TPU pays per-transfer latency): rows are
         # [slots, bins, rowcount, channel values...] per pre-aggregated
         # (key, bin) cell; slot/bin/count values are small integers,
-        # exact in f32.  rowcount 0 marks padding.
+        # exact in f64 to 2^53.  rowcount 0 marks padding.
         slots = packed[0].astype(jnp.int32)
         bins = packed[1].astype(jnp.int32)
         rowcnt = packed[2]
@@ -176,27 +186,27 @@ def channel_input(aggs: Tuple[AggSpec, ...], ch_kinds: Tuple[str, ...],
 
     src = valid_of.get(j)
     if src is not None:  # hidden validity count for agg `src`
-        raw = coerce_float(agg_inputs[aggs[src].column])
-        return (~np.isnan(raw)).astype(np.float32)
+        raw = coerce_float(agg_inputs[aggs[src].column], ACC_DTYPE)
+        return (~np.isnan(raw)).astype(ACC_DTYPE)
     a = aggs[j]
     if a.column is None:
-        return np.ones(n, dtype=np.float32)
-    raw = coerce_float(agg_inputs[a.column])
+        return np.ones(n, dtype=ACC_DTYPE)
+    raw = coerce_float(agg_inputs[a.column], ACC_DTYPE)
     ok = ~np.isnan(raw)
     if a.kind == AggKind.COUNT:  # COUNT(col) counts non-null rows
-        return ok.astype(np.float32)
+        return ok.astype(ACC_DTYPE)
     ident = _init_value(AggKind(ch_kinds[j]))
-    return np.where(ok, raw, np.float32(ident)).astype(np.float32)
+    return np.where(ok, raw, ACC_DTYPE(ident)).astype(ACC_DTYPE)
 
 
 def channel_inits(ch_kinds: Tuple[str, ...]) -> np.ndarray:
-    """Per-channel aggregation identity values ([n_ch] f32), carried
+    """Per-channel aggregation identity values ([n_ch]), carried
     inside canonical snapshots so topology-level merges can pad
     uncovered bin spans with the right identity (+inf for MIN, -inf for
     MAX) instead of 0 — a 0-pad makes a post-rescale MIN/MAX window
     wrongly emit 0 for bins one parent never held."""
     return np.array([_init_value(AggKind(k)) for k in ch_kinds],
-                    dtype=np.float32)
+                    dtype=ACC_DTYPE)
 
 
 def preaggregate(kh: np.ndarray, bins: np.ndarray,
@@ -221,7 +231,7 @@ def preaggregate(kh: np.ndarray, bins: np.ndarray,
     is_first[1:] = (kh_s[1:] != kh_s[:-1]) | (bin_s[1:] != bin_s[:-1])
     starts = is_first.nonzero()[0]
     vals_s = vals[:, order]
-    out = np.empty((len(ch_kinds), len(starts)), dtype=np.float32)
+    out = np.empty((len(ch_kinds), len(starts)), dtype=ACC_DTYPE)
     for j, kind in enumerate(ch_kinds):
         if kind == "min":
             out[j] = np.minimum.reduceat(vals_s[j], starts)
@@ -229,7 +239,7 @@ def preaggregate(kh: np.ndarray, bins: np.ndarray,
             out[j] = np.maximum.reduceat(vals_s[j], starts)
         else:  # sum / count channels are additive
             out[j] = np.add.reduceat(vals_s[j], starts)
-    rowcnt = np.diff(np.append(starts, len(kh_s))).astype(np.float32)
+    rowcnt = np.diff(np.append(starts, len(kh_s))).astype(ACC_DTYPE)
     return kh_s[starts], bin_s[starts], rowcnt, out
 
 
@@ -314,7 +324,7 @@ class KeyedBinState:
         self._ndir = NativeDir.create(self.C)
 
         self.values = jnp.zeros((len(self._ch_kinds), self.C, self.B),
-                                dtype=jnp.float32)
+                                dtype=jnp.float64)
         for j, kind in enumerate(self._ch_kinds):
             iv = _init_value(AggKind(kind))
             if iv != 0.0:
@@ -343,9 +353,9 @@ class KeyedBinState:
         self.values = jnp.concatenate([
             self.values,
             jnp.stack([jnp.full((pad, self.B),
-                                _init_value(AggKind(kind)), jnp.float32)
+                                _init_value(AggKind(kind)), jnp.float64)
                        for kind in self._ch_kinds]) if self._ch_kinds else
-            jnp.zeros((0, pad, self.B), jnp.float32)], axis=1)
+            jnp.zeros((0, pad, self.B), jnp.float64)], axis=1)
         self.counts = jnp.concatenate(
             [self.counts, jnp.zeros((pad, self.B), jnp.int32)], axis=0)
         self.slot_to_key = np.concatenate(
@@ -384,7 +394,7 @@ class KeyedBinState:
         # two-phase, local half: reduce rows per (slot, bin) on the host
         # before any device work (TumblingLocalAggregator analog) — under
         # hot-key skew this collapses the batch by orders of magnitude
-        vals = np.empty((len(self._ch_kinds), n), dtype=np.float32)
+        vals = np.empty((len(self._ch_kinds), n), dtype=ACC_DTYPE)
         for j in range(len(self._ch_kinds)):
             vals[j] = self._channel_input(j, agg_inputs, n)
         from ..native import HAVE_NATIVE, agg_cells
@@ -410,10 +420,11 @@ class KeyedBinState:
             return
 
         npad = _bucket(m, floor=256)
-        # slot/bin indices ride the packed f32 transfer: exact only below
-        # 2^24 (a key table this size would be hundreds of GB anyway)
-        assert self.C <= 1 << 24, "key capacity exceeds f32-exact packing"
-        packed = np.zeros((len(self._ch_kinds) + 3, npad), dtype=np.float32)
+        # slot/bin indices ride the packed f64 transfer: exact below 2^53
+        # (a key table that size is unreachable; the Pallas path keeps its
+        # own tighter f32 2^24 guard in pallas_kernels.update_bin_state)
+        assert self.C <= 1 << 53, "key capacity exceeds f64-exact packing"
+        packed = np.zeros((len(self._ch_kinds) + 3, npad), dtype=ACC_DTYPE)
         packed[0, :m] = slots_c
         packed[1, :m] = bins_c
         packed[2, :m] = rowcnt
@@ -464,7 +475,7 @@ class KeyedBinState:
         vals = np.asarray(self.values)
         cnts = np.asarray(self.counts)
         new_vals = np.zeros((len(self._ch_kinds), self.C, newB),
-                            dtype=np.float32)
+                            dtype=ACC_DTYPE)
         for j, kind in enumerate(self._ch_kinds):
             new_vals[j] = _init_value(AggKind(kind))
         new_cnts = np.zeros((self.C, newB), dtype=np.int32)
@@ -624,11 +635,11 @@ class KeyedBinState:
             arrays["slot_to_key"].astype(np.uint64)[:self.next_slot]
 
         bin_keys = arrays["bin_keys"].astype(np.uint64)
-        bin_vals = np.asarray(arrays["bin_vals"], dtype=np.float32)
+        bin_vals = np.asarray(arrays["bin_vals"], dtype=ACC_DTYPE)
         bin_counts = np.asarray(arrays["bin_counts"], dtype=np.int32)
         span = bin_vals.shape[-1]
         self.B = _bucket(max(span, 2 * self.W + 4), floor=8)
-        values = np.zeros((len(self._ch_kinds), self.C, self.B), np.float32)
+        values = np.zeros((len(self._ch_kinds), self.C, self.B), ACC_DTYPE)
         for j, k in enumerate(self._ch_kinds):
             values[j] = _init_value(AggKind(k))
         counts = np.zeros((self.C, self.B), np.int32)
@@ -737,7 +748,7 @@ def merge_canonical_snapshots(a: Dict[str, np.ndarray],
     ch_init = None
     for arrs in (a, b):
         if "ch_init" in arrs:
-            ch_init = np.asarray(arrs["ch_init"], dtype=np.float32)
+            ch_init = np.asarray(arrs["ch_init"], dtype=ACC_DTYPE)
             break
     if ch_init is None or len(ch_init) != n_ch:
         import logging
@@ -745,13 +756,13 @@ def merge_canonical_snapshots(a: Dict[str, np.ndarray],
         logging.getLogger(__name__).warning(
             "merging bin-state snapshots without ch_init (pre-upgrade "
             "checkpoint): MIN/MAX channels pad uncovered bins with 0")
-        ch_init = np.zeros(n_ch, dtype=np.float32)
+        ch_init = np.zeros(n_ch, dtype=ACC_DTYPE)
     parts_keys, parts_vals, parts_counts = [], [], []
     kv_parts: Dict[str, List[np.ndarray]] = {}
     slot_parts: List[np.ndarray] = []
     for arrs, (lo, span) in ((a, spans[0]), (b, spans[1])):
         keys = arrs["bin_keys"].astype(np.uint64)
-        vals = np.asarray(arrs["bin_vals"], dtype=np.float32)
+        vals = np.asarray(arrs["bin_vals"], dtype=ACC_DTYPE)
         counts = np.asarray(arrs["bin_counts"])
         if width and len(keys):
             pv = np.broadcast_to(ch_init[:, None, None],

@@ -165,25 +165,34 @@ def scatter_add_channels(slots: np.ndarray, bins: np.ndarray,
 
 
 @functools.lru_cache(maxsize=256)
-def _update_state_call(k: int, B: int, C_act: int, n_chunks: int,
+def _update_delta_call(k: int, B: int, C_act: int, n_chunks: int,
                        interpret: bool):
-    """One dispatch for a whole bin-state update: pallas scatter + the
-    adds into the [n_aggs, C, B] values and [C, B] counts arrays.
+    """One x32 dispatch producing the per-batch [k, C_act, B] deltas from
+    the packed pallas scatter (hi + lo recombined).
 
     Channel 0 is the count channel; channels 1..k map to values[0..k-1].
     """
     run = _scatter_multi(2 * k, B, C_act, n_chunks, interpret)
 
     @jax.jit
-    def apply(values, counts, packed):
+    def apply(packed):
         # ONE packed f32 input (one transfer): [slots, bins, w2 hi/lo...]
         slots = packed[0].astype(jnp.int32)
         bins = packed[1].astype(jnp.int32)
         out = run(slots, bins, packed[2:])
-        deltas = out[:k] + out[k:]
+        return out[:k] + out[k:]
+
+    return apply
+
+
+@functools.lru_cache(maxsize=64)
+def _apply_delta_call(k: int, C_act: int):
+    @jax.jit
+    def apply(values, counts, deltas):
         counts = counts.at[:C_act].add(deltas[0].astype(counts.dtype))
         if k > 1:
-            values = values.at[:, :C_act].add(deltas[1:])
+            values = values.at[:, :C_act].add(
+                deltas[1:].astype(values.dtype))
         return values, counts
 
     return apply
@@ -193,7 +202,12 @@ def update_bin_state(values: jnp.ndarray, counts: jnp.ndarray,
                      slots: np.ndarray, bins: np.ndarray,
                      weights: np.ndarray, C_act: int, B: int):
     """Fused state update; returns (values, counts). weights[0] is the
-    count channel, weights[1:] the aggregate channels."""
+    count channel, weights[1:] the aggregate channels.
+
+    Two dispatches: the pallas scatter runs under x32 (Mosaic's TPU
+    lowering rejects 64-bit index types), while the state add runs under
+    the session's x64 so the f64 accumulator state is NOT silently
+    downcast (the numeric-fidelity policy in keyed_bins.ACC_DTYPE)."""
     k, n = weights.shape
     assert n % CHUNK == 0
     # slot ids ride an f32 row: exact only below 2^24 (same guard as the
@@ -204,11 +218,10 @@ def update_bin_state(values: jnp.ndarray, counts: jnp.ndarray,
     packed[0] = slots  # small ints: exact in f32
     packed[1] = bins
     packed[2:] = w2
-    apply = _update_state_call(k, B, C_act, n // CHUNK, _interpret())
-    # every operand is 32-bit; trace under x32 — Mosaic's TPU lowering
-    # rejects the 64-bit index types that global x64 mode introduces
+    delta = _update_delta_call(k, B, C_act, n // CHUNK, _interpret())
     with jax.enable_x64(False):
-        return apply(values, counts, jnp.asarray(packed))
+        deltas = delta(jnp.asarray(packed))
+    return _apply_delta_call(k, C_act)(values, counts, deltas)
 
 
 def pad_batch(slots: np.ndarray, bins: np.ndarray,

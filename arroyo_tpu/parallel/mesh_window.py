@@ -94,7 +94,7 @@ def _keys_mesh(nk: int):
 def _init_filled(ch_kinds: Tuple[str, ...], shape: Tuple[int, ...]
                  ) -> np.ndarray:
     """[n_ch, *shape] float32 array filled with each channel's identity."""
-    out = np.zeros((len(ch_kinds),) + shape, np.float32)
+    out = np.zeros((len(ch_kinds),) + shape, np.float64)
     for j, k in enumerate(ch_kinds):
         out[j] = _init_value(AggKind(k))
     return out
@@ -103,7 +103,7 @@ def _init_filled(ch_kinds: Tuple[str, ...], shape: Tuple[int, ...]
 def _channel_rows(aggs, ch_kinds, valid_of, agg_inputs, n) -> np.ndarray:
     """[n_ch, n] per-row channel contributions, nulls masked to identity
     (shared semantics: ops/keyed_bins.channel_input)."""
-    vals = np.zeros((len(ch_kinds), n), dtype=np.float32)
+    vals = np.zeros((len(ch_kinds), n), dtype=np.float64)
     for j in range(len(ch_kinds)):
         vals[j] = channel_input(aggs, ch_kinds, valid_of, j, agg_inputs, n)
     return vals
@@ -157,7 +157,7 @@ def _update_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int, N: int):
             buf_ok = jnp.zeros((nk * N,), bool).at[tgt].set(
                 ok_s & slot_ok, mode="drop")
             buf_val = jnp.zeros((n_ch + 1, nk * N),
-                                jnp.float32).at[:, tgt].set(
+                                jnp.float64).at[:, tgt].set(
                 jnp.where(slot_ok, v_s, 0.0), mode="drop")
             buf_key = jax.lax.all_to_all(
                 buf_key.reshape(nk, N), "keys", 0, 0).reshape(-1)
@@ -195,9 +195,9 @@ def _update_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int, N: int):
             jnp.where(old_found[:, None], counts, 0), mode="drop")
         chs = []
         for j, kind in enumerate(ch_kinds):
-            base = jnp.full((C, B), inits[j], jnp.float32)
+            base = jnp.full((C, B), inits[j], jnp.float64)
             src = jnp.where(old_found[:, None], bins[j],
-                            jnp.float32(inits[j]))
+                            jnp.float64(inits[j]))
             if kind in ("sum", "count"):
                 ch = base.at[o_tgt].add(
                     jnp.where(old_found[:, None], bins[j], 0.0), mode="drop")
@@ -299,7 +299,7 @@ def _roll_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int):
         ok = idx < B
         ic = idx.clip(0, B - 1)
         counts = jnp.where(ok[None, :], counts[:, ic], 0)
-        outs = [jnp.where(ok[None, :], bins[j][:, ic], jnp.float32(inits[j]))
+        outs = [jnp.where(ok[None, :], bins[j][:, ic], jnp.float64(inits[j]))
                 for j in range(len(ch_kinds))]
         return jnp.stack(outs), counts
 
@@ -546,7 +546,7 @@ class MeshKeyedBinState:
         rel_p[:m] = rel_c
         ok_p = np.zeros(total, bool)
         ok_p[:m] = True
-        vals_p = np.zeros((len(self._ch_kinds) + 1, total), np.float32)
+        vals_p = np.zeros((len(self._ch_kinds) + 1, total), np.float64)
         vals_p[0, :m] = rowcnt
         vals_p[1:, :m] = vals_c
 
@@ -708,7 +708,7 @@ class MeshKeyedBinState:
             arrays["slot_to_key"].astype(np.uint64)[:self.next_slot]
 
         keys = arrays["bin_keys"].astype(np.uint64)
-        bins = np.asarray(arrays["bin_vals"], dtype=np.float32)
+        bins = np.asarray(arrays["bin_vals"], dtype=np.float64)
         counts = np.asarray(arrays["bin_counts"], dtype=np.int32)
         span = bins.shape[-1]
         self.B = _bucket(max(span, 2 * self.W + 4), floor=8)

@@ -78,11 +78,16 @@ class Schema:
     # final set to the source connector so it can skip generating/decoding
     # untouched columns — the DataFusion-planner pushdown analog
     source_used: Optional[Set[str]] = None
+    # qualified-name overrides from joins: (alias_lower, col_lower) ->
+    # physical column, so `r.id` resolves to the collision-renamed `r_id`
+    # instead of falling back to the left side's `id`
+    qualified: Dict[Tuple[str, str], str] = field(default_factory=dict)
 
     def clone(self) -> "Schema":
         return Schema(dict(self.columns), dict(self.structs),
                       set(self.aliases), self.window, set(self.window_names),
-                      self.event_time_col, self.source_used)
+                      self.event_time_col, self.source_used,
+                      dict(self.qualified))
 
     def is_string(self, col: str) -> bool:
         return self.columns.get(col) == "s"
@@ -144,6 +149,8 @@ class Schema:
             if nl in ("start", "end"):
                 return self._use(f"window_{nl}", record)
             raise SqlCompileError(f"window has no field {n}")
+        if (ql, nl) in self.qualified:
+            return self._use(self.qualified[(ql, nl)], record)
         if ql in {a.lower() for a in self.aliases}:
             return self.resolve(ColumnRef(n), presence_only, record)
         # qualifier might be a struct accessed through an alias chain a.b.c

@@ -20,6 +20,10 @@
 
 extern "C" {
 
+// bump when any exported signature changes so the Python loader rebuilds
+// a stale cached .so instead of calling through a mismatched ABI
+int64_t arroyo_abi_version() { return 2; }
+
 static inline uint64_t splitmix64(uint64_t z) {
     z += 0x9E3779B97F4A7C15ULL;
     z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
@@ -214,10 +218,10 @@ void arroyo_dir_lookup(void* h, const uint64_t* kh, int64_t n,
 
 int64_t arroyo_agg_cells(const int64_t* slots, const int32_t* bins,
                          const uint8_t* live, int64_t n, int64_t ring,
-                         const float* vals, const uint8_t* kinds,
+                         const double* vals, const uint8_t* kinds,
                          int32_t n_ch,
                          int64_t* out_slot, int32_t* out_bin,
-                         float* out_cnt, float* out_vals) {
+                         double* out_cnt, double* out_vals) {
     uint64_t cap = 64;
     while ((int64_t)cap < n * 2) cap <<= 1;
     const uint64_t mask = cap - 1;
@@ -238,14 +242,14 @@ int64_t arroyo_agg_cells(const int64_t* slots, const int32_t* bins,
             cidx[j] = c;
             out_slot[c] = slots[i];
             out_bin[c] = bins[i];
-            out_cnt[c] = 1.0f;
+            out_cnt[c] = 1.0;
             for (int32_t ch = 0; ch < n_ch; ch++)
                 out_vals[ch * n + c] = vals[ch * n + i];
         } else {
-            out_cnt[c] += 1.0f;
+            out_cnt[c] += 1.0;
             for (int32_t ch = 0; ch < n_ch; ch++) {
-                float v = vals[ch * n + i];
-                float* acc = &out_vals[ch * n + c];
+                double v = vals[ch * n + i];
+                double* acc = &out_vals[ch * n + c];
                 if (kinds[ch] == 1) { if (v < *acc) *acc = v; }
                 else if (kinds[ch] == 2) { if (v > *acc) *acc = v; }
                 else *acc += v;

@@ -192,6 +192,150 @@ def test_exec_join():
     assert pairs == [(20, 200), (30, 300)]
 
 
+def _updating_net(out, cols):
+    """Apply __op retractions (CREATE/UPDATE add, DELETE remove) to get the
+    NET row multiset of an updating stream's output."""
+    from collections import Counter
+
+    net = Counter()
+    ops = out.columns["__op"]
+    for j in range(len(out.timestamp)):
+        row = tuple(None if (isinstance(out.columns[c][j], float)
+                             and np.isnan(out.columns[c][j]))
+                    else out.columns[c][j].item()
+                    if hasattr(out.columns[c][j], "item")
+                    else out.columns[c][j]
+                    for c in cols)
+        if int(ops[j]) == 2:  # DELETE
+            net[row] -= 1
+            if net[row] == 0:
+                del net[row]
+        else:
+            net[row] += 1
+    return net
+
+
+def _join_tables(p, r_ids=(1, 2), r_vals=(111, 222)):
+    p.add_memory_table("l", {"id": "i", "lv": "i"}, [
+        Batch(np.array([100, 200, 300], dtype=np.int64),
+              {"id": np.array([1, 2, 3], dtype=np.int64),
+               "lv": np.array([10, 20, 30], dtype=np.int64)})])
+    p.add_memory_table("r", {"id": "i", "rv": "i"}, [
+        Batch(np.array([150, 250], dtype=np.int64),
+              {"id": np.array(r_ids, dtype=np.int64),
+               "rv": np.array(r_vals, dtype=np.int64)})])
+    return p
+
+
+def test_exec_left_join_unmatched_rows_survive():
+    """The VERDICT repro: a 3-row LEFT JOIN with one unmatched left row
+    must net 3 rows — the unmatched row with a NULL right side — via
+    __op retraction semantics (join_with_expiration.rs:46-95)."""
+    p = _join_tables(SchemaProvider())
+    out = run_sql("SELECT l.id as id, lv, rv FROM l "
+                  "LEFT JOIN r ON l.id = r.id", p)
+    assert "__op" in out.columns  # outer joins are updating streams
+    net = _updating_net(out, ("id", "lv", "rv"))
+    assert net == {(1, 10, 111): 1, (2, 20, 222): 1, (3, 30, None): 1}
+
+
+def test_exec_left_join_late_match_retracts():
+    """When the first right row for a key arrives AFTER the padded left
+    emission, the padded row is retracted (DELETE) and replaced — the
+    reference's UpdatingData::Update (join_with_expiration.rs:80-95)."""
+    p = _join_tables(SchemaProvider())
+    out = run_sql("SELECT l.id as id, lv, rv FROM l "
+                  "LEFT JOIN r ON l.id = r.id", p)
+    ops = out.columns["__op"].astype(int).tolist()
+    # the memory sources race, but whenever a padded row was emitted for a
+    # key that later matched, a DELETE for it must also appear
+    rows = list(zip(out.columns["id"].tolist(), ops))
+    padded_created = {int(i) for (i, o), j in zip(rows, range(len(rows)))
+                      if o == 0 and isinstance(out.columns["rv"][j], float)
+                      and np.isnan(out.columns["rv"][j]) and int(i) in (1, 2)}
+    deleted = {int(i) for i, o in rows if o == 2}
+    assert padded_created == deleted
+
+
+def test_exec_right_and_full_join():
+    p = _join_tables(SchemaProvider(), r_ids=(2, 4), r_vals=(222, 444))
+    out = run_sql("SELECT l.id as lid, r.id as rid, lv, rv FROM l "
+                  "RIGHT JOIN r ON l.id = r.id", p)
+    net = _updating_net(out, ("lid", "rid", "lv", "rv"))
+    assert net == {(2, 2, 20, 222): 1, (None, 4, None, 444): 1}
+
+    p = _join_tables(SchemaProvider(), r_ids=(2, 4), r_vals=(222, 444))
+    out = run_sql("SELECT l.id as lid, r.id as rid, lv, rv FROM l "
+                  "FULL JOIN r ON l.id = r.id", p)
+    net = _updating_net(out, ("lid", "rid", "lv", "rv"))
+    assert net == {(1, None, 10, None): 1, (2, 2, 20, 222): 1,
+                   (3, None, 30, None): 1, (None, 4, None, 444): 1}
+
+
+def test_exec_windowed_left_join_pads_appended():
+    """Windowed outer join: unmatched side null-padded per fired window,
+    append-only (each window fires once -> no retractions), matching the
+    reference's list-merge codegen (expressions.rs:134-230)."""
+    p = SchemaProvider()
+    SEC = 1_000_000
+    p.add_memory_table("a", {"u": "i"}, [
+        Batch(np.array([1 * SEC, 2 * SEC], dtype=np.int64),
+              {"u": np.array([1, 2], dtype=np.int64)})])
+    p.add_memory_table("b", {"s": "i"}, [
+        Batch(np.array([1 * SEC + 1000], dtype=np.int64),
+              {"s": np.array([1], dtype=np.int64)})])
+    out = run_sql("""
+      SELECT P.u as u, P.np as np, A.na as na
+      FROM (SELECT u, TUMBLE(INTERVAL '1' SECOND) as window, count(*) as np
+            FROM a GROUP BY 1, 2) AS P
+      LEFT JOIN (SELECT s, TUMBLE(INTERVAL '1' SECOND) as window,
+                        count(*) as na
+                 FROM b GROUP BY 1, 2) AS A
+      ON P.u = A.s and P.window = A.window
+    """, p)
+    assert "__op" not in out.columns  # append-only
+    got = {}
+    for j in range(len(out.timestamp)):
+        na = out.columns["na"][j]
+        got[int(out.columns["u"][j])] = (
+            int(out.columns["np"][j]),
+            None if np.isnan(na) else int(na))
+    assert got == {1: (1, 1), 2: (1, None)}
+
+
+def test_plan_rejects_aggregate_over_outer_join():
+    from arroyo_tpu.sql import SqlPlanError
+
+    p = _join_tables(SchemaProvider())
+    with pytest.raises(SqlPlanError, match="updating stream"):
+        plan_sql("SELECT count(*) as c FROM "
+                 "(SELECT l.id as id, lv, rv FROM l "
+                 " LEFT JOIN r ON l.id = r.id) GROUP BY id", p)
+
+
+def test_plan_rejects_updating_misuse():
+    """Updating streams (__op retraction rows) may not silently feed
+    operators that would treat DELETE rows as data: joins, UNION ALL
+    with an append-only branch, and TopN all reject at plan time."""
+    from arroyo_tpu.sql import SqlPlanError
+
+    p = _join_tables(SchemaProvider())
+    p.add_memory_table("t2", {"id": "i", "tv": "i"}, [
+        Batch(np.array([100], dtype=np.int64),
+              {"id": np.array([1], dtype=np.int64),
+               "tv": np.array([7], dtype=np.int64)})])
+    outer = "(SELECT l.id as id, lv, rv FROM l LEFT JOIN r ON l.id = r.id)"
+    with pytest.raises(SqlPlanError, match="updating stream"):
+        plan_sql(f"SELECT s.id as sid, tv FROM {outer} AS s "
+                 "JOIN t2 ON s.id = t2.id", p)
+    with pytest.raises(SqlPlanError, match="both"):
+        plan_sql(f"SELECT id, lv, rv FROM {outer} UNION ALL "
+                 "SELECT id, tv as lv, tv as rv FROM t2", p)
+    with pytest.raises(SqlPlanError, match="updating stream"):
+        plan_sql(f"SELECT id, lv, rv FROM {outer} "
+                 "ORDER BY lv DESC LIMIT 2", p)
+
+
 def test_exec_count_distinct():
     p = SchemaProvider()
     ts = np.arange(6, dtype=np.int64) * 100

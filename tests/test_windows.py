@@ -291,6 +291,62 @@ def test_null_skipping_aggregates(rng):
     assert seen == set(exp)
 
 
+def test_sum_exactness_hot_key_large_magnitudes(rng):
+    """Numeric-fidelity policy (keyed_bins.ACC_DTYPE): SUM of int64 prices
+    over a hot key must equal the exact integer oracle even when the
+    per-cell magnitude passes 2^24 (where f32 accumulators drift — the
+    reference aggregates in exact i64, aggregating_window.rs).  500k rows
+    into ONE (key, bin) cell with values ~10^6 sums to ~5*10^11 >> 2^24."""
+    from arroyo_tpu.ops.keyed_bins import KeyedBinState
+    from arroyo_tpu.graph.logical import AggKind, AggSpec
+
+    n = 500_000
+    ts = rng.integers(0, SEC, n).astype(np.int64)  # all in one bin
+    keys = np.zeros(n, dtype=np.int64)  # one hot key
+    vals = rng.integers(1_000_000, 2_000_000, n).astype(np.int64)
+    from arroyo_tpu.types import hash_columns
+
+    kh = hash_columns([keys])
+    aggs = (AggSpec(AggKind.SUM, "v", "total"),
+            AggSpec(AggKind.COUNT, None, "cnt"),
+            AggSpec(AggKind.AVG, "v", "mean"))
+    st = KeyedBinState(aggs, SEC, SEC, capacity=16)
+    # feed in chunks so cross-batch accumulation is exercised too
+    for s in range(0, n, 50_000):
+        e = s + 50_000
+        st.update(kh[s:e], ts[s:e], {"v": vals[s:e]})
+    f = st.fire_panes(1 << 60, final=True)
+    assert f is not None
+    _kk, oc, _wend, _cnt = f
+    exact = int(vals.sum())  # ~7.5e11, exact in int64 and in f64 < 2^53
+    assert int(oc["total"][0]) == exact
+    assert int(oc["cnt"][0]) == n
+    assert oc["mean"][0] == pytest.approx(exact / n, rel=1e-12)
+
+
+def test_mesh_sum_exactness_hot_key(rng):
+    """Same exactness pin for the mesh-sharded state."""
+    from arroyo_tpu.parallel.mesh_window import MeshKeyedBinState
+    from arroyo_tpu.graph.logical import AggKind, AggSpec
+    from arroyo_tpu.types import hash_columns
+
+    n = 200_000
+    ts = rng.integers(0, SEC, n).astype(np.int64)
+    keys = np.zeros(n, dtype=np.int64)
+    vals = rng.integers(1_000_000, 2_000_000, n).astype(np.int64)
+    kh = hash_columns([keys])
+    aggs = (AggSpec(AggKind.SUM, "v", "total"),)
+    st = MeshKeyedBinState(aggs, SEC, SEC, capacity=16, n_shards=4)
+    for s in range(0, n, 50_000):
+        e = s + 50_000
+        st._lookup_or_insert(kh[s:e])
+        st.update(kh[s:e], ts[s:e], {"v": vals[s:e]})
+    f = st.fire_panes(1 << 60, final=True)
+    assert f is not None
+    _kk, oc, _wend, _cnt = f
+    assert int(oc["total"][0]) == int(vals.sum())
+
+
 def test_device_topk_matches_host_lexsort(rng):
     """ops/topk.segment_top_k == the host lexsort rank-per-partition, at
     sizes crossing the device-dispatch threshold, with ties."""

@@ -290,13 +290,15 @@ class NexmarkGenerator:
             cols["bid_datetime"] = np.where(is_bid, ts, 0)
 
         if self.cfg.generate_strings and w(
-                "person_name", "person_email", "person_city", "person_state"):
+                "person_name", "person_email", "person_city", "person_state",
+                "person_extra"):
             np_idx = is_person.nonzero()[0]
             npn = len(np_idx)
             name = np.empty(n, dtype=object); name[:] = ""
             email = np.empty(n, dtype=object); email[:] = ""
             city = np.empty(n, dtype=object); city[:] = ""
             state = np.empty(n, dtype=object); state[:] = ""
+            extra_p = np.empty(n, dtype=object); extra_p[:] = ""
             if npn:
                 rng_ps = self._rngs["person_s"]
                 fn = np.array(FIRST_NAMES, dtype=object)[rng_ps.integers(0, len(FIRST_NAMES), npn)]
@@ -306,27 +308,38 @@ class NexmarkGenerator:
                                  + self._rand_strings(npn, 5, rng=rng_ps) + ".com")
                 city[np_idx] = np.array(US_CITIES, dtype=object)[rng_ps.integers(0, len(US_CITIES), npn)]
                 state[np_idx] = np.array(US_STATES, dtype=object)[rng_ps.integers(0, len(US_STATES), npn)]
+                # padding to avg_person_byte_size=200 (next_extra_string,
+                # mod.rs:406-416, 619-620); content is never queried
+                extra_p[np_idx] = self._rand_strings(npn, 140, rng=rng_ps)
             cols["person_name"] = name
             cols["person_email"] = email
             cols["person_city"] = city
             cols["person_state"] = state
+            cols["person_extra"] = extra_p
 
         if self.cfg.generate_strings and w(
-                "auction_item_name", "auction_description"):
+                "auction_item_name", "auction_description", "auction_extra"):
             na_idx = is_auction.nonzero()[0]
             item_name = np.empty(n, dtype=object); item_name[:] = ""
             desc = np.empty(n, dtype=object); desc[:] = ""
+            extra_a = np.empty(n, dtype=object); extra_a[:] = ""
             if len(na_idx):
                 rng_as = self._rngs["auction_s"]
                 item_name[na_idx] = self._rand_strings(len(na_idx), 20, rng=rng_as)
                 desc[na_idx] = self._rand_strings(len(na_idx), 100, rng=rng_as)
+                # padding to avg_auction_byte_size=500 (mod.rs:444-449)
+                extra_a[na_idx] = self._rand_strings(len(na_idx), 330,
+                                                     rng=rng_as)
             cols["auction_item_name"] = item_name
             cols["auction_description"] = desc
+            cols["auction_extra"] = extra_a
 
-        if self.cfg.generate_strings and w("bid_channel", "bid_url"):
+        if self.cfg.generate_strings and w("bid_channel", "bid_url",
+                                           "bid_extra"):
             nb_idx = is_bid.nonzero()[0]
             channel = np.empty(n, dtype=object); channel[:] = ""
             url = np.empty(n, dtype=object); url[:] = ""
+            extra_b = np.empty(n, dtype=object); extra_b[:] = ""
             if len(nb_idx):
                 nb = len(nb_idx)
                 rng_bs = self._rngs["bid_s"]
@@ -341,8 +354,11 @@ class NexmarkGenerator:
                                  cold_id.astype(str)).astype(object))
                 channel[nb_idx] = ch
                 url[nb_idx] = u
+                # padding to avg_bid_byte_size=100 (mod.rs:571-575)
+                extra_b[nb_idx] = self._rand_strings(nb, 20, rng=rng_bs)
             cols["bid_channel"] = channel
             cols["bid_url"] = url
+            cols["bid_extra"] = extra_b
 
         return Batch(ts, cols), i
 

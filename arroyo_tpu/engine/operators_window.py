@@ -233,15 +233,8 @@ class BinAggOperator(Operator):
         await ctx.collect(out)
 
 
-def _apply_top_n(batch: Batch, partition_cols: Tuple[str, ...],
-                 sort_column: str, max_elements: int) -> Batch:
-    """Keep the top ``max_elements`` rows by ``sort_column`` (desc) per
-    partition — one fused device sort over (partition, window) segments
-    (ops/topk.py; SURVEY #14/#15 device top-k).  Tiny batches stay on a
-    host lexsort: kernel dispatch costs more than the sort itself."""
-    if len(batch) == 0:
-        return batch
-    sort_val = batch.columns[sort_column]
+def _topn_partition(batch: Batch, partition_cols: Tuple[str, ...]
+                    ) -> np.ndarray:
     if partition_cols:
         from ..types import hash_columns
 
@@ -250,23 +243,59 @@ def _apply_top_n(batch: Batch, partition_cols: Tuple[str, ...],
         cols = [batch.columns[c] for c in partition_cols]
         if "window_end" in batch.columns:
             cols.append(batch.columns["window_end"])
-        part = hash_columns(cols)
-    else:
-        part = batch.columns.get("window_end", np.zeros(len(batch), np.int64))
-    if len(batch) >= 512:
-        from ..ops.topk import segment_top_k
+        return hash_columns(cols)
+    return batch.columns.get("window_end", np.zeros(len(batch), np.int64))
 
-        return batch.select(segment_top_k(part, sort_val, max_elements))
+
+def _apply_top_n(batch: Batch, partition_cols: Tuple[str, ...],
+                 sort_column: str, max_elements: Optional[int],
+                 rank_column: Optional[str] = None) -> Batch:
+    """Keep the top ``max_elements`` rows by ``sort_column`` (desc) per
+    partition — one fused device sort over (partition, window) segments
+    (ops/topk.py; SURVEY #14/#15 device top-k).  Tiny batches stay on a
+    host lexsort: kernel dispatch costs more than the sort itself.
+
+    ``max_elements=None`` ranks without pruning; ``rank_column`` emits
+    the 1-based per-partition rank (ROW_NUMBER() materialized) — ranks
+    are computed on the (small) surviving row set on host."""
+    if len(batch) == 0:
+        return batch
+    sort_val = batch.columns[sort_column]
+    part = _topn_partition(batch, partition_cols)
+    if max_elements is not None:
+        if len(batch) >= 512:
+            from ..ops.topk import segment_top_k
+
+            keep = segment_top_k(part, sort_val, max_elements)
+        else:
+            order = np.lexsort((-np.asarray(sort_val, dtype=np.float64),
+                                part))
+            part_sorted = np.asarray(part)[order]
+            is_start = np.ones(len(order), dtype=bool)
+            is_start[1:] = part_sorted[1:] != part_sorted[:-1]
+            seg_id = np.cumsum(is_start) - 1
+            seg_start = is_start.nonzero()[0]
+            rank = np.arange(len(order)) - seg_start[seg_id]
+            keep = order[rank < max_elements]
+            keep.sort()
+        batch = batch.select(keep)
+        if rank_column is None:
+            return batch
+        part = np.asarray(part)[keep]
+        sort_val = batch.columns[sort_column]
+    if rank_column is None:
+        return batch
     order = np.lexsort((-np.asarray(sort_val, dtype=np.float64), part))
     part_sorted = np.asarray(part)[order]
     is_start = np.ones(len(order), dtype=bool)
     is_start[1:] = part_sorted[1:] != part_sorted[:-1]
-    seg_id = np.cumsum(is_start) - 1
     seg_start = is_start.nonzero()[0]
-    rank = np.arange(len(order)) - seg_start[seg_id]
-    keep = order[rank < max_elements]
-    keep.sort()
-    return batch.select(keep)
+    seg_id = np.cumsum(is_start) - 1
+    ranks = np.empty(len(order), dtype=np.int64)
+    ranks[order] = np.arange(len(order)) - seg_start[seg_id] + 1
+    cols = dict(batch.columns)
+    cols[rank_column] = ranks
+    return Batch(batch.timestamp, cols, batch.key_hash, batch.key_cols)
 
 
 class WindowOperator(Operator):
@@ -454,14 +483,16 @@ class SessionWindowOperator(Operator):
 class TumblingTopNOperator(Operator):
     """Windowed TopN (TumblingTopNWindowFunc, tumbling_top_n_window.rs)."""
 
-    def __init__(self, name: str, width_micros: int, max_elements: int,
+    def __init__(self, name: str, width_micros: int,
+                 max_elements: Optional[int],
                  sort_column: str, partition_cols: Tuple[str, ...],
-                 projection=None):
+                 projection=None, rank_column: Optional[str] = None):
         super().__init__(name)
         self.width = width_micros
         self.max_elements = max_elements
         self.sort_column = sort_column
         self.partition_cols = partition_cols
+        self.rank_column = rank_column
         self.projection = (CompiledExpr(projection.name, projection.fn)
                            if projection else None)
 
@@ -496,7 +527,7 @@ class TumblingTopNOperator(Operator):
             out = Batch(np.full(len(rows), end - 1, np.int64), out_cols,
                         rows.key_hash, rows.key_cols)
             out = _apply_top_n(out, self.partition_cols, self.sort_column,
-                               self.max_elements)
+                               self.max_elements, self.rank_column)
             if self.projection is not None:
                 out = eval_record_expr(self.projection, out)
             await ctx.collect(out)
@@ -810,13 +841,8 @@ class JoinWithExpirationOperator(Operator):
         #    eviction state, join_with_expiration.rs:420-430) — accepted
         #    as parity behavior for expired-state edge cases
         if opp_outer and have_opp:
-            mine_all = mine.all()
             batch_keys = np.unique(batch.key_hash)
-            if mine_all is not None and len(mine_all):
-                new_keys = batch_keys[~np.isin(batch_keys,
-                                               mine_all.key_hash)]
-            else:
-                new_keys = batch_keys
+            new_keys = batch_keys[~mine.contains_keys(batch_keys)]
             if len(new_keys):
                 hit = np.isin(opp.key_hash, new_keys)
                 if hit.any():
@@ -1047,7 +1073,8 @@ def _build_window(op: LogicalOperator) -> Operator:
 def _build_topn(op: LogicalOperator) -> Operator:
     s = op.spec
     return TumblingTopNOperator(op.name, s.width_micros, s.max_elements,
-                                s.sort_column, s.partition_cols, s.projection)
+                                s.sort_column, s.partition_cols, s.projection,
+                                getattr(s, "rank_column", None))
 
 
 @register_builder(OpKind.WINDOW_JOIN)

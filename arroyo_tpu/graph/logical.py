@@ -208,14 +208,18 @@ class TumblingAggregatorSpec:
 
 @dataclass
 class TopNSpec:
-    """Operator::TumblingTopN (tumbling_top_n_window.rs)."""
+    """Operator::TumblingTopN (tumbling_top_n_window.rs).
+
+    ``max_elements=None`` ranks without pruning; ``rank_column`` emits
+    the 1-based per-partition rank (a materialized ROW_NUMBER())."""
 
     width_micros: int
-    max_elements: int
+    max_elements: Optional[int]
     # expression extracting the sort key column(s); descending order
     sort_column: str = ""
     partition_cols: Tuple[str, ...] = ()
     projection: Optional[ColumnExpr] = None
+    rank_column: Optional[str] = None
 
 
 @dataclass

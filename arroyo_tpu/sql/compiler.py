@@ -82,12 +82,16 @@ class Schema:
     # physical column, so `r.id` resolves to the collision-renamed `r_id`
     # instead of falling back to the left side's `id`
     qualified: Dict[Tuple[str, str], str] = field(default_factory=dict)
+    # structs whose presence a preceding `WHERE s IS NOT NULL` filter
+    # guarantees: field loads skip the presence mask (and projections skip
+    # NULL materialization — the hot-path case for nexmark struct fields)
+    presence_guaranteed: Set[str] = field(default_factory=set)
 
     def clone(self) -> "Schema":
         return Schema(dict(self.columns), dict(self.structs),
                       set(self.aliases), self.window, set(self.window_names),
                       self.event_time_col, self.source_used,
-                      dict(self.qualified))
+                      dict(self.qualified), set(self.presence_guaranteed))
 
     def is_string(self, col: str) -> bool:
         return self.columns.get(col) == "s"
@@ -246,6 +250,8 @@ class ExprCompiler:
                 sd = self._struct_of_field(target)
                 pcpv = ((sd.presence_col, sd.presence_val)
                         if sd is not None and sd.presence_col is not None
+                        and sd.name.lower() not in
+                        self.schema.presence_guaranteed
                         else None)
                 if pcpv is not None:
                     self.used_cols.add(pcpv[0])

@@ -48,6 +48,26 @@ MICROS = {
 }
 
 
+def duration_text_micros(text: str) -> int:
+    """'3 second' / '1 day 2 hours' / '30 seconds' -> micros.  The single
+    shared duration parser: INTERVAL literals and the reference-style bare
+    duration strings (session('30 seconds')) both route here."""
+    parts = text.strip().split()
+    if len(parts) < 2 or len(parts) % 2:
+        raise SqlParseError(f"cannot parse duration {text!r}")
+    micros = 0
+    for i in range(0, len(parts), 2):
+        unit = parts[i + 1].lower()
+        if unit not in MICROS:
+            raise SqlParseError(f"unknown interval unit {parts[i + 1]!r}")
+        try:
+            qty = float(parts[i])
+        except ValueError:
+            raise SqlParseError(f"cannot parse duration {text!r}")
+        micros += int(qty * MICROS[unit])
+    return micros
+
+
 class SqlParseError(ValueError):
     pass
 
@@ -508,30 +528,13 @@ class Parser:
         text = t.value.strip()
         # forms: '2' SECOND | '3 second' | '1 day 2 hours'
         parts = text.split()
-        micros = 0
         if len(parts) == 1:
-            qty = float(parts[0])
             unit_tok = self.peek()
-            if unit_tok.kind in ("ident", "kw"):
-                unit = self.next().value.lower()
-                micros = int(qty * MICROS[unit.rstrip("s") + ("s" if unit.endswith("s") else "")
-                                          if unit in MICROS else unit])
-                if unit not in MICROS:
-                    raise SqlParseError(f"unknown interval unit {unit}")
-                micros = int(qty * MICROS[unit])
-            else:
+            if unit_tok.kind not in ("ident", "kw"):
                 raise SqlParseError("interval missing unit")
-        else:
-            i = 0
-            while i < len(parts):
-                qty = float(parts[i])
-                unit = parts[i + 1].lower()
-                if unit not in MICROS:
-                    raise SqlParseError(f"unknown interval unit {unit}")
-                micros += int(qty * MICROS[unit])
-                i += 2
-            # optional trailing unit token ('10' minute written inside string)
-        return IntervalLit(micros)
+            unit = self.next().value.lower()
+            return IntervalLit(duration_text_micros(f"{parts[0]} {unit}"))
+        return IntervalLit(duration_text_micros(text))
 
     def parse_case(self) -> Case:
         operand = None

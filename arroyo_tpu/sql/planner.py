@@ -116,6 +116,15 @@ def _window_from_call(fc: FunctionCall):
     def micros(arg):
         if isinstance(arg, IntervalLit):
             return arg.micros
+        if isinstance(arg, Literal) and arg.type == "string":
+            # the reference accepts bare duration strings in window
+            # functions: session('30 seconds')
+            from .parser import SqlParseError, duration_text_micros
+
+            try:
+                return duration_text_micros(arg.value)
+            except SqlParseError as e:
+                raise SqlPlanError(str(e))
         raise SqlPlanError(f"{fc.name}() arguments must be INTERVALs")
 
     if fc.name == "tumble":
@@ -386,6 +395,50 @@ class Planner:
         if remaining_where is not None:
             upstream = self._filter(upstream, remaining_where, "where")
 
+        # top-level ROW_NUMBER() OVER (...) with no outer filter shape:
+        # rank-only per-window TopN (no pruning), the rank materialized
+        # as a column and the select item rewritten to read it
+        rn_top = [(i, it) for i, it in enumerate(sel.items)
+                  if isinstance(it.expr, FunctionCall)
+                  and it.expr.name == "row_number"
+                  and it.expr.over is not None]
+        if rn_top and rewritten is None:
+            from dataclasses import replace as _replace
+
+            if len(rn_top) > 1:
+                raise SqlPlanError(
+                    "only one ROW_NUMBER() per query is supported")
+            # only aggregate-free selects qualify: with aggregates the
+            # rank would bind to the pre-aggregation stream (the sort
+            # column does not exist there) — fall through so the agg
+            # collector reports the unsupported OVER shape instead
+            rn_idxs = {i for i, _ in rn_top}
+            sel_no_rn = _replace(sel, items=[
+                it for i, it in enumerate(sel.items) if i not in rn_idxs])
+            if _has_aggregates(sel_no_rn):
+                rn_top = []
+        if rn_top and rewritten is None:
+            from dataclasses import replace as _replace
+
+            idx, it = rn_top[0]
+            alias = (it.alias or "row_number").lower()
+            over = it.expr.over
+            if not over.order_by or len(over.order_by) != 1 \
+                    or not isinstance(over.order_by[0].expr, ColumnRef):
+                raise SqlPlanError(
+                    "ROW_NUMBER() OVER requires ORDER BY a single column")
+            if not over.order_by[0].desc:
+                raise SqlPlanError(
+                    "streaming TopN requires ORDER BY ... DESC")
+            part_cols = self._rownumber_partition(over, upstream.schema)
+            shim = Select(items=[], order_by=[over.order_by[0]], limit=None)
+            upstream = self._plan_top_n(shim, upstream, tuple(part_cols),
+                                        rank_column=alias)
+            new_items = list(sel.items)
+            new_items[idx] = SelectItem(ColumnRef(alias),
+                                        it.alias or "row_number")
+            sel = _replace(sel, items=new_items)
+
         if _has_aggregates(sel):
             planned = self._plan_aggregate(sel, upstream)
         else:
@@ -566,6 +619,20 @@ class Planner:
     # -- filters / projections --------------------------------------------
 
     def _filter(self, planned: Planned, pred: Expr, name: str) -> Planned:
+        # `WHERE s IS NOT NULL` conjuncts guarantee struct presence on
+        # surviving rows: downstream field loads can skip the presence
+        # mask (and the NULL materialization it would force) entirely
+        guaranteed = set()
+        for c in _conjuncts(pred):
+            if isinstance(c, IsNull) and c.negated \
+                    and isinstance(c.operand, ColumnRef):
+                try:
+                    kind, target = planned.schema.resolve(
+                        c.operand, record=False)
+                except SqlCompileError:
+                    continue
+                if kind == "struct":
+                    guaranteed.add(target.name.lower())
         compiled = compile_scalar(pred, planned.schema)
         fn = _wrap_predicate(compiled)
         expr = ColumnExpr(f"{name}_{self._next_id()}", fn,
@@ -577,7 +644,11 @@ class Planner:
                                 ExprReturnType.RECORD)))
         else:
             stream = planned.stream.filter(fn, name=expr.name)
-        return Planned(stream, planned.schema, updating=planned.updating)
+        schema = planned.schema
+        if guaranteed:
+            schema = schema.clone()
+            schema.presence_guaranteed |= guaranteed
+        return Planned(stream, schema, updating=planned.updating)
 
     @staticmethod
     def _host_filter(pred_fn):
@@ -659,6 +730,15 @@ class Planner:
                 is_identity = False
             if not is_identity:
                 identity = False
+
+        # SELECT * over a windowed input expands window_start/window_end as
+        # plain columns — keep the schema's windowness so downstream
+        # ROW_NUMBER()/TopN still sees `window`
+        if schema.window and "window_start" in new_schema.columns \
+                and "window_end" in new_schema.columns \
+                and not new_schema.window:
+            new_schema.window = True
+            new_schema.window_names |= schema.window_names | {"window"}
 
         if identity and not compiled and passthrough:
             # pure struct/window passthrough — no map needed
@@ -866,7 +946,15 @@ class Planner:
 
         if window is None:
             stream = stream.non_window_aggregate(DEFAULT_UPDATING_TTL, aggs)
-            post_updating = True
+            # GROUP BY the window of a windowed input (q5's MaxBids) is a
+            # bounded per-window refinement: treat it as append-only and
+            # DROP the __op column in the post-projection (each upstream
+            # pane fires once, so rows are creates in the common case; a
+            # leaked __op would otherwise reach joins/sinks as a data
+            # column).  Multi-emission refinements join as appends — a
+            # documented approximation (the reference routes the same
+            # shape through its updating join).
+            post_updating = not grouped_by_window
         else:
             post_updating = False
             if needs_generic:
@@ -931,15 +1019,7 @@ class Planner:
             agg_map={name: e.name for name, e in post_items
                      if isinstance(e, ColumnRef) and e.qualifier is None
                      and e.name in agg_outputs} if fusable else None,
-            # GROUP BY the window of a windowed input (q5's MaxBids) is a
-            # bounded per-window refinement, not an open-ended updating
-            # stream: every upstream pane fires once at the watermark, so
-            # in the common single-emission case the re-aggregate is
-            # append-only and downstream joins are safe (the reference
-            # routes the same shape through its updating join; our inner
-            # join treats multi-emission refinements as appends — a known,
-            # documented approximation)
-            updating=post_updating and not grouped_by_window)
+            updating=post_updating)
         if having_rewritten is not None:
             # HAVING compiles against the projected schema: predicates may
             # only reference selected outputs (aggregates referenced in
@@ -1017,6 +1097,14 @@ class Planner:
         if not subs:
             return planned, where
 
+        if planned.updating:
+            # the semi-join key projection strips __op, so retraction rows
+            # from an updating left input would pass as data — reject
+            # (an updating RIGHT subquery is fine: key existence is
+            # monotone under create/update rows)
+            raise SqlPlanError(
+                "IN (SELECT ...) over an updating stream (outer join or "
+                "non-windowed aggregate) is not supported")
         for e in subs:
             if e.negated:
                 raise SqlPlanError(
@@ -1058,7 +1146,7 @@ class Planner:
         Returns (planned-after-topn, remaining where) or None."""
         from dataclasses import replace as _replace
 
-        if not isinstance(sel.from_, DerivedTable) or sel.where is None:
+        if not isinstance(sel.from_, DerivedTable):
             return None
         inner = sel.from_.query
         rn_items = [(i, it) for i, it in enumerate(inner.items)
@@ -1073,23 +1161,27 @@ class Planner:
         rn_alias = (rn_item.alias or "row_number").lower()
         over = rn_item.expr.over
 
-        # outer WHERE: find `rn <= k` / `rn < k` among top-level conjuncts
+        # outer WHERE: find `rn <= k` / `rn < k` / `rn = k` among
+        # top-level conjuncts.  No bound found -> rank-only mode: keep
+        # every row per window partition and materialize the rank column
+        # (bounded by window contents, so still streaming-safe)
         limit = None
         remaining = []
-        for c in _conjuncts(sel.where):
+        for c in (_conjuncts(sel.where) if sel.where is not None else []):
             if (limit is None and isinstance(c, BinaryOp)
-                    and c.op in ("<=", "<")
+                    and c.op in ("<=", "<", "=")
                     and isinstance(c.left, ColumnRef)
                     and c.left.name.lower() == rn_alias
                     and isinstance(c.right, Literal)
                     and c.right.type == "int"):
-                limit = c.right.value if c.op == "<=" else c.right.value - 1
+                limit = (c.right.value - 1 if c.op == "<"
+                         else c.right.value)
+                if c.op == "=" and c.right.value > 1:
+                    # prune to the top k, then filter the exact rank on
+                    # the materialized rank column
+                    remaining.append(c)
             else:
                 remaining.append(c)
-        if limit is None:
-            raise SqlPlanError(
-                "ROW_NUMBER() requires an outer rank bound "
-                f"(WHERE {rn_alias} <= k) in streaming SQL")
         if not over.order_by or len(over.order_by) != 1 \
                 or not isinstance(over.order_by[0].expr, ColumnRef):
             raise SqlPlanError(
@@ -1120,12 +1212,20 @@ class Planner:
             planned = Planned(planned.stream, schema,
                               planned.agg_node, planned.agg_map)
 
-        # partition must include the window; extra simple columns ride as
-        # TopN partition columns
+        part_cols = self._rownumber_partition(over, planned.schema)
+
+        shim = Select(items=[], order_by=[over.order_by[0]], limit=limit)
+        planned = self._plan_top_n(shim, planned, tuple(part_cols),
+                                   rank_column=rn_alias)
+        return planned, _conjoin(remaining)
+
+    def _rownumber_partition(self, over, schema: Schema) -> List[str]:
+        """PARTITION BY must include the window; extra simple columns
+        ride as TopN partition columns."""
         part_cols: List[str] = []
         saw_window = False
         for pe in over.partition_by:
-            if self._is_window_ref(pe, planned.schema):
+            if self._is_window_ref(pe, schema):
                 saw_window = True
             elif isinstance(pe, ColumnRef):
                 part_cols.append(pe.name.lower())
@@ -1137,13 +1237,11 @@ class Planner:
             raise SqlPlanError(
                 "ROW_NUMBER() in streaming SQL must PARTITION BY the "
                 "window (unbounded ranking is not supported)")
-
-        shim = Select(items=[], order_by=[over.order_by[0]], limit=limit)
-        planned = self._plan_top_n(shim, planned, tuple(part_cols))
-        return planned, _conjoin(remaining)
+        return part_cols
 
     def _plan_top_n(self, sel: Select, planned: Planned,
-                    partition_cols: Tuple[str, ...] = ()) -> Planned:
+                    partition_cols: Tuple[str, ...] = (),
+                    rank_column: Optional[str] = None) -> Planned:
         """ORDER BY ... LIMIT n over a windowed stream -> per-window TopN
         (the reference's window-TopN rewrite, optimizations.rs:293-501).
 
@@ -1189,7 +1287,9 @@ class Planner:
             # projection using the internal agg output name
             node = stream.program.node(planned.agg_node)
             sort_col = planned.agg_map[col]
-        if node is not None:
+        if node is not None and sel.limit is not None:
+            # rank-only mode (limit None) cannot prune locally — the
+            # fusion only applies when a bound exists
             spec = node.operator.spec
             slide = getattr(spec, "slide_micros", spec.width_micros)
             node.operator.kind = OpKind.SLIDING_AGGREGATING_TOP_N
@@ -1204,14 +1304,20 @@ class Planner:
             # depend on it being 1 at plan time
 
         # global per-window-instance TopN: a single merging subtask
-        # (pinned across rescales) partitioned by window_end inside TopN
+        # (pinned across rescales) partitioned by window_end inside TopN;
+        # materializes the ROW_NUMBER() column when the query reads it
         stream = stream._chain(LogicalOperator(
             OpKind.TUMBLING_TOP_N, f"topn_{self._next_id()}",
             spec=TopNSpec(width_micros=1, max_elements=sel.limit,
-                          sort_column=col, partition_cols=partition_cols)),
+                          sort_column=col, partition_cols=partition_cols,
+                          rank_column=rank_column)),
             parallelism=1)
         stream.program.node(stream.tail).max_parallelism = 1
-        return Planned(stream, planned.schema)
+        schema = planned.schema
+        if rank_column is not None:
+            schema = schema.clone()
+            schema.columns[rank_column] = "i"
+        return Planned(stream, schema)
 
     # -- joins -------------------------------------------------------------
 
@@ -1279,18 +1385,29 @@ class Planner:
                 lspec, rspec, name=f"join_{self._next_id()}")
 
         schema = Schema(aliases=left.schema.aliases | right.schema.aliases)
-        # qualified refs bind to their own side even when a collision
-        # renamed the right column (r.id -> r_id)
-        for a in left.schema.aliases:
-            for c in lcols:
-                schema.qualified[(a.lower(), c.lower())] = c
         for c in lcols:
             schema.columns[c] = left.schema.columns[c]
+        rename: Dict[str, str] = {}
         for c in rcols:
             name = c if c not in schema.columns else f"r_{c}"
             schema.columns[name] = right.schema.columns[c]
-            for a in right.schema.aliases:
-                schema.qualified[(a.lower(), c.lower())] = name
+            rename[c] = name
+        # qualified refs bind to their own side even when a collision
+        # renamed the right column (r.id -> r_id).  Child bindings are
+        # inherited FIRST (remapped through this join's renames) so that
+        # in nested joins an inner alias keeps pointing at its own
+        # column; the blanket per-alias mapping below only fills gaps.
+        for key, phys in left.schema.qualified.items():
+            schema.qualified[key] = phys  # left names survive unchanged
+        for key, phys in right.schema.qualified.items():
+            schema.qualified[key] = rename.get(phys, phys)
+        for a in left.schema.aliases:
+            for c in lcols:
+                schema.qualified.setdefault((a.lower(), c.lower()), c)
+        for a in right.schema.aliases:
+            for c in rcols:
+                schema.qualified.setdefault((a.lower(), c.lower()),
+                                            rename[c])
         schema.structs = {**right.schema.structs, **left.schema.structs}
         # pushdown: columns resolved against the JOINED schema may come
         # from either side's source — record into both sides' used sets

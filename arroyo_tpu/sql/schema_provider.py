@@ -58,19 +58,22 @@ def nexmark_table(config: Dict[str, Any]) -> TableDef:
         columns={
             "event_type": "i",
             "person_id": "i", "person_name": "s", "person_email": "s",
-            "person_city": "s", "person_state": "s",
+            "person_city": "s", "person_state": "s", "person_extra": "s",
             "auction_id": "i", "auction_seller": "i", "auction_category": "i",
             "auction_initial_bid": "i", "auction_reserve": "i",
             "auction_expires": "t", "auction_datetime": "t",
             "auction_item_name": "s", "auction_description": "s",
+            "auction_extra": "s",
             "bid_auction": "i", "bid_bidder": "i", "bid_price": "i",
             "bid_datetime": "t", "bid_channel": "s", "bid_url": "s",
+            "bid_extra": "s",
         },
         structs={
             "person": StructDef("person", {
                 "id": "person_id", "name": "person_name",
                 "email_address": "person_email", "city": "person_city",
                 "state": "person_state", "datetime": "__timestamp",
+                "extra": "person_extra",
             }, "event_type", 0),
             "auction": StructDef("auction", {
                 "id": "auction_id", "seller": "auction_seller",
@@ -80,11 +83,13 @@ def nexmark_table(config: Dict[str, Any]) -> TableDef:
                 "datetime": "auction_datetime",
                 "item_name": "auction_item_name",
                 "description": "auction_description",
+                "extra": "auction_extra",
             }, "event_type", 1),
             "bid": StructDef("bid", {
                 "auction": "bid_auction", "bidder": "bid_bidder",
                 "price": "bid_price", "datetime": "bid_datetime",
                 "channel": "bid_channel", "url": "bid_url",
+                "extra": "bid_extra",
             }, "event_type", 2),
         },
     )

@@ -258,10 +258,13 @@ class BatchBuffer:
     def __init__(self) -> None:
         self._pending: List[Batch] = []
         self._merged: Optional[Batch] = None
+        self._keys: Optional[set] = None  # lazy incremental key-hash set
 
     def append(self, batch: Batch) -> None:
         if len(batch):
             self._pending.append(batch)
+            if self._keys is not None and batch.key_hash is not None:
+                self._keys.update(batch.key_hash.tolist())
 
     def _consolidate(self) -> Optional[Batch]:
         if self._pending:
@@ -285,10 +288,26 @@ class BatchBuffer:
         if m is None:
             return
         mask = m.timestamp >= time
-        self._merged = m.select(mask) if not mask.all() else m
+        if not mask.all():
+            self._merged = m.select(mask)
+            self._keys = None  # rows left: rebuild membership lazily
 
     def all(self) -> Optional[Batch]:
         return self._consolidate()
+
+    def contains_keys(self, key_hashes: np.ndarray) -> np.ndarray:
+        """Per-element membership of ``key_hashes`` among buffered rows'
+        key hashes — incremental (set updated on append, rebuilt only
+        after an eviction actually dropped rows), so outer-join
+        first-match checks cost O(batch), not O(buffer) per batch."""
+        if self._keys is None:
+            m = self._consolidate()
+            self._keys = (set(m.key_hash.tolist())
+                          if m is not None and m.key_hash is not None
+                          else set())
+        s = self._keys
+        return np.fromiter((int(k) in s for k in key_hashes.tolist()),
+                           dtype=bool, count=len(key_hashes))
 
     def remove_keys(self, key_hashes: np.ndarray) -> None:
         """Drop buffered rows whose key_hash is in ``key_hashes`` (used by
@@ -297,7 +316,9 @@ class BatchBuffer:
         if m is None or len(m) == 0 or m.key_hash is None:
             return
         keep = ~np.isin(m.key_hash, key_hashes)
-        self._merged = m.select(keep) if not keep.all() else m
+        if not keep.all():
+            self._merged = m.select(keep)
+            self._keys = None
 
     def __len__(self) -> int:
         m = self._consolidate()
@@ -310,6 +331,7 @@ class BatchBuffer:
     def restore_batch(self, batch: Optional[Batch]) -> None:
         self._merged = batch
         self._pending.clear()
+        self._keys = None
 
 
 class DeviceTable:

@@ -650,17 +650,28 @@ def test_exec_row_number_topn_canonical_q5():
         assert sorted(nums, reverse=True) == top, (wend, nums, top)
 
 
-def test_row_number_requires_rank_bound():
+def test_row_number_without_bound_is_rank_only():
+    """ROW_NUMBER() with no outer rank bound plans as rank-only TopN
+    (ranks materialized, nothing pruned) — the reference's bare
+    `row_number` query shape.  ASC ordering still rejects."""
     p = SchemaProvider()
     p.add_memory_table("b", {"a": "i"}, [
         Batch(np.arange(3, dtype=np.int64), {"a": np.arange(3)})])
-    with pytest.raises(Exception, match="rank bound|row_number|rn"):
+    prog = plan_sql("""
+    SELECT a FROM (
+      SELECT a, count(*) as num, TUMBLE(INTERVAL '1' SECOND) as window,
+             ROW_NUMBER() OVER (PARTITION BY window
+                                ORDER BY num DESC) as rn
+      FROM b GROUP BY 1, 3) WHERE num > 0
+    """, p)
+    assert not prog.validate()
+    with pytest.raises(Exception, match="DESC"):
         plan_sql("""
         SELECT a FROM (
           SELECT a, count(*) as num, TUMBLE(INTERVAL '1' SECOND) as window,
                  ROW_NUMBER() OVER (PARTITION BY window
-                                    ORDER BY num DESC) as rn
-          FROM b GROUP BY 1, 3) WHERE num > 0
+                                    ORDER BY num ASC) as rn
+          FROM b GROUP BY 1, 3) WHERE rn <= 2
         """, p)
 
 

@@ -138,7 +138,9 @@ let history = {};          // op -> {t, sent, rates: []}
 // ---- SQL + DAG preview ----------------------------------------------------
 
 function layoutDag(g) {
-  // layered left-to-right layout: depth = longest path from a source
+  // layered left-to-right layout (dagre-style): depth = longest path from
+  // a source; per-layer order by barycenter sweeps (median of neighbor
+  // positions) so multi-branch pipelines (joins, unions) don't tangle
   const depth = {}, order = {};
   const indeg = {};
   g.nodes.forEach(n => indeg[n.operator_id] = 0);
@@ -146,8 +148,11 @@ function layoutDag(g) {
   const q = g.nodes.filter(n => !indeg[n.operator_id])
                    .map(n => n.operator_id);
   q.forEach(id => depth[id] = 0);
-  const adj = {};
-  g.edges.forEach(e => (adj[e.src] = adj[e.src] || []).push(e.dst));
+  const adj = {}, radj = {};
+  g.edges.forEach(e => {
+    (adj[e.src] = adj[e.src] || []).push(e.dst);
+    (radj[e.dst] = radj[e.dst] || []).push(e.src);
+  });
   while (q.length) {
     const u = q.shift();
     for (const v of adj[u] || []) {
@@ -155,21 +160,42 @@ function layoutDag(g) {
       if (--indeg[v] === 0) q.push(v);
     }
   }
-  const cols = {};
+  const layers = [];
   g.nodes.forEach(n => {
     const d = depth[n.operator_id] || 0;
-    order[n.operator_id] = (cols[d] = (cols[d] || 0) + 1) - 1;
+    (layers[d] = layers[d] || []).push(n.operator_id);
   });
-  return {depth, order};
+  layers.forEach(l => l.forEach((id, i) => order[id] = i));
+  const bary = (id, nbrs) => {
+    const ps = (nbrs[id] || []).map(v => order[v]).filter(p => p != null);
+    return ps.length ? ps.reduce((a, b) => a + b, 0) / ps.length
+                     : order[id];
+  };
+  for (let sweep = 0; sweep < 4; sweep++) {
+    const nbrs = sweep % 2 ? adj : radj;  // down then up passes
+    const idxs = sweep % 2
+      ? [...layers.keys()].reverse() : [...layers.keys()];
+    for (const d of idxs) {
+      layers[d].sort((a, b) => bary(a, nbrs) - bary(b, nbrs));
+      layers[d].forEach((id, i) => order[id] = i);
+    }
+  }
+  // vertically center short layers against the tallest one
+  const maxRows = Math.max(...layers.map(l => l.length));
+  const offset = {};
+  layers.forEach(l => l.forEach(
+    id => offset[id] = (maxRows - l.length) / 2));
+  return {depth, order, offset};
 }
 
 function renderDag(g) {
-  const {depth, order} = layoutDag(g);
+  const {depth, order, offset} = layoutDag(g);
   const W = 210, H = 54, GX = 60, GY = 16;
   const pos = {};
   let maxd = 0, maxr = 0;
   g.nodes.forEach(n => {
-    const d = depth[n.operator_id] || 0, r = order[n.operator_id] || 0;
+    const d = depth[n.operator_id] || 0;
+    const r = (order[n.operator_id] || 0) + (offset[n.operator_id] || 0);
     pos[n.operator_id] = {x: d * (W + GX) + 10, y: r * (H + GY) + 12};
     maxd = Math.max(maxd, d); maxr = Math.max(maxr, r);
   });
@@ -327,12 +353,36 @@ async function pollJob() {
   }
 }
 
+async function seedHistory(pid, jid) {
+  // persistent server-side history (sqlite sampler): charts survive a
+  // page reload instead of starting empty
+  try {
+    const r = await fetch(
+      `/v1/pipelines/${pid}/jobs/${jid}/metrics_history`);
+    if (!r.ok) return;
+    const j = await r.json();
+    for (const s of j.data || []) {
+      const pts = s.points || [];
+      if (!pts.length) continue;
+      const rates = [];
+      for (let i = 1; i < pts.length; i++) {
+        const dt = pts[i][0] - pts[i-1][0];
+        if (dt > 0) rates.push(Math.max(0, (pts[i][1] - pts[i-1][1]) / dt));
+      }
+      const last = pts[pts.length - 1];
+      history[s.operator_id] = {
+        t: performance.now() / 1000, sent: last[1],
+        rates: rates.slice(-60)};
+    }
+  } catch (e) { /* history is best-effort */ }
+}
+
 function watch(pid, jid) {
   watching = {pid, jid};
   history = {};
   $('jobinfo').textContent = `(${jid})`;
   $('charts').dataset.built = '';
-  pollJob();
+  seedHistory(pid, jid).then(pollJob);
 }
 
 // ---- SSE output tail ------------------------------------------------------

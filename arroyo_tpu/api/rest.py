@@ -17,7 +17,9 @@ truth for live job state.
 
 from __future__ import annotations
 
+import asyncio
 import json
+import logging
 import sqlite3
 import time
 import uuid
@@ -29,6 +31,8 @@ from ..controller.state_machine import JobState
 from ..sql import Planner, SchemaProvider, SqlPlanError
 from ..sql.compiler import SqlCompileError
 from .http import HttpError, HttpServer, Request, Router, SseResponse
+
+logger = logging.getLogger(__name__)
 
 _SCHEMA = """
 CREATE TABLE IF NOT EXISTS pipelines (
@@ -51,6 +55,15 @@ CREATE TABLE IF NOT EXISTS job_log (
     level TEXT NOT NULL,
     message TEXT NOT NULL
 );
+CREATE TABLE IF NOT EXISTS metrics_history (
+    job_id TEXT NOT NULL,
+    operator_id TEXT NOT NULL,
+    ts REAL NOT NULL,
+    messages_sent REAL NOT NULL,
+    backpressure REAL NOT NULL
+);
+CREATE INDEX IF NOT EXISTS metrics_history_job
+    ON metrics_history (job_id, operator_id, ts);
 CREATE TABLE IF NOT EXISTS connection_profiles (
     id TEXT PRIMARY KEY,
     name TEXT UNIQUE NOT NULL,
@@ -83,13 +96,73 @@ class ApiServer:
         self.http = HttpServer(self.router)
         self.port: Optional[int] = None
 
+    # metrics-history sampler cadence / retention (persistent per-job
+    # history the console can reload — arroyo-api queries Prometheus with
+    # rate() for this, metrics.rs:42-60; here the API owns the store)
+    METRICS_SAMPLE_SECS = 2.0
+    METRICS_RETENTION_SECS = 3600.0
+
     async def start(self, host: str = "127.0.0.1", port: int = 0) -> int:
         self.port = await self.http.start(host, port)
+        self._sampler = asyncio.ensure_future(self._sample_metrics_loop())
         return self.port
 
     async def stop(self) -> None:
+        sampler = getattr(self, "_sampler", None)
+        if sampler is not None:
+            sampler.cancel()
         await self.http.stop()
         self.db.close()
+
+    # -- metrics history ----------------------------------------------------
+
+    def _scrape_job_metrics(self, jid: str) -> Dict[str, Dict[str, float]]:
+        """{operator_id: {messages_sent, backpressure}} from the in-process
+        prometheus registry."""
+        from ..obs import metrics as m
+
+        out: Dict[str, Dict[str, float]] = {}
+        for fam in m.REGISTRY.collect():
+            if not fam.name.startswith("arroyo_worker_"):
+                continue
+            for s in fam.samples:
+                if s.name.endswith("_created") \
+                        or s.labels.get("job_id") != jid:
+                    continue
+                op = s.labels.get("operator_id", "")
+                g = out.setdefault(op, {"messages_sent": 0.0,
+                                        "qsize": 0.0, "qrem": 0.0})
+                if s.name.startswith("arroyo_worker_messages_sent"):
+                    g["messages_sent"] += s.value
+                elif s.name.startswith("arroyo_worker_tx_queue_size"):
+                    g["qsize"] += s.value
+                elif s.name.startswith("arroyo_worker_tx_queue_rem"):
+                    g["qrem"] += s.value
+        for g in out.values():
+            g["backpressure"] = (1 - g["qrem"] / g["qsize"]
+                                 if g["qsize"] > 0 else 0.0)
+        return out
+
+    async def _sample_metrics_loop(self) -> None:
+        while True:
+            try:
+                await asyncio.sleep(self.METRICS_SAMPLE_SECS)
+                now = time.time()
+                for jid in list(self.controller.jobs):
+                    for op, g in self._scrape_job_metrics(jid).items():
+                        self.db.execute(
+                            "INSERT INTO metrics_history VALUES "
+                            "(?, ?, ?, ?, ?)",
+                            (jid, op, now, g["messages_sent"],
+                             g["backpressure"]))
+                self.db.execute(
+                    "DELETE FROM metrics_history WHERE ts < ?",
+                    (now - self.METRICS_RETENTION_SECS,))
+                self.db.commit()
+            except asyncio.CancelledError:
+                return
+            except Exception:  # sampling must never kill the server
+                logger.exception("metrics sampler")
 
     # -- planning ----------------------------------------------------------
 
@@ -399,6 +472,22 @@ class ApiServer:
                     g["metrics"][key] = s.value
             return {"data": sorted(groups.values(),
                                    key=lambda g: g["operator_id"])}
+
+        @r.get("/v1/pipelines/{pid}/jobs/{jid}/metrics_history")
+        async def metrics_history(req: Request):
+            """Persistent per-operator history (the API's sampler writes
+            it to sqlite every METRICS_SAMPLE_SECS): the console reloads
+            charts after a refresh instead of starting empty."""
+            jid = req.params["jid"]
+            series: Dict[str, list] = {}
+            for row in self.db.execute(
+                    "SELECT operator_id, ts, messages_sent, backpressure "
+                    "FROM metrics_history WHERE job_id = ? ORDER BY ts",
+                    (jid,)):
+                series.setdefault(row["operator_id"], []).append(
+                    [row["ts"], row["messages_sent"], row["backpressure"]])
+            return {"data": [{"operator_id": op, "points": pts}
+                             for op, pts in sorted(series.items())]}
 
         @r.get("/v1/pipelines/{pid}/jobs/{jid}/output")
         async def job_output(req: Request):

@@ -10,8 +10,12 @@ a transaction that is only committed in the second phase of the checkpoint
 The broker client is pluggable: ``bootstrap_servers='memory://<name>'`` uses
 the in-process :class:`InMemoryKafkaBroker` (the test rig — the reference's
 kafka tests likewise drive a real local broker by hand, kafka/source/test.rs);
-anything else requires aiokafka, which is surfaced as a clear error when the
-library is absent in this environment.
+anything else routes through :class:`AioKafkaBroker`, an aiokafka-backed
+adapter (clear error when the library is absent).  Real-broker integration
+tests live in tests/test_kafka_integration.py (``pytest -m kafka`` with
+``KAFKA_BOOTSTRAP`` set).  Confluent-framed payloads resolve writer schemas
+through :mod:`.schema_registry` when ``format_options.schema_registry_url``
+is configured.
 """
 
 from __future__ import annotations
@@ -92,6 +96,10 @@ class InMemoryKafkaBroker:
         self.create_topic(topic)
         return len(self.topics[topic])
 
+    def latest_offset(self, topic: str, partition: int) -> int:
+        self.create_topic(topic)
+        return len(self.topics[topic][partition].log)
+
     # -- produce ------------------------------------------------------
 
     def produce(self, topic: str, value: bytes, key: Optional[bytes] = None,
@@ -140,6 +148,156 @@ class InMemoryKafkaBroker:
 
 
 # ---------------------------------------------------------------------------
+# Real-broker adapter (aiokafka)
+# ---------------------------------------------------------------------------
+
+
+class AioKafkaBroker:
+    """Adapter exposing the ``InMemoryKafkaBroker`` fetch/produce surface
+    over aiokafka for real brokers (kafka/source/mod.rs + sink analog).
+
+    Methods are coroutines (call sites await when the broker returns an
+    awaitable).  The transactional sink keeps one producer per OPEN
+    transaction: a sealed-but-uncommitted epoch parks its producer until
+    the commit phase, and new inserts draw a fresh producer — Kafka
+    permits one in-flight transaction per producer, and the two-phase
+    protocol overlaps epochs (the reference's rdkafka sink does the
+    same via transactional producer instances)."""
+
+    def __init__(self, bootstrap: str, client_configs: Dict[str, str]):
+        try:
+            import aiokafka  # noqa: F401
+        except ImportError:
+            raise RuntimeError(
+                "real Kafka requires aiokafka (pip install aiokafka); "
+                "use bootstrap_servers='memory://<name>' for the "
+                "in-process broker")
+        self.bootstrap = bootstrap
+        self.client_configs = client_configs
+        self._consumer = None
+        self._isolation = True
+        self._producers: Dict[str, Any] = {}  # txn_id -> started producer
+
+    async def _get_consumer(self, read_committed: bool = True):
+        # the isolation level is fixed at construction: recreate the
+        # consumer if a different level is requested later (read_mode is
+        # per-source, so in practice this happens at most once)
+        if self._consumer is not None and self._isolation != read_committed:
+            await self._consumer.stop()
+            self._consumer = None
+        if self._consumer is None:
+            from aiokafka import AIOKafkaConsumer
+
+            self._consumer = AIOKafkaConsumer(
+                bootstrap_servers=self.bootstrap,
+                enable_auto_commit=False,
+                isolation_level=("read_committed" if read_committed
+                                 else "read_uncommitted"),
+                **self.client_configs)
+            self._isolation = read_committed
+            await self._consumer.start()
+        return self._consumer
+
+    async def partitions(self, topic: str,
+                         read_committed: bool = True) -> int:
+        c = await self._get_consumer(read_committed)
+        parts = c.partitions_for_topic(topic)
+        if not parts:
+            # topic metadata may not be cached yet: .topics() forces a
+            # metadata fetch (a bare sleep would wait out
+            # metadata_max_age_ms, default 5 min)
+            await c.topics()
+            parts = c.partitions_for_topic(topic)
+        if not parts:
+            # guessing a partition count would silently strand data on
+            # the unguessed partitions for the lifetime of the job
+            raise RuntimeError(
+                f"kafka topic {topic!r} has no partition metadata at "
+                f"{self.bootstrap}; does the topic exist?")
+        return len(parts)
+
+    async def fetch(self, topic: str, partition: int, offset: int,
+                    max_records: int, read_committed: bool = True
+                    ) -> List[_KRecord]:
+        from aiokafka import TopicPartition
+
+        c = await self._get_consumer(read_committed)
+        tp = TopicPartition(topic, partition)
+        if c.assignment() != {tp}:
+            c.assign([tp])
+        c.seek(tp, max(offset, 0))
+        data = await c.getmany(tp, timeout_ms=200, max_records=max_records)
+        return [_KRecord(partition, m.offset, m.key, m.value)
+                for m in data.get(tp, [])]
+
+    # -- transactional produce ----------------------------------------
+
+    async def begin_txn(self, txn_id: str) -> None:
+        from aiokafka import AIOKafkaProducer
+
+        prod = AIOKafkaProducer(
+            bootstrap_servers=self.bootstrap, transactional_id=txn_id,
+            **self.client_configs)
+        await prod.start()
+        await prod.begin_transaction()
+        self._producers[txn_id] = prod
+
+    async def produce_txn(self, txn_id: str, topic: str, value: bytes,
+                          key: Optional[bytes] = None,
+                          partition: Optional[int] = None) -> None:
+        await self._producers[txn_id].send(topic, value=value, key=key,
+                                           partition=partition)
+
+    async def commit_txn(self, txn_id: str) -> None:
+        prod = self._producers.pop(txn_id, None)
+        if prod is None:
+            # a pre-committed epoch recovered after a crash: Kafka's
+            # transaction protocol cannot commit a previous producer
+            # incarnation's transaction — re-initializing the
+            # transactional id FENCES and ABORTS it (aiokafka exposes no
+            # resume API; the reference's rdkafka sink shares this
+            # limitation).  Failing loudly keeps the loss visible instead
+            # of silently dropping the epoch while offsets advance.
+            raise RuntimeError(
+                f"cannot commit recovered kafka transaction {txn_id!r}: "
+                "the producing session died before its commit phase and "
+                "Kafka aborts in-flight transactions on producer "
+                "re-initialization; the epoch's rows were not published")
+        await prod.commit_transaction()
+        await prod.stop()
+
+    async def abort_txn(self, txn_id: str) -> None:
+        prod = self._producers.pop(txn_id, None)
+        if prod is not None:
+            await prod.abort_transaction()
+            await prod.stop()
+
+    async def close(self) -> None:
+        if self._consumer is not None:
+            await self._consumer.stop()
+            self._consumer = None
+        for txn in list(self._producers):
+            await self.abort_txn(txn)
+
+
+def make_broker(bootstrap_servers: str, client_configs: Dict[str, str]):
+    """memory:// -> in-process broker; anything else -> aiokafka."""
+    if bootstrap_servers.startswith("memory://"):
+        return InMemoryKafkaBroker.get(bootstrap_servers[len("memory://"):])
+    return AioKafkaBroker(bootstrap_servers, client_configs)
+
+
+async def _aw(v):
+    """Await-tolerant call result: the in-memory broker is sync, the
+    aiokafka adapter returns coroutines."""
+    import inspect
+
+    if inspect.isawaitable(v):
+        return await v
+    return v
+
+
+# ---------------------------------------------------------------------------
 # Source
 # ---------------------------------------------------------------------------
 
@@ -154,19 +312,28 @@ class KafkaSource(SourceOperator):
         # table 's': partition -> last-read offset (source/mod.rs:155-175)
         return [global_table("s", "kafka partition offsets")]
 
-    def _broker(self) -> InMemoryKafkaBroker:
-        bs = self.cfg.bootstrap_servers
-        if bs.startswith("memory://"):
-            return InMemoryKafkaBroker.get(bs[len("memory://"):])
-        raise RuntimeError(
-            "real Kafka requires aiokafka, which is not available in this "
-            "environment; use bootstrap_servers='memory://<name>' or install "
-            "aiokafka")
+    def _broker(self):
+        return make_broker(self.cfg.bootstrap_servers,
+                           self.cfg.client_configs)
 
     async def run(self, ctx: Context) -> SourceFinishType:
         broker = self._broker()
+        try:
+            return await self._run(broker, ctx)
+        finally:
+            closer = getattr(broker, "close", None)
+            if closer is not None:
+                await _aw(closer())
+
+    async def _run(self, broker, ctx: Context) -> SourceFinishType:
         state = ctx.state.get_global_keyed_state("s")
-        n_parts = broker.partitions(self.cfg.topic)
+        read_committed = self.cfg.read_mode == "read_committed"
+        # real-broker adapter: create the consumer at the configured
+        # isolation level up front (it is fixed per consumer instance)
+        warm = getattr(broker, "_get_consumer", None)
+        if warm is not None:
+            await warm(read_committed)
+        n_parts = await _aw(broker.partitions(self.cfg.topic))
         me, n = ctx.task_info.task_index, ctx.task_info.parallelism
         my_parts = [p for p in range(n_parts) if p % n == me]
         if not my_parts:
@@ -178,20 +345,21 @@ class KafkaSource(SourceOperator):
             if stored is not None:
                 offsets[p] = stored + 1
             elif self.cfg.offset == "latest":
-                offsets[p] = len(broker.topics[self.cfg.topic][p].log)
+                offsets[p] = await _aw(
+                    broker.latest_offset(self.cfg.topic, p))
             else:
                 offsets[p] = 0
 
         runner = getattr(ctx, "_runner", None)
         batch_size = self.cfg.batch_size or config().target_batch_size
-        read_committed = self.cfg.read_mode == "read_committed"
         total = 0
         idle_spins = 0
         while True:
             got = 0
             for p in my_parts:
-                recs = broker.fetch(self.cfg.topic, p, offsets[p], batch_size,
-                                    read_committed)
+                recs = await _aw(broker.fetch(
+                    self.cfg.topic, p, offsets[p], batch_size,
+                    read_committed))
                 if recs:
                     got += len(recs)
                     total += len(recs)
@@ -230,29 +398,27 @@ class KafkaSink(TwoPhaseCommitterSink):
         self.fmt = make_format(self.cfg.format, **self.cfg.format_options)
         self._txn_id: Optional[str] = None
 
-    def _broker(self) -> InMemoryKafkaBroker:
-        bs = self.cfg.bootstrap_servers
-        if bs.startswith("memory://"):
-            return InMemoryKafkaBroker.get(bs[len("memory://"):])
-        raise RuntimeError(
-            "real Kafka requires aiokafka, which is not available in this "
-            "environment; use bootstrap_servers='memory://<name>'")
+    def _broker(self):
+        if getattr(self, "_b", None) is None:
+            self._b = make_broker(self.cfg.bootstrap_servers,
+                                  self.cfg.client_configs)
+        return self._b
 
     async def committer_init(self, recovery_state, ctx: Context) -> None:
         self._subtask = ctx.task_info.task_index
 
-    def _ensure_txn(self) -> str:
+    async def _ensure_txn(self) -> str:
         if self._txn_id is None:
             self._txn_id = (f"arroyo-{self.cfg.topic}-{self._subtask}-"
                             f"{next(self._txn_counter)}")
-            self._broker().begin_txn(self._txn_id)
+            await _aw(self._broker().begin_txn(self._txn_id))
         return self._txn_id
 
     async def insert_batch(self, batch, ctx: Context) -> None:
-        txn = self._ensure_txn()
+        txn = await self._ensure_txn()
         broker = self._broker()
         for payload in self.fmt.serialize_batch(batch):
-            broker.produce_txn(txn, self.cfg.topic, payload)
+            await _aw(broker.produce_txn(txn, self.cfg.topic, payload))
 
     async def committer_checkpoint(self, epoch: int, stopping: bool,
                                    ctx: Context):
@@ -265,15 +431,19 @@ class KafkaSink(TwoPhaseCommitterSink):
     async def committer_commit(self, epoch: int, pre_commits, ctx: Context) -> None:
         broker = self._broker()
         for pc in pre_commits.values():
-            broker.commit_txn(pc["txn_id"])
+            await _aw(broker.commit_txn(pc["txn_id"]))
 
     async def on_close(self, ctx: Context) -> None:
         # stream ended without a final barrier: commit the dangling txn so
         # graceful end-of-data flushes (barrier-stopped runs never hit this
         # with an open txn)
         if self._txn_id is not None:
-            self._broker().commit_txn(self._txn_id)
+            await _aw(self._broker().commit_txn(self._txn_id))
             self._txn_id = None
+        broker = getattr(self, "_b", None)
+        closer = getattr(broker, "close", None)
+        if closer is not None:
+            await _aw(closer())
 
 
 register_connector(ConnectorMeta(

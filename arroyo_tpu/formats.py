@@ -435,12 +435,26 @@ class AvroFormat(Format):
 
     def __init__(self, schema: Optional[Dict[str, Any]] = None,
                  confluent_schema_registry: bool = False,
-                 schema_id: int = 0, **_ignored):
+                 schema_id: int = 0,
+                 schema_registry_url: Optional[str] = None,
+                 subject: Optional[str] = None, **_ignored):
         if isinstance(schema, str):
             schema = json.loads(schema)
         self.schema = schema
-        self.confluent = confluent_schema_registry
+        self.confluent = confluent_schema_registry or bool(
+            schema_registry_url)
         self.schema_id = schema_id
+        self.registry_url = schema_registry_url
+        self.subject = subject
+        # schema-json -> registered id (inferred schemas can change
+        # batch to batch, so memoize per schema, not per instance)
+        self._registered: Dict[str, int] = {}
+        self._fts_by_id: Dict[int, List[Tuple[str, str]]] = {}
+
+    def _registry(self):
+        from .connectors.schema_registry import registry_client
+
+        return registry_client(self.registry_url)
 
     SUPPORTED = {"boolean", "int", "long", "float", "double", "string",
                  "bytes"}
@@ -495,10 +509,22 @@ class AvroFormat(Format):
         # no configured schema: infer per call (Format contract says
         # stateless; a job needing a stable cross-batch schema must
         # configure one)
-        fts = self._field_types(self.schema
-                                or avro_schema_for_rows(rows))
+        schema = self.schema or avro_schema_for_rows(rows)
+        fts = self._field_types(schema)
         out = []
-        header = (b"\x00" + self.schema_id.to_bytes(4, "big")
+        sid = self.schema_id
+        if self.registry_url:
+            # register (memoized per schema text — the inferred schema
+            # can change batch to batch); the returned global id rides
+            # the confluent wire header so any registry-aware consumer
+            # can resolve the writer schema
+            text = json.dumps(schema, sort_keys=True)
+            if text not in self._registered:
+                self._registered[text] = self._registry().register(
+                    self.subject or f"{schema.get('name', 'record')}-value",
+                    schema)
+            sid = self._registered[text]
+        header = (b"\x00" + sid.to_bytes(4, "big")
                   if self.confluent else b"")
         for r in rows:
             buf = bytearray(header)
@@ -530,12 +556,29 @@ class AvroFormat(Format):
         return (raw if t == "bytes" else raw.decode()), pos + n
 
     def deserialize(self, payloads: Sequence[bytes]) -> List[Dict[str, Any]]:
-        fts = self._field_types()
+        own_fts = self._field_types() if self.schema is not None else None
         rows = []
         for p in payloads:
             # confluent framing guard (mirrors JsonFormat): only strip the
             # 5-byte header when it is actually present
             pos = 5 if (self.confluent and len(p) >= 5 and p[0] == 0) else 0
+            if pos and self.registry_url:
+                # resolve the WRITER schema from the header id — payloads
+                # may be written under a different (evolved) schema than
+                # the table DDL declares.  Per-payload, memoized by id, so
+                # a framed payload's schema never leaks onto an unframed
+                # neighbor in the same batch
+                sid = int.from_bytes(p[1:5], "big")
+                fts = self._fts_by_id.get(sid)
+                if fts is None:
+                    fts = self._field_types(self._registry().get_schema(sid))
+                    self._fts_by_id[sid] = fts
+            else:
+                fts = own_fts
+            if fts is None:
+                raise ValueError(
+                    "avro format needs a schema (or a schema_registry_url "
+                    "with confluent framing)")
             row: Dict[str, Any] = {}
             for name, t in fts:
                 branch, pos = _zigzag_decode(p, pos)

@@ -605,6 +605,7 @@ class KeyedBinState:
             "bin_vals": values[:, :n][:, :, cols],
             "bin_counts": counts[:n][:, cols],
             "ch_init": channel_inits(self._ch_kinds),
+            "mesh_shards": np.array([1], dtype=np.int64),
             "key_sorted": self.key_sorted,
             "slot_of_sorted": self.slot_of_sorted,
             "slot_to_key": self.slot_to_key[:n],

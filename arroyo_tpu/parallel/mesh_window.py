@@ -669,6 +669,10 @@ class MeshKeyedBinState:
             "bin_vals": bins[:, real][:, :, first:first + span],
             "bin_counts": counts[real][:, first:first + span],
             "ch_init": channel_inits(self._ch_kinds),
+            # provenance marker (ignored by restore — the format is
+            # topology-independent): lets tests/operators verify a
+            # checkpoint was written by an N-shard mesh state
+            "mesh_shards": np.array([self.nk], dtype=np.int64),
             "key_sorted": self.key_sorted,
             "slot_of_sorted": self.slot_of_sorted,
             "slot_to_key": self.slot_to_key[:self.next_slot],

@@ -361,3 +361,52 @@ def test_rest_rescale_running_pipeline(api_env):
             assert job["state"] == "Finished", job
 
     _run(loop, scenario())
+
+
+def test_rest_metrics_history_persists(api_env):
+    """The API's sampler writes per-operator metrics history to sqlite
+    and serves it back — a fresh console session (no in-browser state)
+    can reconstruct throughput charts for a job that already ran."""
+    loop, controller, base = api_env
+
+    sql = """
+    CREATE TABLE impulse WITH (connector = 'impulse',
+      event_rate = '4000', message_count = '20000', batch_size = '256');
+    SELECT counter, counter * 2 as doubled FROM impulse
+    """
+
+    async def scenario():
+        async with httpx.AsyncClient(base_url=base) as c:
+            r = await c.post("/v1/pipelines",
+                             json={"name": "hist", "query": sql})
+            assert r.status_code == 200, r.text
+            pl = r.json()
+            pid, job_id = pl["id"], pl["jobs"][0]["id"]
+
+            # wait for the job to finish (several sampler ticks elapse)
+            for _ in range(400):
+                r = await c.get("/v1/jobs")
+                job = next(j for j in r.json()["data"]
+                           if j["id"] == job_id)
+                if job["state"] in ("Finished", "Failed"):
+                    break
+                await asyncio.sleep(0.05)
+            assert job["state"] == "Finished", job
+
+            r = await c.get(
+                f"/v1/pipelines/{pid}/jobs/{job_id}/metrics_history")
+            assert r.status_code == 200
+            data = r.json()["data"]
+            assert data, "no metrics history sampled"
+            # cumulative messages_sent must be monotone per operator and
+            # show real progress (the 2s sampler may miss the final tick
+            # before the job leaves the controller, so not the full count)
+            monotone_ok, any_sent = True, 0.0
+            for s in data:
+                pts = s["points"]
+                assert len(pts) >= 1
+                for a, b in zip(pts, pts[1:]):
+                    monotone_ok &= b[1] >= a[1]
+                any_sent = max(any_sent, pts[-1][1])
+            assert monotone_ok and any_sent >= 5000
+    _run(loop, scenario())

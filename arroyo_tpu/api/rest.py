@@ -116,12 +116,13 @@ class ApiServer:
 
     # -- metrics history ----------------------------------------------------
 
-    def _scrape_job_metrics(self, jid: str) -> Dict[str, Dict[str, float]]:
-        """{operator_id: {messages_sent, backpressure}} from the in-process
-        prometheus registry."""
+    @staticmethod
+    def _iter_job_samples(jid: str):
+        """Yield the in-process prometheus samples belonging to one job
+        (the single filtering definition the live endpoint AND the
+        history sampler share — so they cannot drift)."""
         from ..obs import metrics as m
 
-        out: Dict[str, Dict[str, float]] = {}
         for fam in m.REGISTRY.collect():
             if not fam.name.startswith("arroyo_worker_"):
                 continue
@@ -129,15 +130,21 @@ class ApiServer:
                 if s.name.endswith("_created") \
                         or s.labels.get("job_id") != jid:
                     continue
-                op = s.labels.get("operator_id", "")
-                g = out.setdefault(op, {"messages_sent": 0.0,
-                                        "qsize": 0.0, "qrem": 0.0})
-                if s.name.startswith("arroyo_worker_messages_sent"):
-                    g["messages_sent"] += s.value
-                elif s.name.startswith("arroyo_worker_tx_queue_size"):
-                    g["qsize"] += s.value
-                elif s.name.startswith("arroyo_worker_tx_queue_rem"):
-                    g["qrem"] += s.value
+                yield s
+
+    def _scrape_job_metrics(self, jid: str) -> Dict[str, Dict[str, float]]:
+        """{operator_id: {messages_sent, backpressure}} summary."""
+        out: Dict[str, Dict[str, float]] = {}
+        for s in self._iter_job_samples(jid):
+            op = s.labels.get("operator_id", "")
+            g = out.setdefault(op, {"messages_sent": 0.0,
+                                    "qsize": 0.0, "qrem": 0.0})
+            if s.name.startswith("arroyo_worker_messages_sent"):
+                g["messages_sent"] += s.value
+            elif s.name.startswith("arroyo_worker_tx_queue_size"):
+                g["qsize"] += s.value
+            elif s.name.startswith("arroyo_worker_tx_queue_rem"):
+                g["qrem"] += s.value
         for g in out.values():
             g["backpressure"] = (1 - g["qrem"] / g["qsize"]
                                  if g["qsize"] > 0 else 0.0)
@@ -453,23 +460,14 @@ class ApiServer:
             """Per-operator throughput metrics (metrics.rs:42-60 queries
             prometheus rate(arroyo_worker_*); here the registry is
             in-process, so the API scrapes it directly)."""
-            from ..obs import metrics as m
-
             jid = req.params["jid"]
             groups: Dict[str, Dict[str, Any]] = {}
-            for fam in m.REGISTRY.collect():
-                if not fam.name.startswith("arroyo_worker_"):
-                    continue
-                for s in fam.samples:
-                    if s.name.endswith(("_created",)):
-                        continue
-                    if s.labels.get("job_id") != jid:
-                        continue
-                    op = s.labels.get("operator_id", "")
-                    g = groups.setdefault(op, {"operator_id": op,
-                                               "metrics": {}})
-                    key = f"{s.name}[{s.labels.get('subtask_idx', '0')}]"
-                    g["metrics"][key] = s.value
+            for s in self._iter_job_samples(jid):
+                op = s.labels.get("operator_id", "")
+                g = groups.setdefault(op, {"operator_id": op,
+                                           "metrics": {}})
+                key = f"{s.name}[{s.labels.get('subtask_idx', '0')}]"
+                g["metrics"][key] = s.value
             return {"data": sorted(groups.values(),
                                    key=lambda g: g["operator_id"])}
 

@@ -176,6 +176,7 @@ class AioKafkaBroker:
         self.client_configs = client_configs
         self._consumer = None
         self._isolation = True
+        self._positions: Dict[Any, int] = {}  # tp -> next expected offset
         self._producers: Dict[str, Any] = {}  # txn_id -> started producer
 
     async def _get_consumer(self, read_committed: bool = True):
@@ -185,6 +186,7 @@ class AioKafkaBroker:
         if self._consumer is not None and self._isolation != read_committed:
             await self._consumer.stop()
             self._consumer = None
+            self._positions.clear()
         if self._consumer is None:
             from aiokafka import AIOKafkaConsumer
 
@@ -216,6 +218,13 @@ class AioKafkaBroker:
                 f"{self.bootstrap}; does the topic exist?")
         return len(parts)
 
+    async def latest_offset(self, topic: str, partition: int) -> int:
+        from aiokafka import TopicPartition
+
+        c = await self._get_consumer(self._isolation)
+        offs = await c.end_offsets([TopicPartition(topic, partition)])
+        return int(next(iter(offs.values())))
+
     async def fetch(self, topic: str, partition: int, offset: int,
                     max_records: int, read_committed: bool = True
                     ) -> List[_KRecord]:
@@ -223,12 +232,22 @@ class AioKafkaBroker:
 
         c = await self._get_consumer(read_committed)
         tp = TopicPartition(topic, partition)
-        if c.assignment() != {tp}:
-            c.assign([tp])
-        c.seek(tp, max(offset, 0))
-        data = await c.getmany(tp, timeout_ms=200, max_records=max_records)
+        # accumulate the assignment and keep positions: an unconditional
+        # assign+seek would discard aiokafka's prefetch buffer per call
+        if tp not in c.assignment():
+            c.assign(sorted(c.assignment() | {tp}))
+            self._positions.pop(tp, None)
+        want = max(offset, 0)
+        if self._positions.get(tp) != want:
+            c.seek(tp, want)
+        data = await c.getmany(tp, timeout_ms=50, max_records=max_records)
+        recs = data.get(tp, [])
+        if recs:
+            self._positions[tp] = recs[-1].offset + 1
+        else:
+            self._positions[tp] = want
         return [_KRecord(partition, m.offset, m.key, m.value)
-                for m in data.get(tp, [])]
+                for m in recs]
 
     # -- transactional produce ----------------------------------------
 
@@ -333,7 +352,10 @@ class KafkaSource(SourceOperator):
         warm = getattr(broker, "_get_consumer", None)
         if warm is not None:
             await warm(read_committed)
-        n_parts = await _aw(broker.partitions(self.cfg.topic))
+            n_parts = await _aw(broker.partitions(self.cfg.topic,
+                                                  read_committed))
+        else:
+            n_parts = await _aw(broker.partitions(self.cfg.topic))
         me, n = ctx.task_info.task_index, ctx.task_info.parallelism
         my_parts = [p for p in range(n_parts) if p % n == me]
         if not my_parts:

@@ -434,6 +434,11 @@ class SessionWindowOperator(Operator):
 
     async def handle_timer(self, time: int, key: Any, payload: Any,
                            ctx: Context) -> None:
+        # expired sessions accumulate here; the task loop fires every
+        # expired timer synchronously BEFORE handle_watermark, so one
+        # batched emission per watermark replaces a per-session
+        # query+select+aggregate (the dominant cost of session-heavy
+        # streams: O(sessions x buffer) -> O(buffer))
         _, kh, start = key
         sessions = list(self.windows.get(kh) or [])
         fire = [(s, e) for (s, e) in sessions if e <= time]
@@ -443,34 +448,88 @@ class SessionWindowOperator(Operator):
         else:
             self.windows.remove(kh)
             ctx.state.note_delete("v", kh)
-        for (s, e) in fire:
-            rows = self.buffer.query_range(s, e)
-            if rows is None:
+        if not hasattr(self, "_pending_fires"):
+            self._pending_fires = []
+        self._pending_fires.extend((int(kh), s, e) for (s, e) in fire)
+
+    async def _flush_fires(self, ctx: Context) -> None:
+        fires = getattr(self, "_pending_fires", None)
+        if not fires:
+            return
+        self._pending_fires = []
+        rows = self.buffer.query_range(min(s for _, s, _ in fires),
+                                       max(e for _, _, e in fires))
+        if rows is None or not len(rows):
+            return
+        order = np.argsort(rows.key_hash, kind="stable")
+        kh_sorted = rows.key_hash[order]
+        ts_sorted = rows.timestamp[order]
+
+        by_key: Dict[int, List[Tuple[int, int]]] = {}
+        for kh, s, e in fires:
+            by_key.setdefault(kh, []).append((s, e))
+        sel_parts: List[np.ndarray] = []
+        seg_parts: List[np.ndarray] = []
+        seg_kh: List[int] = []
+        seg_s: List[int] = []
+        seg_e: List[int] = []
+        for kh, sess in by_key.items():
+            lo = np.searchsorted(kh_sorted, np.uint64(kh), side="left")
+            hi = np.searchsorted(kh_sorted, np.uint64(kh), side="right")
+            if lo == hi:
                 continue
-            mask = rows.key_hash == np.uint64(kh)
-            rows = rows.select(mask)
-            if not len(rows):
+            sess.sort()
+            t = ts_sorted[lo:hi]
+            starts = np.array([s for s, _ in sess], dtype=np.int64)
+            ends = np.array([e for _, e in sess], dtype=np.int64)
+            si = np.searchsorted(starts, t, side="right") - 1
+            ok = (si >= 0) & (t < ends[np.clip(si, 0, len(sess) - 1)])
+            if not ok.any():
                 continue
-            if self.flatten:
-                cols = dict(rows.columns)
-                cols["window_start"] = np.full(len(rows), s, np.int64)
-                cols["window_end"] = np.full(len(rows), e, np.int64)
-                out = Batch(np.full(len(rows), e - 1, np.int64), cols,
-                            rows.key_hash, rows.key_cols)
-            else:
-                uniq, agg_cols, _, _cnt, _vc = segment_aggregate(
-                    rows.key_hash, rows.timestamp, rows.columns, self.aggs)
-                cols = _first_occurrence_cols(rows, uniq)
-                cols["window_start"] = np.full(len(uniq), s, np.int64)
-                cols["window_end"] = np.full(len(uniq), e, np.int64)
-                cols.update(agg_cols)
-                out = Batch(np.full(len(uniq), e - 1, np.int64), cols,
-                            uniq.astype(np.uint64), rows.key_cols)
-            if self.projection is not None:
-                out = eval_record_expr(self.projection, out)
-            await ctx.collect(out)
+            base = len(seg_kh)
+            seg_parts.append(base + si[ok])
+            sel_parts.append(order[lo:hi][ok])
+            seg_kh.extend(kh for _ in sess)
+            seg_s.extend(s for s, _ in sess)
+            seg_e.extend(e for _, e in sess)
+        if not sel_parts:
+            return
+        sel = np.concatenate(sel_parts)
+        segs = np.concatenate(seg_parts).astype(np.uint64)
+        sub = rows.select(sel)
+        seg_kh_a = np.array(seg_kh, dtype=np.uint64)
+        seg_s_a = np.array(seg_s, dtype=np.int64)
+        seg_e_a = np.array(seg_e, dtype=np.int64)
+
+        if self.flatten:
+            si = segs.astype(np.int64)
+            cols = dict(sub.columns)
+            cols["window_start"] = seg_s_a[si]
+            cols["window_end"] = seg_e_a[si]
+            out = Batch(seg_e_a[si] - 1, cols, sub.key_hash, sub.key_cols)
+        else:
+            uniq, agg_cols, _, _cnt, _vc = segment_aggregate(
+                segs, sub.timestamp, sub.columns, self.aggs)
+            ui = uniq.astype(np.int64)
+            # key columns: first row of each emitted segment
+            cols: Dict[str, np.ndarray] = {}
+            if sub.key_cols:
+                so = np.argsort(segs, kind="stable")
+                seg_sorted = segs[so]
+                _, first = np.unique(seg_sorted, return_index=True)
+                first_rows = so[first]  # aligned with sorted uniq
+                cols = {c: sub.columns[c][first_rows] for c in sub.key_cols
+                        if c in sub.columns}
+            cols["window_start"] = seg_s_a[ui]
+            cols["window_end"] = seg_e_a[ui]
+            cols.update(agg_cols)
+            out = Batch(seg_e_a[ui] - 1, cols, seg_kh_a[ui], sub.key_cols)
+        if self.projection is not None:
+            out = eval_record_expr(self.projection, out)
+        await ctx.collect(out)
 
     async def handle_watermark(self, watermark: int, ctx: Context) -> None:
+        await self._flush_fires(ctx)
         # evict data older than every live session start
         live_starts = [s for _, sessions in self.windows.items()
                        for (s, _) in sessions]

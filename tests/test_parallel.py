@@ -391,3 +391,31 @@ def test_sql_q8_join_mesh_matches_single_device(monkeypatch):
     single_out = _run_sql_q8_shape(monkeypatch, "off")
     assert mesh_out == single_out
     assert len(mesh_out) > 0
+
+
+def test_ring_pane_aggregate_matches_numpy(rng):
+    """Bin-dimension ring parallelism (SURVEY §5 sequence-parallel
+    discipline): sliding pane aggregates over an 8-shard bin ring match
+    the numpy oracle, for halo widths below, at, and beyond one shard
+    block (multiple ppermute rotations)."""
+    from arroyo_tpu.parallel.ring_panes import ring_pane_aggregate
+
+    n, shards = 256, 8  # Bl = 32
+    vals = rng.integers(-50, 100, n).astype(np.float64)
+
+    def oracle(kind, W):
+        out = np.empty(n)
+        for t in range(n):
+            lo = max(t - W + 1, 0)
+            seg = vals[lo:t + 1]
+            out[t] = (seg.sum() if kind == "sum" else
+                      seg.min() if kind == "min" else seg.max())
+        return out
+
+    for W in (1, 7, 32, 33, 100, 256):  # crossing 1, 2, and 4+ shards
+        got = ring_pane_aggregate(vals, W, "sum", shards)
+        np.testing.assert_allclose(got, oracle("sum", W), rtol=1e-12)
+    for kind in ("min", "max"):
+        for W in (7, 33, 100):
+            got = ring_pane_aggregate(vals, W, kind, shards)
+            np.testing.assert_allclose(got, oracle(kind, W))

@@ -347,6 +347,45 @@ def test_mesh_sum_exactness_hot_key(rng):
     assert int(oc["total"][0]) == int(vals.sum())
 
 
+def test_apply_top_n_host_device_boundary_parity(rng):
+    """_apply_top_n routes to the device segment_top_k only at >= 512
+    rows: the kept-row set AND the materialized rank column must agree
+    across the boundary (same data, padded to cross it)."""
+    from arroyo_tpu.engine.operators_window import _apply_top_n
+
+    n = 511
+    part = rng.integers(0, 23, n).astype(np.int64)
+    vals = rng.integers(0, 40, n).astype(np.int64)  # ties included
+
+    def run(nn):
+        b = Batch(np.zeros(nn, dtype=np.int64),
+                  {"p": part[:nn] if nn <= n else np.concatenate(
+                      [part, part[:nn - n]]),
+                   "v": vals[:nn] if nn <= n else np.concatenate(
+                      [vals, vals[:nn - n]])})
+        out = _apply_top_n(b, ("p",), "v", 3, rank_column="rn")
+        return out
+
+    # host path (511) vs device path (512: one duplicated row appended)
+    host = run(511)
+    dev = run(512)
+    def canon(o, limit):
+        return sorted(zip(o.columns["p"].tolist()[:limit],
+                          o.columns["v"].tolist()[:limit],
+                          o.columns["rn"].tolist()[:limit]))
+    # the appended row can displace at most itself; compare the common
+    # prefix semantics: per-partition (value, rank) multisets must agree
+    # for partitions untouched by the duplicate
+    dup_part = int(part[0])
+    hrows = [(p, v, r) for p, v, r in canon(host, len(host))
+             if p != dup_part]
+    drows = [(p, v, r) for p, v, r in canon(dev, len(dev))
+             if p != dup_part]
+    assert hrows == drows
+    assert set(host.columns["rn"].tolist()) <= {1, 2, 3}
+    assert set(dev.columns["rn"].tolist()) <= {1, 2, 3}
+
+
 def test_device_topk_matches_host_lexsort(rng):
     """ops/topk.segment_top_k == the host lexsort rank-per-partition, at
     sizes crossing the device-dispatch threshold, with ties."""

@@ -233,10 +233,12 @@ class AioKafkaBroker:
         c = await self._get_consumer(read_committed)
         tp = TopicPartition(topic, partition)
         # accumulate the assignment and keep positions: an unconditional
-        # assign+seek would discard aiokafka's prefetch buffer per call
+        # assign+seek would discard aiokafka's prefetch buffer per call.
+        # assign() REPLACES the whole subscription and resets every
+        # partition's fetch position — all cached positions invalidate
         if tp not in c.assignment():
             c.assign(sorted(c.assignment() | {tp}))
-            self._positions.pop(tp, None)
+            self._positions.clear()
         want = max(offset, 0)
         if self._positions.get(tp) != want:
             c.seek(tp, want)

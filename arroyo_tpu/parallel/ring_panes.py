@@ -45,13 +45,11 @@ def _ring_step(kind: str, nk: int, Bl: int, W: int):
     from .mesh_window import _keys_mesh
 
     ident = _init_value(AggKind(kind))
-    additive = kind in ("sum", "count", "avg")
+    additive = kind in ("sum", "count")
     mesh = _keys_mesh(nk)
     n_rot = max((W - 1 + Bl - 1) // Bl, 0)  # ring rotations needed
 
     def combine(a, b):
-        if additive:
-            return a + b
         return jnp.minimum(a, b) if kind == "min" else jnp.maximum(a, b)
 
     def sliding(ext):
@@ -62,11 +60,23 @@ def _ring_step(kind: str, nk: int, Bl: int, W: int):
             lo = jnp.arange(Bl) + (ext.shape[0] - Bl) - W
             hi = jnp.arange(Bl) + (ext.shape[0] - Bl)
             return c[hi] - jnp.where(lo >= 0, c[jnp.maximum(lo, 0)], 0.0)
-        # min/max: W is data-window width; a scan-free gather form
-        idx = (jnp.arange(Bl)[:, None] + (ext.shape[0] - Bl - W + 1)
-               + jnp.arange(W)[None, :])
-        return (jnp.min(ext[idx], axis=1) if kind == "min"
-                else jnp.max(ext[idx], axis=1))
+        # min/max: van Herk block decomposition — per W-block running
+        # extrema from both directions, then window [j-W+1, j] =
+        # combine(suffix[j-W+1], prefix[j]).  O(L) memory (a naive
+        # [Bl, W] gather would materialize the very windows this module
+        # exists to avoid holding).
+        import jax.lax as lax
+
+        L = ext.shape[0]
+        P = ((L + W - 1) // W) * W
+        x = jnp.concatenate(
+            [jnp.full((P - L,), ident, ext.dtype), ext]).reshape(-1, W)
+        op = lax.cummax if kind == "max" else lax.cummin
+        pre = op(x, axis=1).reshape(-1)
+        suf = op(x[:, ::-1], axis=1)[:, ::-1].reshape(-1)
+        j = jnp.arange(P - Bl, P)  # the last Bl padded positions
+        # j >= W-1 always: P >= L >= W + Bl - 1, so j - W + 1 >= 0
+        return combine(suf[j - W + 1], pre[j])
 
     def shard_fn(local):  # [Bl] per shard
         d = jax.lax.axis_index("keys")
@@ -102,6 +112,11 @@ def ring_pane_aggregate(bins: np.ndarray, width_bins: int, kind: str,
     import jax
     import jax.numpy as jnp
 
+    if kind not in ("sum", "count", "min", "max"):
+        # avg must divide by the per-pane non-null count — callers
+        # combine a sum ring with a count ring instead (as keyed_bins
+        # does); accepting 'avg' here would silently return sums
+        raise ValueError(f"ring_pane_aggregate: unsupported kind {kind!r}")
     n = len(bins)
     assert n % n_shards == 0, "bin count must divide the shard count"
     Bl = n // n_shards

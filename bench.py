@@ -388,6 +388,8 @@ def run_latency() -> dict:
 
     rate = float(os.environ.get("BENCH_LAT_RATE", 100_000))
     secs = float(os.environ.get("BENCH_LAT_SECS", 6))
+    if secs <= 0:
+        return {}
     lat_batch = min(BATCH, 8192)
     base = int(time.time() * 1e6)
     sql = LAT_SQL.format(rate=int(rate), n=int(rate * secs),
@@ -640,13 +642,33 @@ def main_child() -> None:
         raise SystemExit(f"unknown BENCH_QUERY {headline!r}; "
                          f"choose from {sorted(QUERIES)}")
     if os.environ.get("BENCH_ALL"):
+        # one child process per query: queries measured in a shared
+        # process degrade the later ones (allocator growth, jit-cache
+        # churn — q5 measured ~2x lower after three predecessors)
+        headline_result = None
         for name in sorted(QUERIES):
-            result = run_query(name, QUERIES[name])
-            result["backend"] = backend
             if name == headline:
-                headline_result = result
-            else:
-                print(json.dumps(result), file=sys.stderr)
+                continue
+            env = dict(os.environ, BENCH_CHILD="1", BENCH_ALL="",
+                       BENCH_QUERY=name, BENCH_LAT_SECS="0",
+                       BENCH_CONFIG5="0")
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)], env=env,
+                    stdout=subprocess.PIPE, timeout=BENCH_TIMEOUT,
+                    text=True)
+                if r.returncode == 0 and r.stdout.strip():
+                    print(r.stdout.strip().splitlines()[-1],
+                          file=sys.stderr)
+                else:
+                    print(json.dumps({"metric": name,
+                                      "error": f"rc={r.returncode}"}),
+                          file=sys.stderr)
+            except subprocess.TimeoutExpired:
+                print(json.dumps({"metric": name, "error": "timeout"}),
+                      file=sys.stderr)
+        headline_result = run_query(headline, QUERIES[headline])
+        headline_result["backend"] = backend
         headline_result.update(run_latency())
         emit_config5(backend)
         print(json.dumps(headline_result))

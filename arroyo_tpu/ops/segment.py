@@ -19,8 +19,11 @@ import numpy as np
 from ..graph.logical import AggKind, AggSpec
 from .expr import bucket_size
 
-NEG_INF = jnp.finfo(jnp.float32).min
-POS_INF = jnp.finfo(jnp.float32).max
+# f64 extremes: the aggregation channels are float64 (numeric-fidelity
+# policy, ops/keyed_bins.ACC_DTYPE) so the null identities must not clip
+# values beyond the float32 range
+NEG_INF = jnp.finfo(jnp.float64).min
+POS_INF = jnp.finfo(jnp.float64).max
 
 
 @functools.lru_cache(maxsize=256)
@@ -47,7 +50,7 @@ def _segment_agg_kernel(n_padded: int, n_segments: int, agg_kinds: Tuple[str, ..
                 r = jax.ops.segment_max(jnp.where(valid, v, NEG_INF), sid,
                                         num_segments=n_segments + 1)[:n_segments]
             elif kind == "count":
-                r = counts.astype(jnp.float32)
+                r = counts.astype(jnp.float64)
             else:
                 raise ValueError(kind)
             outs.append(r)
@@ -134,25 +137,26 @@ def segment_aggregate(
         if a.column is None:  # COUNT(*) — all rows
             specs.append((a, len(kinds), None))
             kinds.append("count")
-            rows.append(np.zeros(n, dtype=np.float32))
+            rows.append(np.zeros(n, dtype=np.float64))
             continue
-        raw = coerce_float(agg_inputs[a.column][order])
+        raw = coerce_float(agg_inputs[a.column][order],
+                           np.float64)
         ok = ~np.isnan(raw)
         if a.kind == AggKind.COUNT:  # COUNT(col) — non-null rows
             specs.append((a, len(kinds), None))
             kinds.append("sum")
-            rows.append(ok.astype(np.float32))
+            rows.append(ok.astype(np.float64))
             continue
-        ident = np.float32(0.0 if a.kind in (AggKind.SUM, AggKind.AVG)
+        ident = np.float64(0.0 if a.kind in (AggKind.SUM, AggKind.AVG)
                            else (POS_INF if a.kind == AggKind.MIN
                                  else NEG_INF))
         specs.append((a, len(kinds), len(kinds) + 1))
         kinds.append("sum" if a.kind == AggKind.AVG else a.kind.value)
-        rows.append(np.where(ok, raw, ident).astype(np.float32))
+        rows.append(np.where(ok, raw, ident).astype(np.float64))
         kinds.append("sum")
-        rows.append(ok.astype(np.float32))
+        rows.append(ok.astype(np.float64))
 
-    vals = np.zeros((len(kinds), npad), dtype=np.float32)
+    vals = np.zeros((len(kinds), npad), dtype=np.float64)
     for i, row in enumerate(rows):
         vals[i, :n] = row
 

@@ -185,6 +185,23 @@ def _jnp():
     return jnp
 
 
+def nan_validity(v, m):
+    """Combine an explicit validity mask with the engine's implicit NULL
+    encodings: NaN rows in float columns and None rows in unmasked
+    object columns.  Returns the combined mask, or None when every row
+    is valid.  THE single definition — IS NULL, COUNT(col) indicators,
+    and any other null-sensitive consumer must route through here so
+    the modalities cannot drift."""
+    jnp = _jnp()
+    if isinstance(v, np.ndarray) and v.dtype == object:
+        nn = np.array([x is not None and x == x for x in v], dtype=bool)
+        return nn if m is None else (m & nn)
+    if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+        nn = ~jnp.isnan(v)
+        return nn if m is None else (m & nn)
+    return m
+
+
 def _mask_and(a, b):
     if a is None:
         return b
@@ -316,13 +333,14 @@ class ExprCompiler:
 
             def isnull(env):
                 v, m = inner(env)
-                if m is None:
+                valid = nan_validity(v, m)
+                if valid is None:
                     is_valid = jnp.ones(np.shape(v) or (1,), dtype=bool) \
                         if hasattr(v, "shape") else True
                     res = is_valid if e.negated else ~is_valid \
                         if hasattr(is_valid, "__invert__") else not is_valid
                     return res, None
-                return (m if e.negated else ~m), None
+                return (valid if e.negated else ~valid), None
             return isnull
         if isinstance(e, InList):
             inner = self.compile(e.operand)

@@ -1032,17 +1032,15 @@ class Planner:
         def fn(env):
             import jax.numpy as jnp
 
+            from .compiler import nan_validity
+
             v, m = c.fn(env)
-            if m is None:
-                if isinstance(v, np.ndarray) and v.dtype == object:
-                    # nullable object column without an explicit mask:
-                    # the None rows themselves are the nulls
-                    return np.array([x is not None for x in v],
-                                    dtype=np.float32), None
-                base = jnp.ones_like(jnp.asarray(v), dtype=jnp.float32) \
+            valid = nan_validity(v, m)  # NaN / None rows are SQL NULLs
+            if valid is None:
+                base = jnp.ones(np.shape(v), dtype=jnp.float32) \
                     if hasattr(v, "shape") else 1.0
                 return base, None
-            return jnp.asarray(m).astype(jnp.float32), None
+            return jnp.asarray(valid).astype(jnp.float32), None
 
         return Compiled(fn, c.needs_host, c.sql, c.used_cols)
 

@@ -32,8 +32,11 @@ import numpy as np
 
 from ..graph.logical import AggKind, AggSpec
 
-NEG_INF = float(jnp.finfo(jnp.float32).min)
-POS_INF = float(jnp.finfo(jnp.float32).max)
+# f64 extremes (the accumulation channels are float64, see ACC_DTYPE):
+# f32 extremes here would clip MIN/MAX values beyond +/-3.4e38.  The
+# Pallas path never sees these — it handles additive channels only.
+NEG_INF = float(jnp.finfo(jnp.float64).min)
+POS_INF = float(jnp.finfo(jnp.float64).max)
 
 # Numeric-fidelity policy (VERDICT r2 #5; the reference aggregates in exact
 # i64/f64, aggregating_window.rs): all XLA-path accumulation channels are

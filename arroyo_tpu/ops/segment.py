@@ -102,7 +102,9 @@ def segment_aggregate(
             # like the reference's wasm UDFs, operators/mod.rs:347-494)
             v = agg_inputs[a.column][order]
             if v.dtype == object:
-                ok_rows = np.array([x is not None for x in v])
+                # x == x filters float NaN hiding in object columns —
+                # same modality set as compiler.nan_validity
+                ok_rows = np.array([x is not None and x == x for x in v])
             elif np.issubdtype(v.dtype, np.floating):
                 ok_rows = ~np.isnan(v)
             else:

@@ -27,7 +27,7 @@ def _make_table(rng, n, n_keys, span_secs, null_frac):
     return ts, k, v
 
 
-def _windows_of(t, mode, width, slide, gap=None):
+def _windows_of(t, mode, width, slide):
     """Window ends a row at time t contributes to (tumble/hop)."""
     if mode == "tumble":
         return [(t // width + 1) * width]
@@ -195,3 +195,70 @@ def test_fuzz_windowed_join(seed):
             kw = (int(b.columns["u"][j]), int(b.timestamp[j]) + 1)
             got[kw] = (int(b.columns["np"][j]), int(b.columns["na"][j]))
     assert got == exp, f"seed {seed}"
+
+
+@pytest.mark.parametrize("seed,kind", [
+    (21, "LEFT"), (22, "RIGHT"), (23, "FULL"),
+    (24, "LEFT"), (25, "FULL")])
+def test_fuzz_outer_join_net_result(seed, kind):
+    """Random LEFT/RIGHT/FULL joins: after applying __op retractions,
+    the net row multiset must equal the standard SQL outer-join result
+    regardless of arrival interleaving."""
+    from collections import Counter
+
+    rng = np.random.default_rng(seed)
+    nl = int(rng.integers(5, 60))
+    nr = int(rng.integers(5, 60))
+    lids = rng.integers(0, 20, nl).astype(np.int64)
+    rids = rng.integers(0, 20, nr).astype(np.int64)
+    lvs = rng.integers(0, 1000, nl).astype(np.int64)
+    rvs = rng.integers(0, 1000, nr).astype(np.int64)
+
+    p = SchemaProvider()
+    p.add_memory_table("l", {"id": "i", "lv": "i"}, [
+        Batch(np.sort(rng.integers(0, 1000, nl)).astype(np.int64),
+              {"id": lids, "lv": lvs})])
+    p.add_memory_table("r", {"id": "i", "rv": "i"}, [
+        Batch(np.sort(rng.integers(0, 1000, nr)).astype(np.int64),
+              {"id": rids, "rv": rvs})])
+    clear_sink("results")
+    LocalRunner(plan_sql(
+        f"SELECT l.id as lid, r.id as rid, lv, rv FROM l "
+        f"{kind} JOIN r ON l.id = r.id", p)).run()
+    outs = sink_output("results")
+
+    def cell(x):
+        return None if (isinstance(x, float) and np.isnan(x)) else int(x)
+
+    net = Counter()
+    for b in outs:
+        ops = b.columns["__op"]
+        for j in range(len(b)):
+            row = tuple(cell(b.columns[c][j])
+                        for c in ("lid", "rid", "lv", "rv"))
+            if int(ops[j]) == 2:
+                net[row] -= 1
+            else:
+                net[row] += 1
+    net = +net  # drop zero entries
+
+    exp = Counter()
+    r_by_id = {}
+    for i in range(nr):
+        r_by_id.setdefault(int(rids[i]), []).append(int(rvs[i]))
+    for i in range(nl):
+        lid, lv = int(lids[i]), int(lvs[i])
+        if lid in r_by_id:
+            for rv in r_by_id[lid]:
+                exp[(lid, lid, lv, rv)] += 1
+        elif kind in ("LEFT", "FULL"):
+            exp[(lid, None, lv, None)] += 1
+    if kind in ("RIGHT", "FULL"):
+        lkeys = set(lids.tolist())
+        for i in range(nr):
+            rid, rv = int(rids[i]), int(rvs[i])
+            if rid not in lkeys:
+                exp[(None, rid, None, rv)] += 1
+    assert net == exp, (
+        f"seed {seed} {kind}: net/exp differ "
+        f"(net-exp={+(net - exp)!r}, exp-net={+(exp - net)!r})")

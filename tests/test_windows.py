@@ -347,6 +347,29 @@ def test_mesh_sum_exactness_hot_key(rng):
     assert int(oc["total"][0]) == int(vals.sum())
 
 
+def test_min_max_beyond_float32_range():
+    """MIN/MAX null identities are f64 extremes: values beyond the f32
+    range (+/-3.4e38) must survive both aggregation paths instead of
+    clipping to the identity."""
+    from arroyo_tpu.graph.logical import AggKind, AggSpec
+    from arroyo_tpu.ops.keyed_bins import KeyedBinState
+    from arroyo_tpu.ops.segment import segment_aggregate
+    from arroyo_tpu.types import hash_columns
+
+    vals = np.array([-1e300, 1e300, np.nan], dtype=np.float64)
+    ts = np.array([100, 200, 300], dtype=np.int64)
+    kh = hash_columns([np.zeros(3, dtype=np.int64)])
+    aggs = (AggSpec(AggKind.MIN, "v", "lo"), AggSpec(AggKind.MAX, "v", "hi"))
+
+    st = KeyedBinState(aggs, SEC, SEC, capacity=16)
+    st.update(kh, ts, {"v": vals})
+    _k, oc, _w, _c = st.fire_panes(1 << 60, final=True)
+    assert oc["lo"][0] == -1e300 and oc["hi"][0] == 1e300
+
+    _u, cols, _t, _rc, _vc = segment_aggregate(kh, ts, {"v": vals}, aggs)
+    assert cols["lo"][0] == -1e300 and cols["hi"][0] == 1e300
+
+
 def test_apply_top_n_host_device_boundary_parity(rng):
     """_apply_top_n routes to the device segment_top_k only at >= 512
     rows: the kept-row set AND the materialized rank column must agree

@@ -126,6 +126,24 @@ def coerce_object_col(v: np.ndarray):
     return v, None
 
 
+def nan_validity(v, m):
+    """Combine an explicit validity mask with the engine's implicit NULL
+    encodings: NaN rows in float columns and None rows in unmasked
+    object columns.  Returns the combined mask, or None when every row
+    is valid.  THE single definition — IS NULL, COUNT(col) indicators,
+    UDAF null filters, and any other null-sensitive consumer must route
+    through here so the modalities cannot drift."""
+    import jax.numpy as jnp
+
+    if isinstance(v, np.ndarray) and v.dtype == object:
+        nn = np.array([x is not None and x == x for x in v], dtype=bool)
+        return nn if m is None else (m & nn)
+    if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+        nn = ~jnp.isnan(v)
+        return nn if m is None else (m & nn)
+    return m
+
+
 def coerce_float(arr: np.ndarray, dtype=np.float32) -> np.ndarray:
     """Numeric view of a column for aggregation inputs: None (in object
     columns from nullable JSON) becomes NaN instead of raising."""

@@ -44,8 +44,8 @@ POS_INF = float(jnp.finfo(jnp.float64).max)
 # comparisons below that, AVG divides exactly-summed numerators.  The Pallas
 # MXU path keeps its bf16 hi/lo compensated scatter per batch but lands the
 # deltas in this f64 state, so only within-batch rounding (~2^-16 relative)
-# remains.  min/max identities stay at the f32 extremes — they are
-# identities for any value of magnitude < 3.4e38.
+# remains.  MIN/MAX null identities are f64 extremes (NEG_INF/POS_INF
+# above) so values beyond +/-3.4e38 never clip.
 ACC_DTYPE = np.float64
 
 
@@ -384,13 +384,19 @@ class KeyedBinState:
             timestamps, self.slide, self.B, threshold)
         if n_live == 0:
             return
-        self.min_bin = lo if self.min_bin is None else min(self.min_bin, lo)
-        self.max_bin = hi if self.max_bin is None else max(self.max_bin, hi)
-        # ring capacity check: if new data spans too far ahead, fire nothing —
-        # bins wrap only after panes are emitted and evicted; enforce window
-        if self.max_bin - self.min_bin >= self.B:
-            self._grow_ring(self.max_bin - self.min_bin + 1)
+        lo_new = lo if self.min_bin is None else min(self.min_bin, lo)
+        hi_new = hi if self.max_bin is None else max(self.max_bin, hi)
+        # ring capacity check BEFORE extending min/max: _grow_ring copies
+        # the ring span [min_bin, max_bin] into the wider ring, so the
+        # bounds must still describe what the OLD ring actually holds —
+        # growing after extending them replicated old slots into the
+        # about-to-be-written range (ghost duplicates under far-apart
+        # sources, e.g. two impulse splits with staggered time bases)
+        if hi_new - lo_new >= self.B:
+            self._grow_ring(hi_new - lo_new + 1)
             bins_mod = ((timestamps // self.slide) % self.B).astype(np.int32)
+        self.min_bin = lo_new
+        self.max_bin = hi_new
 
         slots = self._lookup_or_insert(key_hash)
 

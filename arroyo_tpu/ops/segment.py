@@ -100,15 +100,12 @@ def segment_aggregate(
             # user aggregate: per-segment host call over non-null values
             # (non-mergeable — only reachable via buffered window paths,
             # like the reference's wasm UDFs, operators/mod.rs:347-494)
+            from ..formats import nan_validity
+
             v = agg_inputs[a.column][order]
-            if v.dtype == object:
-                # x == x filters float NaN hiding in object columns —
-                # same modality set as compiler.nan_validity
-                ok_rows = np.array([x is not None and x == x for x in v])
-            elif np.issubdtype(v.dtype, np.floating):
-                ok_rows = ~np.isnan(v)
-            else:
-                ok_rows = np.ones(len(v), dtype=bool)
+            ok = nan_validity(v, None)
+            ok_rows = (np.ones(len(v), dtype=bool) if ok is None
+                       else np.asarray(ok))
             groups = np.split(np.arange(n), seg_start[1:])
             out = []
             for g in groups:

@@ -185,21 +185,8 @@ def _jnp():
     return jnp
 
 
-def nan_validity(v, m):
-    """Combine an explicit validity mask with the engine's implicit NULL
-    encodings: NaN rows in float columns and None rows in unmasked
-    object columns.  Returns the combined mask, or None when every row
-    is valid.  THE single definition — IS NULL, COUNT(col) indicators,
-    and any other null-sensitive consumer must route through here so
-    the modalities cannot drift."""
-    jnp = _jnp()
-    if isinstance(v, np.ndarray) and v.dtype == object:
-        nn = np.array([x is not None and x == x for x in v], dtype=bool)
-        return nn if m is None else (m & nn)
-    if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
-        nn = ~jnp.isnan(v)
-        return nn if m is None else (m & nn)
-    return m
+from ..formats import nan_validity  # noqa: F401  (re-export: SQL layers
+# import the shared null-modality definition from here)
 
 
 def _mask_and(a, b):

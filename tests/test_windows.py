@@ -347,6 +347,58 @@ def test_mesh_sum_exactness_hot_key(rng):
     assert int(oc["total"][0]) == int(vals.sum())
 
 
+def test_ring_growth_does_not_ghost_duplicate(rng):
+    """Two interleaved streams with far-apart time bases (e.g. impulse
+    splits whose wall-clock bases drifted during jit compiles) force a
+    mid-stream ring growth: growing must NOT replicate old ring slots
+    into the newly-spanned bin range.  Regression for the ghost
+    duplication where _grow_ring copied [min, max] AFTER the new batch
+    had already extended the bounds."""
+    from arroyo_tpu.graph.logical import AggKind, AggSpec
+    from arroyo_tpu.ops.keyed_bins import KeyedBinState
+    from arroyo_tpu.types import hash_columns
+
+    aggs = (AggSpec(AggKind.COUNT, None, "cnt"),
+            AggSpec(AggKind.SUM, "v", "total"))
+    nA = nB = 2000
+    tsA = np.sort(rng.integers(0, 120_000, nA)).astype(np.int64)
+    tsB = np.sort(rng.integers(1_500_000, 1_620_000, nB)).astype(np.int64)
+    kA = rng.integers(0, 4, nA).astype(np.int64)
+    kB = rng.integers(0, 4, nB).astype(np.int64)
+    vA = rng.integers(1, 100, nA).astype(np.int64)
+    vB = rng.integers(1, 100, nB).astype(np.int64)
+    khA, khB = hash_columns([kA]), hash_columns([kB])
+
+    exp = {}
+    for ts, kh, vv in ((tsA, khA, vA), (tsB, khB, vB)):
+        for t, k, v in zip(ts.tolist(), kh.tolist(), vv.tolist()):
+            b = t // 100_000
+            for e in (b, b + 1):  # W/slide = 2 panes per event
+                c, s = exp.get((k, e), (0, 0))
+                exp[(k, e)] = (c + 1, s + v)
+
+    st = KeyedBinState(aggs, 100_000, 200_000, capacity=16)
+    got = {}
+
+    def fire(wm, final=False):
+        f = st.fire_panes(wm, final=final)
+        if f:
+            kk, oc, wend, _ = f
+            for j in range(len(kk)):
+                key = (int(kk[j]), int(wend[j]) // 100_000 - 1)
+                assert key not in got, f"pane refire {key}"
+                got[key] = (int(oc["cnt"][j]), int(oc["total"][j]))
+
+    stepsA = np.array_split(np.arange(nA), 4)
+    stepsB = np.array_split(np.arange(nB), 4)
+    for ia, ib in zip(stepsA, stepsB):
+        st.update(khA[ia], tsA[ia], {"v": vA[ia]})
+        st.update(khB[ib], tsB[ib], {"v": vB[ib]})
+        fire(int(min(tsA[ia[-1]], tsB[ib[0]])))
+    fire(1 << 60, final=True)
+    assert got == exp
+
+
 def test_min_max_beyond_float32_range():
     """MIN/MAX null identities are f64 extremes: values beyond the f32
     range (+/-3.4e38) must survive both aggregation paths instead of

@@ -125,6 +125,33 @@ AGG_FUNCTIONS = {"count", "sum", "min", "max", "avg"}
 # -- statements --------------------------------------------------------------
 
 
+def map_children(e: "Expr", fn) -> "Expr":
+    """Rebuild ``e`` with ``fn`` applied to each direct child expression —
+    THE single structural traversal every expression rewriter must use,
+    so node-type coverage is a one-place fix (three hand-rolled switch
+    ladders had already drifted on Case/InList/Between)."""
+    if isinstance(e, BinaryOp):
+        return BinaryOp(e.op, fn(e.left), fn(e.right))
+    if isinstance(e, UnaryOp):
+        return UnaryOp(e.op, fn(e.operand))
+    if isinstance(e, IsNull):
+        return IsNull(fn(e.operand), e.negated)
+    if isinstance(e, InList):
+        return InList(fn(e.operand), [fn(x) for x in e.items], e.negated)
+    if isinstance(e, Between):
+        return Between(fn(e.operand), fn(e.low), fn(e.high), e.negated)
+    if isinstance(e, Case):
+        return Case(fn(e.operand) if e.operand is not None else None,
+                    [(fn(c), fn(v)) for c, v in e.whens],
+                    fn(e.else_) if e.else_ is not None else None)
+    if isinstance(e, Cast):
+        return Cast(fn(e.operand), e.target_type)
+    if isinstance(e, FunctionCall):
+        return FunctionCall(e.name, [fn(a) for a in e.args], e.distinct,
+                            e.over)
+    return e
+
+
 @dataclass
 class SelectItem:
     expr: Expr

@@ -51,6 +51,7 @@ from .ast_nodes import (
     JoinKind,
     Literal,
     NamedTable,
+    map_children,
     Select,
     SelectItem,
     Star,
@@ -162,22 +163,7 @@ class AggCollector:
                         return ColumnRef(f"__agg{j}")
                 self.aggs.append(e)
                 return ColumnRef(f"__agg{len(self.aggs) - 1}")
-            return FunctionCall(e.name, [self.rewrite(a) for a in e.args],
-                                e.distinct)
-        if isinstance(e, BinaryOp):
-            return BinaryOp(e.op, self.rewrite(e.left), self.rewrite(e.right))
-        if isinstance(e, UnaryOp):
-            return UnaryOp(e.op, self.rewrite(e.operand))
-        if isinstance(e, Cast):
-            return Cast(self.rewrite(e.operand), e.target_type)
-        if isinstance(e, IsNull):
-            return IsNull(self.rewrite(e.operand), e.negated)
-        if isinstance(e, Case):
-            return Case(
-                self.rewrite(e.operand) if e.operand else None,
-                [(self.rewrite(c), self.rewrite(v)) for c, v in e.whens],
-                self.rewrite(e.else_) if e.else_ else None)
-        return e
+        return map_children(e, self.rewrite)
 
 
 def _has_aggregates(sel: Select) -> bool:
@@ -837,16 +823,9 @@ class Planner:
         def sub_group(e: Expr) -> Expr:
             if repr(e) in group_repr:
                 return ColumnRef(group_repr[repr(e)])
-            if isinstance(e, BinaryOp):
-                return BinaryOp(e.op, sub_group(e.left), sub_group(e.right))
-            if isinstance(e, UnaryOp):
-                return UnaryOp(e.op, sub_group(e.operand))
-            if isinstance(e, Cast):
-                return Cast(sub_group(e.operand), e.target_type)
-            if isinstance(e, FunctionCall) and not _is_agg_name(e.name):
-                return FunctionCall(e.name, [sub_group(a) for a in e.args],
-                                    e.distinct)
-            return e
+            if isinstance(e, FunctionCall) and _is_agg_name(e.name):
+                return e  # aggregate args are not group refs
+            return map_children(e, sub_group)
 
         # collect aggregates from items (+ having), rewrite exprs
         collector = AggCollector()
@@ -1021,10 +1000,27 @@ class Planner:
                      and e.name in agg_outputs} if fusable else None,
             updating=post_updating)
         if having_rewritten is not None:
-            # HAVING compiles against the projected schema: predicates may
-            # only reference selected outputs (aggregates referenced in
-            # HAVING but not in SELECT are unsupported)
-            planned2 = self._filter(planned2, having_rewritten, "having")
+            # HAVING compiles against the projected schema: its __agg{j}
+            # placeholders rewrite to the SELECTED output carrying the
+            # same aggregate; aggregates referenced in HAVING but not in
+            # SELECT remain unsupported (clear error from the compiler)
+            alias_of = {e.name: name for name, e in post_items
+                        if isinstance(e, ColumnRef) and e.qualifier is None
+                        and e.name.startswith("__agg")}
+
+            def sub_ph(e: Expr) -> Expr:
+                if isinstance(e, ColumnRef):
+                    if e.name in alias_of:
+                        return ColumnRef(alias_of[e.name])
+                    if e.name.startswith("__agg"):
+                        raise SqlPlanError(
+                            "an aggregate referenced in HAVING must also "
+                            "appear in the SELECT list")
+                    return e
+                return map_children(e, sub_ph)
+
+            planned2 = self._filter(planned2, sub_ph(having_rewritten),
+                                    "having")
         return planned2
 
     @staticmethod

@@ -351,3 +351,56 @@ def test_fuzz_checkpoint_restore_exactly_once(seed, tmp_path):
         key = (r["bucket"], r["window_end"])
         assert key not in seen, f"duplicate emission {key} (seed {seed})"
         seen.add(key)
+
+
+@pytest.mark.parametrize("seed", [41, 42, 43, 44])
+def test_fuzz_distinct_udaf_having(seed):
+    """The buffered (non-mergeable) window path: COUNT(DISTINCT), a
+    median UDAF, and HAVING, against a python oracle."""
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(800, 4000))
+    keys = int(rng.integers(3, 12))
+    width_s = int(rng.integers(1, 4))
+    having_min = int(rng.integers(2, 12))
+    ts, k, _ = _make_table(rng, n, keys, 8, 0.0)
+    v = rng.integers(0, 25, n).astype(np.int64)  # small domain -> dups
+
+    from arroyo_tpu.sql.functions import UDAFS
+
+    p = SchemaProvider()
+    if "med" not in UDAFS:  # registration is global across param cases
+        p.register_udaf("med", np.median)
+    p.add_memory_table("t", {"k": "i", "v": "i"},
+                       [Batch(ts, {"k": k, "v": v})])
+    sql = f"""
+    SELECT k, TUMBLE(INTERVAL '{width_s}' SECOND) as window,
+           count(distinct v) as dv, med(v) as med, count(*) as c
+    FROM t GROUP BY 1, 2 HAVING count(*) >= {having_min}
+    """
+    clear_sink("results")
+    LocalRunner(plan_sql(sql, p)).run()
+    outs = sink_output("results")
+    out = Batch.concat(outs) if outs else None
+
+    width = width_s * SEC
+    cells = {}
+    for t_, key, val in zip(ts.tolist(), k.tolist(), v.tolist()):
+        (e,) = _windows_of(t_, "tumble", width, None)
+        cells.setdefault((key, e), []).append(val)
+    exp = {key: (len(set(vals)), float(np.median(vals)), len(vals))
+           for key, vals in cells.items() if len(vals) >= having_min}
+
+    got = {}
+    if out is not None:
+        for j in range(len(out)):
+            key = (int(out.columns["k"][j]),
+                   int(out.columns["window_end"][j]))
+            assert key not in got
+            got[key] = (int(out.columns["dv"][j]),
+                        float(out.columns["med"][j]),
+                        int(out.columns["c"][j]))
+    assert set(got) == set(exp), f"seed {seed}"
+    for key in exp:
+        assert got[key][0] == exp[key][0], (seed, key, "distinct")
+        assert got[key][1] == pytest.approx(exp[key][1]), (seed, key, "med")
+        assert got[key][2] == exp[key][2], (seed, key, "count")

@@ -146,6 +146,9 @@ def map_children(e: "Expr", fn) -> "Expr":
                     fn(e.else_) if e.else_ is not None else None)
     if isinstance(e, Cast):
         return Cast(fn(e.operand), e.target_type)
+    if isinstance(e, InSubquery):
+        # the subquery plans separately; only the operand is a child expr
+        return InSubquery(fn(e.operand), e.query, e.negated)
     if isinstance(e, FunctionCall):
         return FunctionCall(e.name, [fn(a) for a in e.args], e.distinct,
                             e.over)

@@ -404,3 +404,101 @@ def test_fuzz_distinct_udaf_having(seed):
         assert got[key][0] == exp[key][0], (seed, key, "distinct")
         assert got[key][1] == pytest.approx(exp[key][1]), (seed, key, "med")
         assert got[key][2] == exp[key][2], (seed, key, "count")
+
+
+def _gen_expr(rng, depth):
+    """Random scalar expression tree -> (sql_text, python_eval_fn).
+    eval fn takes (k:int, v:float-or-None) and returns the SQL
+    three-valued result (None = NULL)."""
+    def num_leaf():
+        c = int(rng.integers(0, 3))
+        if c == 0:
+            return "k", lambda k, v: k
+        if c == 1:
+            return "v", lambda k, v: v
+        lit = int(rng.integers(-20, 20))
+        return str(lit), lambda k, v, _l=lit: _l
+
+    if depth <= 0:
+        return num_leaf()
+    c = int(rng.integers(0, 4))
+    if c == 0:  # arithmetic
+        ls, lf = _gen_expr(rng, depth - 1)
+        rs, rf = _gen_expr(rng, depth - 1)
+        op = ["+", "-", "*"][int(rng.integers(0, 3))]
+        pyop = {"+": lambda a, b: a + b, "-": lambda a, b: a - b,
+                "*": lambda a, b: a * b}[op]
+
+        def f(k, v, _lf=lf, _rf=rf, _o=pyop):
+            a, b = _lf(k, v), _rf(k, v)
+            return None if a is None or b is None else _o(a, b)
+        return f"({ls} {op} {rs})", f
+    if c == 1:  # CASE WHEN cmp THEN x ELSE y END
+        ls, lf = _gen_expr(rng, depth - 1)
+        rs, rf = _gen_expr(rng, depth - 1)
+        xs, xf = _gen_expr(rng, depth - 1)
+        ys, yf = _gen_expr(rng, depth - 1)
+        op = ["<", ">", "=", "<=", ">="][int(rng.integers(0, 5))]
+        pyop = {"<": lambda a, b: a < b, ">": lambda a, b: a > b,
+                "=": lambda a, b: a == b, "<=": lambda a, b: a <= b,
+                ">=": lambda a, b: a >= b}[op]
+
+        def f(k, v, _lf=lf, _rf=rf, _xf=xf, _yf=yf, _o=pyop):
+            a, b = _lf(k, v), _rf(k, v)
+            cond = None if a is None or b is None else _o(a, b)
+            # SQL: NULL condition selects the ELSE branch
+            return _xf(k, v) if cond else _yf(k, v)
+        return (f"(CASE WHEN {ls} {op} {rs} THEN {xs} ELSE {ys} END)", f)
+    if c == 2:  # COALESCE
+        ls, lf = _gen_expr(rng, depth - 1)
+        rs, rf = _gen_expr(rng, depth - 1)
+
+        def f(k, v, _lf=lf, _rf=rf):
+            a = _lf(k, v)
+            return a if a is not None else _rf(k, v)
+        return f"COALESCE({ls}, {rs})", f
+    # ABS
+    ls, lf = _gen_expr(rng, depth - 1)
+
+    def f(k, v, _lf=lf):
+        a = _lf(k, v)
+        return None if a is None else abs(a)
+    return f"ABS({ls})", f
+
+
+@pytest.mark.parametrize("seed", [51, 52, 53, 54, 55, 56])
+def test_fuzz_scalar_expressions(seed):
+    """Random expression trees (arithmetic, CASE, COALESCE, ABS) over a
+    nullable float column, evaluated through the full engine and checked
+    row-by-row against a python three-valued-logic interpreter."""
+    rng = np.random.default_rng(seed)
+    n = 400
+    ts = np.arange(n, dtype=np.int64) * 100
+    k = rng.integers(-10, 10, n).astype(np.int64)
+    v = rng.integers(-50, 50, n).astype(np.float64)
+    v[rng.random(n) < 0.3] = np.nan
+
+    sql_e, f = _gen_expr(rng, 3)
+    p = SchemaProvider()
+    p.add_memory_table("t", {"k": "i", "v": "f"},
+                       [Batch(ts, {"k": k, "v": v})])
+    clear_sink("results")
+    LocalRunner(plan_sql(
+        f"SELECT k, v, {sql_e} as e FROM t", p)).run()
+    out = Batch.concat(sink_output("results"))
+    assert len(out) == n
+    # rows keep source order per batch; match by (k, v) row identity via
+    # the original index column k/v pairs in order
+    for j in range(n):
+        kk = int(out.columns["k"][j])
+        vv = out.columns["v"][j]
+        vv = None if (isinstance(vv, float) and np.isnan(vv)) else float(vv)
+        want = f(kk, vv)
+        have = out.columns["e"][j]
+        if want is None:
+            assert (have is None
+                    or (isinstance(have, float) and np.isnan(have))), (
+                seed, sql_e, j, kk, vv, have)
+        else:
+            assert have == pytest.approx(float(want), rel=1e-9), (
+                seed, sql_e, j, kk, vv, have, want)

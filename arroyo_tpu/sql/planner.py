@@ -176,19 +176,6 @@ def _has_aggregates(sel: Select) -> bool:
     return bool(c.aggs) or bool(sel.group_by)
 
 
-def _collect_placeholders(e: Expr):
-    """All ``__agg{j}`` placeholder refs in an expression tree."""
-    found = set()
-
-    def walk(x: Expr) -> Expr:
-        if isinstance(x, ColumnRef) and x.name.startswith("__agg"):
-            found.add(x.name)
-        return map_children(x, walk)
-
-    walk(e)
-    return found
-
-
 def _apply_validity(v, m):
     """Materialize a SQL validity mask into the projected column: None for
     object/string/host-bool rows, NaN for numerics (the engine's null
@@ -998,50 +985,44 @@ class Planner:
         agg_tail = stream.tail
         agg_kind = stream.program.node(agg_tail).operator.kind
         agg_outputs = {a.output for a in aggs}
-        # the ONE selected-aggregate mapping: placeholder column ->
-        # SELECT output name when the item is a bare aggregate ref; both
-        # the TopN fusion map and the HAVING rewrite derive from it
-        selected_aggs = {e.name: name for name, e in post_items
-                         if isinstance(e, ColumnRef) and e.qualifier is None
-                         and e.name in agg_outputs}
 
-        if having_rewritten is not None and any(
-                ph not in selected_aggs
-                for ph in _collect_placeholders(having_rewritten)):
-            # HAVING references an aggregate that is not a bare selected
-            # output (not selected at all, or only inside an expression):
-            # filter BEFORE the post-projection, where the __agg columns
-            # still exist as physical columns
+        if having_rewritten is not None:
+            # HAVING filters BEFORE the post-projection, where aggregate
+            # (__agg) columns still exist physically — so aggregates need
+            # not be selected, and aggregates nested in selected
+            # expressions work.  References to SELECT output aliases
+            # substitute to their defining expressions (which are written
+            # in mid-schema terms; a single pass suffices)
+            name_to_expr = {name.lower(): e for name, e in post_items}
+
+            def sub_alias(e: Expr) -> Expr:
+                if isinstance(e, ColumnRef) and e.qualifier is None \
+                        and e.name.lower() in name_to_expr:
+                    return name_to_expr[e.name.lower()]
+                return map_children(e, sub_alias)
+
             stream = self._filter(
                 Planned(stream, mid_schema, updating=post_updating),
-                having_rewritten, "having").stream
-            having_rewritten = None
+                sub_alias(having_rewritten), "having").stream
 
         post_fn = _wrap_record(post_compiled, passthrough)
         post_host = any(c.needs_host for _, c in post_compiled)
         pname2 = f"agg_project_{self._next_id()}"
         stream = (stream.udf(post_fn, name=pname2) if post_host
                   else stream.map(post_fn, name=pname2))
-        fusable = agg_kind in (OpKind.SLIDING_WINDOW_AGGREGATOR,
-                               OpKind.TUMBLING_WINDOW_AGGREGATOR)
-        planned2 = Planned(
+        # TopN fusion rewrites the AGGREGATE node itself; with a HAVING
+        # filter between the aggregate and the TopN, fusing would prune
+        # groups BEFORE the filter — so HAVING disables the fusion
+        fusable = (agg_kind in (OpKind.SLIDING_WINDOW_AGGREGATOR,
+                                OpKind.TUMBLING_WINDOW_AGGREGATOR)
+                   and having_rewritten is None)
+        return Planned(
             stream, out_schema,
             agg_node=agg_tail if fusable else None,
-            agg_map=({name: ph for ph, name in selected_aggs.items()}
-                     if fusable else None),
+            agg_map={name: e.name for name, e in post_items
+                     if isinstance(e, ColumnRef) and e.qualifier is None
+                     and e.name in agg_outputs} if fusable else None,
             updating=post_updating)
-        if having_rewritten is not None:
-            # every HAVING aggregate is a bare selected output: rewrite
-            # the placeholders to the output names and filter after the
-            # projection (alias references keep working)
-            def sub_ph(e: Expr) -> Expr:
-                if isinstance(e, ColumnRef) and e.name in selected_aggs:
-                    return ColumnRef(selected_aggs[e.name])
-                return map_children(e, sub_ph)
-
-            planned2 = self._filter(planned2, sub_ph(having_rewritten),
-                                    "having")
-        return planned2
 
     @staticmethod
     def _mask_indicator(c: Compiled) -> Compiled:

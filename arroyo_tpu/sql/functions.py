@@ -146,12 +146,21 @@ def _register_math():
     DEVICE_FUNCTIONS["nullif"] = nullif
 
     def coalesce(args):
+        from ..formats import nan_validity
+
+        # NULL-ness must include the implicit encodings (NaN floats in
+        # unmasked columns), not just explicit masks — else a NaN first
+        # argument short-circuits and never falls through
         out_v, out_m = args[0]
+        out_m = nan_validity(out_v, out_m)
         for v, m in args[1:]:
             if out_m is None:
                 break
+            m = nan_validity(v, m)
             out_v = jnp.where(out_m, out_v, v)
-            out_m = out_m | (jnp.ones_like(out_m) if m is None else m)
+            # symmetric | broadcast: out_m may be scalar (literal first
+            # arg) while m is row-shaped, or vice versa
+            out_m = None if m is None else (out_m | m)
         return out_v, out_m
 
     DEVICE_FUNCTIONS["coalesce"] = coalesce

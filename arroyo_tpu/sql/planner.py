@@ -996,8 +996,11 @@ class Planner:
             name_to_expr = {name.lower(): e for name, e in post_items}
 
             def sub_alias(e: Expr) -> Expr:
+                # standard SQL resolution: a real mid-schema column
+                # (group key) of the same name wins over a SELECT alias
                 if isinstance(e, ColumnRef) and e.qualifier is None \
-                        and e.name.lower() in name_to_expr:
+                        and e.name.lower() in name_to_expr \
+                        and e.name.lower() not in mid_schema.columns:
                     return name_to_expr[e.name.lower()]
                 return map_children(e, sub_alias)
 

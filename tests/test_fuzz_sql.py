@@ -466,7 +466,7 @@ def _gen_expr(rng, depth):
     return f"ABS({ls})", f
 
 
-@pytest.mark.parametrize("seed", [51, 52, 53, 54, 55, 56])
+@pytest.mark.parametrize("seed", list(range(51, 61)))
 def test_fuzz_scalar_expressions(seed):
     """Random expression trees (arithmetic, CASE, COALESCE, ABS) over a
     nullable float column, evaluated through the full engine and checked
@@ -504,7 +504,7 @@ def test_fuzz_scalar_expressions(seed):
                 seed, sql_e, j, kk, vv, have, want)
 
 
-@pytest.mark.parametrize("seed", [61, 62, 63, 64])
+@pytest.mark.parametrize("seed", [61, 62, 63, 64, 65, 66])
 def test_fuzz_rescale_reshard(seed):
     """Random N->M rescales mid-stream: snapshot N KeyedBinState
     partitions, re-shard to M by key range (filter + merge, the

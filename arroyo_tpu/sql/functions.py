@@ -157,7 +157,13 @@ def _register_math():
             if out_m is None:
                 break
             m = nan_validity(v, m)
-            out_v = jnp.where(out_m, out_v, v)
+            # object (string) columns can't enter jnp.where — select on
+            # host (nan_validity returns a mask for object arrays even
+            # when every row is valid)
+            obj = ((isinstance(out_v, np.ndarray) and out_v.dtype == object)
+                   or (isinstance(v, np.ndarray) and v.dtype == object))
+            out_v = (np.where(np.asarray(out_m), out_v, v) if obj
+                     else jnp.where(out_m, out_v, v))
             # symmetric | broadcast: out_m may be scalar (literal first
             # arg) while m is row-shaped, or vice versa
             out_m = None if m is None else (out_m | m)

@@ -739,7 +739,15 @@ def _match_pairs(lk: np.ndarray, rk_sorted: np.ndarray
 
 def _concat_col(parts: List[np.ndarray]) -> np.ndarray:
     """Concatenate column fragments, promoting to object when any
-    fragment is (None-padded rows mix with typed rows)."""
+    fragment is (None-padded rows mix with typed rows).
+
+    int64 fragments mixed with NaN-padded (outer-join null) fragments
+    promote to float64 — the engine-wide nullable-int convention
+    (docs/architecture.md): BIGINT values above 2^53 lose precision in
+    outer-join output batches that mix matched and unmatched rows.
+    Nexmark ids and realistic key spaces sit far below that bound; a
+    lossless alternative (object dtype with None pads) would take every
+    downstream vectorized op off the fast path."""
     if any(p.dtype == object for p in parts):
         out = np.empty(sum(len(p) for p in parts), dtype=object)
         at = 0
@@ -1015,13 +1023,23 @@ class SemiJoinOperator(Operator):
 class NonWindowAggOperator(Operator):
     """Running per-key aggregates over an updating stream with expiration
     (UpdatingAggregateOperator, updating_aggregate.rs:11-150): each batch
-    merges into per-key running state and emits create/update rows."""
+    merges into per-key running state and emits create/update rows.
+
+    With ``flush_key`` set (GROUP BY the window of a windowed input, q5's
+    MaxBids shape), refinements are instead CONSOLIDATED in state and each
+    key emits its final row exactly once, when the watermark passes the
+    named key column — upstream panes always precede the watermark that
+    releases them (shuffle fan-in takes the min across subtasks), so this
+    is append-only-correct even when one window's rows arrive in several
+    batches from several upstream subtasks."""
 
     def __init__(self, name: str, expiration_micros: int,
-                 aggs: Tuple[AggSpec, ...], projection=None):
+                 aggs: Tuple[AggSpec, ...], projection=None,
+                 flush_key: Optional[str] = None):
         super().__init__(name)
         self.expiration = expiration_micros
         self.aggs = aggs
+        self.flush_key = flush_key
         self.projection = (CompiledExpr(projection.name, projection.fn)
                            if projection else None)
 
@@ -1079,7 +1097,14 @@ class NonWindowAggOperator(Operator):
                 out_cols[a.output].append(merged[a.output])
             ops[i] = (UpdateOp.CREATE.value if prev is None
                       else UpdateOp.UPDATE.value)
+            if self.flush_key is not None:
+                # stash key-column values for the watermark-time emission
+                # (state-resident, so a restore can still flush correctly)
+                for c, arr in key_cols.items():
+                    merged[f"__kc::{c}"] = arr[i]
             self.table.insert(int(max_ts[i]), k, merged)
+        if self.flush_key is not None:
+            return  # emission happens at watermark passage
         cols = dict(key_cols)
         for a in self.aggs:
             arr = np.asarray(out_cols[a.output])
@@ -1088,6 +1113,39 @@ class NonWindowAggOperator(Operator):
             cols[a.output] = arr
         cols[UPDATE_OP_COLUMN] = ops
         out = Batch(max_ts, cols, uniq.astype(np.uint64), batch.key_cols)
+        if self.projection is not None:
+            out = eval_record_expr(self.projection, out)
+        await ctx.collect(out)
+
+    async def handle_watermark(self, watermark: int, ctx: Context) -> None:
+        if self.flush_key is not None:
+            await self._flush_ready(watermark, ctx)
+        await ctx.broadcast(Message.wm(Watermark.event_time(watermark)))
+
+    async def _flush_ready(self, watermark: int, ctx: Context) -> None:
+        fk = f"__kc::{self.flush_key}"
+        ready = []
+        for t, k, rec in list(self.table.snapshot()):
+            bound = rec.get(fk)
+            if bound is None or float(bound) <= watermark:
+                ready.append((t, k, rec))
+        if not ready:
+            return
+        ts = np.array([t for t, _, _ in ready], dtype=np.int64)
+        kh = np.array([k for _, k, _ in ready], dtype=np.uint64)
+        kc_names = [n[len("__kc::"):] for n in ready[0][2]
+                    if n.startswith("__kc::")]
+        cols: Dict[str, np.ndarray] = {}
+        for c in kc_names:
+            cols[c] = np.asarray([rec[f"__kc::{c}"] for _, _, rec in ready])
+        for a in self.aggs:
+            arr = np.asarray([rec[a.output] for _, _, rec in ready])
+            if a.kind == AggKind.COUNT:
+                arr = arr.astype(np.int64)
+            cols[a.output] = arr
+        for _, k, _ in ready:
+            self.table.remove(k)
+        out = Batch(ts, cols, kh, tuple(kc_names))
         if self.projection is not None:
             out = eval_record_expr(self.projection, out)
         await ctx.collect(out)
@@ -1160,4 +1218,5 @@ def _build_join_exp(op: LogicalOperator) -> Operator:
 def _build_nonwindow(op: LogicalOperator) -> Operator:
     s = op.spec
     return NonWindowAggOperator(op.name, s.expiration_micros, s.aggs,
-                                s.projection)
+                                s.projection,
+                                getattr(s, "flush_key", None))

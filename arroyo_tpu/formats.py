@@ -283,7 +283,15 @@ class JsonFormat(Format):
             else:  # struct/list/timestamp payloads: row path handles them
                 raise ValueError(f"non-scalar column {name}: {t}")
         if timestamp_field and timestamp_field in cols:
-            ts = cols[timestamp_field].astype(np.int64)
+            tcol = cols[timestamp_field]
+            if tcol.dtype.kind == "f" and not np.isfinite(tcol).all():
+                # a payload missing the timestamp field surfaced as a
+                # null -> NaN, and astype(int64) on NaN is undefined
+                # behavior (platform-dependent garbage event times); the
+                # row path handles missing fields explicitly
+                raise ValueError(
+                    f"null {timestamp_field!r} in columnar JSON batch")
+            ts = tcol.astype(np.int64)
         else:
             ts = np.full(len(raw), now_micros(), dtype=np.int64)
         return Batch(ts, cols)

@@ -268,6 +268,11 @@ class NonWindowAggregatorSpec:
     expiration_micros: int
     aggs: Tuple[AggSpec, ...] = ()
     projection: Optional[ColumnExpr] = None
+    # when set (to a key-column name holding an event-time bound, e.g.
+    # "window_end"): consolidate refinements in state and emit each key's
+    # FINAL row once, when the watermark passes that bound — append-only
+    # output instead of create/update refinements
+    flush_key: Optional[str] = None
 
 
 @dataclass
@@ -596,9 +601,11 @@ class Stream:
 
     def non_window_aggregate(self, expiration_micros: int, aggs: Sequence[AggSpec],
                              projection: Optional[Callable] = None,
-                             name: str = "updating_agg") -> "Stream":
+                             name: str = "updating_agg",
+                             flush_key: Optional[str] = None) -> "Stream":
         proj = ColumnExpr(f"{name}_proj", projection) if projection else None
-        spec = NonWindowAggregatorSpec(expiration_micros, tuple(aggs), proj)
+        spec = NonWindowAggregatorSpec(expiration_micros, tuple(aggs), proj,
+                                       flush_key)
         op = LogicalOperator(OpKind.NON_WINDOW_AGGREGATOR, name, spec=spec)
         return self._chain(op, edge=EdgeType.SHUFFLE)
 

@@ -971,9 +971,11 @@ def _concat_ws(args):
         # NULL separator yields a NULL result (Postgres/DataFusion) —
         # NULL value args, by contrast, are merely skipped
         sep = _row_get(sep_v, i)
-        if sep is None or (sep_m is not None
-                           and not bool(np.asarray(sep_m).reshape(-1)[
-                               i if np.ndim(sep_m) else 0])):
+        # broadcastable length-1 masks (scalar-literal separator) index
+        # row 0 for every row, same as _row_is_valid
+        sm = (None if sep_m is None else np.asarray(sep_m).reshape(-1))
+        if sep is None or (sm is not None
+                           and not bool(sm[i if sm.shape[0] > 1 else 0])):
             out.append(None)
             valid[i] = False
             continue

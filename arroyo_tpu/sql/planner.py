@@ -924,15 +924,16 @@ class Planner:
             stream = stream.global_key()
 
         if window is None:
-            stream = stream.non_window_aggregate(DEFAULT_UPDATING_TTL, aggs)
             # GROUP BY the window of a windowed input (q5's MaxBids) is a
-            # bounded per-window refinement: treat it as append-only and
-            # DROP the __op column in the post-projection (each upstream
-            # pane fires once, so rows are creates in the common case; a
-            # leaked __op would otherwise reach joins/sinks as a data
-            # column).  Multi-emission refinements join as appends — a
-            # documented approximation (the reference routes the same
-            # shape through its updating join).
+            # bounded per-window re-aggregation: refinements consolidate
+            # in state and each window emits its FINAL row exactly once,
+            # when the watermark passes window_end (flush_key) — upstream
+            # panes always precede the watermark that releases them, so
+            # the output is genuinely append-only even when one window's
+            # rows arrive in several batches from parallel subtasks.
+            stream = stream.non_window_aggregate(
+                DEFAULT_UPDATING_TTL, aggs,
+                flush_key="window_end" if grouped_by_window else None)
             post_updating = not grouped_by_window
         else:
             post_updating = False

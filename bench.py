@@ -38,23 +38,31 @@ PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", 3))
 PROBE_BACKOFF = float(os.environ.get("BENCH_PROBE_BACKOFF", 20))
 
 
-def probe_backend() -> str:
+def probe_backend():
     """Decide which jax backend to use WITHOUT risking a hang in this
     process: probe `jax.devices()` in a subprocess with a hard timeout,
     retry, and on unrecoverable failure force the CPU backend so the bench
-    still records a number (tagged with its backend)."""
+    still records a number (tagged with its backend).
+
+    Returns ``(backend, probe_failures)`` — every failed probe attempt is
+    returned so the artifact records that an accelerator was TRIED, not
+    just that CPU was used (a "backend: cpu" line with no recorded attempt
+    reads as CPU-by-choice)."""
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        return "cpu"
+        return "cpu", []
     code = ("import jax; "
             "print(jax.default_backend(), len(jax.devices()))")
+    failures = []
     for attempt in range(1, PROBE_RETRIES + 1):
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code], capture_output=True,
                 text=True, timeout=PROBE_TIMEOUT)
         except subprocess.TimeoutExpired:
-            print(f"backend probe attempt {attempt}/{PROBE_RETRIES}: "
-                  f"timed out after {PROBE_TIMEOUT:.0f}s", file=sys.stderr)
+            err = f"timed out after {PROBE_TIMEOUT:.0f}s"
+            failures.append({"attempt": f"probe {attempt}", "error": err})
+            print(f"backend probe attempt {attempt}/{PROBE_RETRIES}: {err}",
+                  file=sys.stderr)
             if attempt < PROBE_RETRIES:
                 time.sleep(PROBE_BACKOFF)  # tunnel flaps recover in waves
             continue
@@ -62,10 +70,11 @@ def probe_backend() -> str:
             backend, ndev = r.stdout.split()[:2]
             print(f"backend probe: {backend} ({ndev} devices)",
                   file=sys.stderr)
-            return backend
+            return backend, failures
+        err = f"rc={r.returncode}: {r.stderr.strip()[-500:]}"
+        failures.append({"attempt": f"probe {attempt}", "error": err})
         print(f"backend probe attempt {attempt}/{PROBE_RETRIES} failed "
-              f"(rc={r.returncode}): {r.stderr.strip()[-500:]}",
-              file=sys.stderr)
+              f"({err})", file=sys.stderr)
     print("backend probe: accelerator unavailable, falling back to CPU",
           file=sys.stderr)
     os.environ["JAX_PLATFORMS"] = "cpu"
@@ -73,7 +82,7 @@ def probe_backend() -> str:
         import jax
 
         jax.config.update("jax_platforms", "cpu")
-    return "cpu"
+    return "cpu", failures
 
 SRC = """
 CREATE TABLE nexmark WITH (
@@ -599,6 +608,133 @@ def run_config5() -> dict:
     return result
 
 
+# -- kernel-level accelerator microbench ------------------------------------
+#
+# The full Nexmark pipeline needs a stable accelerator for minutes; the
+# tunnel often can't provide that.  This microbench is the falsifiable
+# fallback: it exercises exactly the device hot path the engine uses —
+# the keyed-bin update kernel (one packed host->device transfer per step,
+# scatter-add into resident state), the pane-emission gather/reduce, the
+# Pallas scatter path where supported, plus raw transfer bandwidth and
+# dispatch latency — and completes in seconds, so a flaky tunnel can
+# still yield a device datapoint.
+
+
+def run_kernel_microbench() -> dict:
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from arroyo_tpu.ops import keyed_bins as kb
+
+    backend = jax.default_backend()
+    dev = jax.devices()[0]
+    out = {"backend": backend, "device": str(dev),
+           "jax": jax.__version__, "numpy": np.__version__}
+
+    def timeit(fn, warmup=3, iters=20):
+        for _ in range(warmup):
+            fn()
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            fn()
+        return (time.perf_counter() - t0) / iters
+
+    # dispatch latency: tiny jitted op round-trip
+    one = jax.device_put(jnp.float32(1.0), dev)
+    f = jax.jit(lambda x: x + 1)
+    jax.block_until_ready(f(one))
+    out["dispatch_ms"] = round(
+        timeit(lambda: jax.block_until_ready(f(one)), iters=50) * 1e3, 3)
+
+    # host->device transfer bandwidth (8 MB f32, the engine's batch scale)
+    buf = np.random.default_rng(0).standard_normal(
+        (2 * 1024 * 1024,)).astype(np.float32)
+    dt = timeit(lambda: jax.block_until_ready(jax.device_put(buf, dev)),
+                warmup=2, iters=10)
+    out["h2d_MBps"] = round(buf.nbytes / dt / 1e6, 1)
+
+    # update kernel: the q5-shaped hot loop.  C keys x B bins resident
+    # state, n pre-aggregated (key,bin) cells per step, ONE packed
+    # f64[3+k, n] transfer per step — exactly KeyedBinState.update's
+    # device path (keyed_bins.py:61-95).
+    kinds = ("count", "sum", "max")
+    C, B, n = 8192, 16, 16384
+    kern = kb._update_kernel(kinds, C, B, n)
+    values = jax.device_put(jnp.stack(
+        [jnp.full((C, B), kb._init_value(kb.AggKind(k)), jnp.float64)
+         for k in kinds]), dev)
+    counts = jax.device_put(jnp.zeros((C, B), jnp.float64), dev)
+    rng = np.random.default_rng(1)
+    packed_np = np.empty((3 + len(kinds), n), np.float64)
+    packed_np[0] = rng.integers(0, C, n)
+    packed_np[1] = rng.integers(0, B, n)
+    packed_np[2] = 1.0
+    packed_np[3:] = rng.standard_normal((len(kinds), n))
+
+    state = [values, counts]
+
+    def step():
+        packed = jax.device_put(packed_np, dev)  # one transfer per step
+        v, c = kern(state[0], state[1], packed)
+        state[0], state[1] = v, c
+        jax.block_until_ready(c)
+
+    dt = timeit(step, warmup=3, iters=20)
+    out["update_step_ms"] = round(dt * 1e3, 3)
+    out["update_cells_per_sec"] = round(n / dt, 1)
+
+    # emit kernel: k panes gathered from the ring and reduced per key
+    k = 64
+    W = 5
+    ring = np.tile(np.arange(W, dtype=np.int32), (k, 1))
+    bin_ok = np.ones((k, W), dtype=bool)
+    ek = kb._emit_kernel(kinds, C, B, W, k)
+    ring_d = jax.device_put(ring, dev)
+    ok_d = jax.device_put(bin_ok, dev)
+
+    def estep():
+        r, cnt = ek(state[0], state[1], ring_d, ok_d)
+        jax.block_until_ready(cnt)
+
+    dt = timeit(estep, warmup=3, iters=20)
+    out["emit_step_ms"] = round(dt * 1e3, 3)
+    out["emit_key_panes_per_sec"] = round(C * k / dt, 1)
+
+    # pallas path: the engine's fused custom-kernel state update
+    # (pallas_kernels.update_bin_state — x32 scatter + f64 apply)
+    try:
+        from arroyo_tpu.ops import pallas_kernels as pk
+
+        if pk.pallas_enabled():
+            slots = packed_np[0].astype(np.int32)
+            bins = packed_np[1].astype(np.int32)
+            weights = np.concatenate(
+                [packed_np[2:3], packed_np[3:]]).astype(np.float32)
+
+            def pstep():
+                v, c = pk.update_bin_state(
+                    state[0], state[1], slots, bins, weights, C, B)
+                state[0], state[1] = v, c
+                jax.block_until_ready(c)
+
+            dt = timeit(pstep, warmup=3, iters=20)
+            out["pallas_update_step_ms"] = round(dt * 1e3, 3)
+            out["pallas_update_cells_per_sec"] = round(n / dt, 1)
+        else:
+            out["pallas"] = "disabled"
+    except Exception as e:  # pallas failure must not kill the microbench
+        out["pallas_error"] = f"{type(e).__name__}: {e}"[:300]
+    return out
+
+
+def main_kernels_child() -> None:
+    import jax  # noqa: F401  (fail fast if the backend is unreachable)
+
+    print(json.dumps(run_kernel_microbench()))
+
+
 def main_child() -> None:
     """The actual benchmark, run inside a supervised subprocess."""
     os.environ.setdefault("BATCH_SIZE", str(BATCH))
@@ -641,15 +777,18 @@ def main_child() -> None:
     if headline not in QUERIES:
         raise SystemExit(f"unknown BENCH_QUERY {headline!r}; "
                          f"choose from {sorted(QUERIES)}")
-    if os.environ.get("BENCH_ALL"):
+    if os.environ.get("BENCH_ALL", "1") not in ("0", "false", "no", ""):
         # one child process per query: queries measured in a shared
         # process degrade the later ones (allocator growth, jit-cache
-        # churn — q5 measured ~2x lower after three predecessors)
-        headline_result = None
+        # churn — q5 measured ~2x lower after three predecessors).
+        # Every per-query result is EMBEDDED in the single headline JSON
+        # line so the driver artifact carries all BASELINE configs, not
+        # just q5 (round-3 verdict: stderr-only results are unrecorded).
+        queries = {}
         for name in sorted(QUERIES):
             if name == headline:
                 continue
-            env = dict(os.environ, BENCH_CHILD="1", BENCH_ALL="",
+            env = dict(os.environ, BENCH_CHILD="1", BENCH_ALL="0",
                        BENCH_QUERY=name, BENCH_LAT_SECS="0",
                        BENCH_CONFIG5="0")
             try:
@@ -658,38 +797,41 @@ def main_child() -> None:
                     stdout=subprocess.PIPE, timeout=BENCH_TIMEOUT,
                     text=True)
                 if r.returncode == 0 and r.stdout.strip():
-                    print(r.stdout.strip().splitlines()[-1],
-                          file=sys.stderr)
+                    queries[name] = json.loads(
+                        r.stdout.strip().splitlines()[-1])
                 else:
-                    print(json.dumps({"metric": name,
-                                      "error": f"rc={r.returncode}"}),
-                          file=sys.stderr)
+                    queries[name] = {"error": f"rc={r.returncode}"}
             except subprocess.TimeoutExpired:
-                print(json.dumps({"metric": name, "error": "timeout"}),
-                      file=sys.stderr)
+                queries[name] = {"error": "timeout"}
+            print(json.dumps({name: queries[name]}), file=sys.stderr)
         headline_result = run_query(headline, QUERIES[headline])
         headline_result["backend"] = backend
         headline_result.update(run_latency())
-        emit_config5(backend)
+        headline_result["queries"] = queries
+        c5 = emit_config5(backend)
+        if c5 is not None:
+            headline_result["config5"] = c5
         print(json.dumps(headline_result))
     else:
         result = run_query(headline, QUERIES[headline])
         result["backend"] = backend
         result.update(run_latency())
-        emit_config5(backend)
+        c5 = emit_config5(backend)
+        if c5 is not None:
+            result["config5"] = c5
         print(json.dumps(result))
 
 
-def emit_config5(backend: str) -> None:
-    """BASELINE config #5 as a second metric line (stderr) + artifact."""
+def emit_config5(backend: str):
+    """BASELINE config #5: returned for embedding + stderr + artifact."""
     if os.environ.get("BENCH_CONFIG5", "1") in ("0", "false", "no"):
-        return
+        return None
     try:
         c5 = run_config5()
     except Exception as e:  # the headline must still print
         print(f"config5 bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)
-        return
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
     c5["backend"] = backend
     print(json.dumps(c5), file=sys.stderr)
     try:
@@ -699,9 +841,77 @@ def emit_config5(backend: str) -> None:
             f.write("\n")
     except OSError:
         pass
+    return c5
 
 
 BENCH_TIMEOUT = float(os.environ.get("BENCH_TIMEOUT", 2400))
+
+
+def host_fingerprint() -> dict:
+    """Machine/env fingerprint so cross-round artifact numbers can be
+    attributed (round-3 verdict: q5 1.75M->1.48M was unattributable with
+    no recorded environment).  No jax import — the supervisor must never
+    risk a hang."""
+    import platform
+
+    fp = {
+        "hostname": platform.node(),
+        "machine": platform.machine(),
+        "python": platform.python_version(),
+        "cpu_count": os.cpu_count(),
+        "tunnel": bool(os.environ.get("PALLAS_AXON_POOL_IPS")),
+    }
+    try:
+        r = subprocess.run(
+            ["git", "-C", os.path.dirname(os.path.abspath(__file__)),
+             "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10)
+        if r.returncode == 0:
+            fp["git"] = r.stdout.strip()
+    except Exception:
+        pass
+    try:
+        with open("/proc/loadavg") as f:
+            fp["loadavg_1m"] = float(f.read().split()[0])
+    except OSError:
+        pass
+    return fp
+
+
+KERNEL_BENCH_TIMEOUT = float(os.environ.get("BENCH_KERNEL_TIMEOUT", 420))
+
+
+def run_kernel_bench_supervised() -> dict:
+    """Kernel microbench on BOTH the accelerator (if not user-forced cpu)
+    and the CPU, each in its own bounded subprocess.  The accelerator
+    attempt runs even when the full-bench probe failed: the microbench
+    only needs the tunnel alive for seconds, and a device-kernel number
+    (or the recorded failure) is the falsifiable TPU evidence the full
+    pipeline can't always provide."""
+    out = {}
+    targets = [("cpu", dict(os.environ, BENCH_KERNELS_CHILD="1",
+                            JAX_PLATFORMS="cpu"))]
+    if os.environ.get("BENCH_FORCED_CPU") != "1":
+        acc = dict(os.environ, BENCH_KERNELS_CHILD="1")
+        acc.pop("JAX_PLATFORMS", None)
+        targets.insert(0, ("accelerator", acc))
+    for label, env in targets:
+        if label == "cpu":
+            env.pop("PALLAS_AXON_POOL_IPS", None)
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                stdout=subprocess.PIPE, timeout=KERNEL_BENCH_TIMEOUT,
+                text=True)
+        except subprocess.TimeoutExpired:
+            out[label] = {"error": "timed out after "
+                          f"{KERNEL_BENCH_TIMEOUT:.0f}s"}
+            continue
+        if r.returncode == 0 and r.stdout.strip():
+            out[label] = json.loads(r.stdout.strip().splitlines()[-1])
+        else:
+            out[label] = {"error": f"rc={r.returncode}"}
+    return out
 
 
 def main() -> None:
@@ -712,14 +922,20 @@ def main() -> None:
     if headline not in QUERIES:
         raise SystemExit(f"unknown BENCH_QUERY {headline!r}; "
                          f"choose from {sorted(QUERIES)}")
-    probe_backend()  # may force JAX_PLATFORMS=cpu for the child
+    user_forced_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    _, probe_failures = probe_backend()  # may force JAX_PLATFORMS=cpu
+    if probe_failures and not user_forced_cpu:
+        os.environ["BENCH_FORCED_CPU"] = "1"  # still try kernels on acc
     env = dict(os.environ, BENCH_CHILD="1")
     cpu_env = dict(env, JAX_PLATFORMS="cpu")
     cpu_env.pop("PALLAS_AXON_POOL_IPS", None)  # disable axon sitecustomize
     attempts = ([("cpu", cpu_env)] if env.get("JAX_PLATFORMS") == "cpu"
                 else [("accelerator", env), ("cpu", cpu_env)])
     last_err = "unknown"
-    failed_attempts = []  # record every attempt, incl. the accelerator one
+    # every failed attempt — probe and bench — lands in the artifact so a
+    # "backend: cpu" line always shows whether an accelerator was tried
+    failed_attempts = list(probe_failures)
+    line = None
     for label, attempt in attempts:
         try:
             r = subprocess.run(
@@ -731,28 +947,29 @@ def main() -> None:
             print(last_err, file=sys.stderr)
             continue
         if r.returncode == 0 and r.stdout.strip():
-            if failed_attempts:
-                # surface the failed accelerator attempt in the recorded
-                # line rather than silently reporting CPU only
-                line = json.loads(r.stdout.strip().splitlines()[-1])
-                line["failed_attempts"] = failed_attempts
-                print(json.dumps(line))
-            else:
-                sys.stdout.write(r.stdout)
-            return
+            line = json.loads(r.stdout.strip().splitlines()[-1])
+            break
         last_err = f"{label} bench exited rc={r.returncode}"
         failed_attempts.append({"attempt": label, "error": last_err})
         print(last_err, file=sys.stderr)
-    print(json.dumps({
-        "metric": "nexmark_%s_events_per_sec" % os.environ.get(
-            "BENCH_QUERY", "q5"),
-        "value": 0, "unit": "events/sec", "vs_baseline": 0.0,
-        "error": last_err,
-    }))
+    if line is None:
+        line = {
+            "metric": "nexmark_%s_events_per_sec" % headline,
+            "value": 0, "unit": "events/sec", "vs_baseline": 0.0,
+            "error": last_err,
+        }
+    if failed_attempts:
+        line["failed_attempts"] = failed_attempts
+    if os.environ.get("BENCH_KERNELS", "1") not in ("0", "false", "no"):
+        line["kernel_bench"] = run_kernel_bench_supervised()
+    line["fingerprint"] = host_fingerprint()
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":
-    if os.environ.get("BENCH_CHILD"):
+    if os.environ.get("BENCH_KERNELS_CHILD"):
+        main_kernels_child()
+    elif os.environ.get("BENCH_CHILD"):
         main_child()
     else:
         try:

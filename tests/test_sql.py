@@ -385,6 +385,47 @@ def test_exec_nexmark_q5_shape():
     assert np.all(out.columns["num"] >= 1)
 
 
+def test_exec_group_by_window_consolidates_refinements():
+    """GROUP BY the window of a windowed input (q5's MaxBids shape) must
+    emit exactly ONE final row per window — even at parallelism > 1,
+    where one window's partial rows arrive in several batches from
+    several upstream subtasks.  The stale partial-aggregate rows that an
+    eager updating aggregate would leak (advisor r3 medium finding) must
+    be consolidated before emission."""
+    import collections
+
+    from arroyo_tpu.sql.planner import Planner
+
+    rng = np.random.default_rng(11)
+    n = 6000
+    ts = np.sort(rng.integers(0, 6 * SEC, n)).astype(np.int64)
+    keys = rng.integers(0, 12, n).astype(np.int64)
+    provider = SchemaProvider()
+    provider.add_memory_table("events", {"k": "i"}, [
+        Batch(ts[i:i + 500], {"k": keys[i:i + 500]})
+        for i in range(0, n, 500)])
+    clear_sink("results")
+    prog = Planner(provider).plan("""
+        SELECT max(num) AS maxn, window FROM (
+          SELECT count(*) AS num, TUMBLE(INTERVAL '2' SECOND) AS window
+          FROM events GROUP BY k, 2
+        ) GROUP BY 2
+    """, query_parallelism=2)
+    LocalRunner(prog).run()
+    out = Batch.concat(sink_output("results"))
+    per_w = collections.Counter(int(w) for w in out.columns["window_end"])
+    assert all(v == 1 for v in per_w.values()), per_w
+    want = collections.defaultdict(collections.Counter)
+    for t, k in zip(ts.tolist(), keys.tolist()):
+        wend = (t // (2 * SEC) + 1) * 2 * SEC
+        want[wend][k] += 1
+    assert set(per_w) == set(want)
+    got = {int(w): int(m) for w, m in zip(out.columns["window_end"],
+                                          out.columns["maxn"])}
+    for wend, cnt in want.items():
+        assert got[wend] == max(cnt.values()), (wend, got[wend], cnt)
+
+
 def test_exec_nullable_bool_predicate():
     """Object-dtype nullable bool columns (JSON rows with missing fields)
     must evaluate in predicates: None -> not matched, not a crash."""

@@ -47,6 +47,7 @@ from ..graph.logical import (
     TumblingWindow,
 )
 from ..ops.expr import CompiledExpr, eval_record_expr
+from ..ops.join import join_pairs
 from ..ops.keyed_bins import KeyedBinState
 from ..ops.segment import segment_aggregate
 from ..state.tables import DeviceTable, TableDescriptor, TableType
@@ -723,20 +724,6 @@ def _empty_like_side(tmpl: "_SideTemplate", other: Batch) -> Batch:
                  np.zeros(0, dtype=np.uint64), other.key_cols)
 
 
-def _match_pairs(lk: np.ndarray, rk_sorted: np.ndarray
-                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """(lidx, ridx_into_sorted, per-left-row match counts) for an equi-join
-    of left key hashes against an already-sorted right key array."""
-    left_start = np.searchsorted(rk_sorted, lk, side="left")
-    left_end = np.searchsorted(rk_sorted, lk, side="right")
-    counts = left_end - left_start
-    lidx = np.repeat(np.arange(len(lk)), counts)
-    offs = np.arange(len(lidx)) - np.repeat(
-        np.cumsum(counts) - counts, counts)
-    ridx = np.repeat(left_start, counts) + offs
-    return lidx, ridx, counts
-
-
 def _concat_col(parts: List[np.ndarray]) -> np.ndarray:
     """Concatenate column fragments, promoting to object when any
     fragment is (None-padded rows mix with typed rows).
@@ -767,13 +754,11 @@ def join_batches(l: Batch, r: Batch, end: int,
     LEFT/RIGHT/FULL null-padding of unmatched rows (the reference's
     windowed list-merge, arroyo-sql/src/expressions.rs:134-230).
 
-    Match counting and position arithmetic are vectorized; pair expansion is
-    np.repeat (the result size is data-dependent, so it stays on host — the
-    per-window aggregation around it is the device work)."""
-    lo = np.argsort(l.key_hash, kind="stable")
-    ro = np.argsort(r.key_hash, kind="stable")
-    lk, rk = l.key_hash[lo], r.key_hash[ro]
-    lidx, ridx, counts = _match_pairs(lk, rk)
+    Sort/probe/prefix-sum/pair-expansion run as device kernels for large
+    windows (ops/join.py, SURVEY "Core TPU kernel #3"); the host only
+    materializes the output batch by the computed indices, so every
+    payload dtype (strings, exact int64) survives untouched."""
+    lo, ro, lidx, ridx, counts = join_pairs(l.key_hash, r.key_hash)
 
     l_rows = l.select(lo[lidx])
     r_rows = r.select(ro[ridx])
@@ -921,23 +906,25 @@ class JoinWithExpirationOperator(Operator):
                                        UpdateOp.DELETE.value)
                     await ctx.collect(out)
 
-        # 2. joined CREATEs for matched pairs
+        # 2. joined CREATEs for matched pairs (device sort/probe/expand
+        #    kernels for large states — ops/join.py)
         if have_opp:
-            ro = np.argsort(opp.key_hash, kind="stable")
-            lidx, ridx, counts = _match_pairs(batch.key_hash,
-                                              opp.key_hash[ro])
+            lo, ro, lidx, ridx, counts = join_pairs(batch.key_hash,
+                                                    opp.key_hash)
             if len(lidx):
-                my_rows = batch.select(lidx)
+                my_rows = batch.select(lo[lidx])
                 opp_rows = opp.select(ro[ridx])
                 out = self._orient(my_rows, dict(opp_rows.columns), side,
                                    end, op_create)
                 await ctx.collect(out)
+            unmatched = np.zeros(len(batch), dtype=bool)
+            unmatched[lo[counts == 0]] = True  # back to original order
         else:
-            counts = np.zeros(len(batch), dtype=np.int64)
+            unmatched = np.ones(len(batch), dtype=bool)
 
         # 3. null-padded CREATEs for my unmatched rows
-        if my_outer and (counts == 0).any():
-            un = batch.select(counts == 0)
+        if my_outer and unmatched.any():
+            un = batch.select(unmatched)
             pad = opp_tmpl.null_cols(len(un))
             out = self._orient(un, pad, side, end, op_create)
             await ctx.collect(out)
@@ -1127,7 +1114,10 @@ class NonWindowAggOperator(Operator):
         ready = []
         for t, k, rec in list(self.table.snapshot()):
             bound = rec.get(fk)
-            if bound is None or float(bound) <= watermark:
+            # integer comparison: window_end is epoch micros (~1.8e18,
+            # above 2^53), where a float round-trip can round DOWN and
+            # flush a window before a lagging subtask's pane arrives
+            if bound is None or int(bound) <= watermark:
                 ready.append((t, k, rec))
         if not ready:
             return

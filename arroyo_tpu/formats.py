@@ -182,6 +182,12 @@ def _py(v: Any) -> Any:
 # ---------------------------------------------------------------------------
 
 
+class _TransientColumnarError(ValueError):
+    """Columnar fast-path failure caused by one batch's DATA (not the
+    stream's structure): fall back for that batch without disabling the
+    fast path."""
+
+
 class Format:
     """bytes[] -> rows and rows -> bytes[].  Stateless and reusable."""
 
@@ -242,6 +248,11 @@ class JsonFormat(Format):
                 # no pyarrow in this environment: never retry the import
                 # on the hot path
                 self._arrow_ok = False
+            except _TransientColumnarError:
+                # per-record data glitch (e.g. one payload missing the
+                # timestamp field): row-path THIS batch only, keep the
+                # fast path for the well-formed rest of the stream
+                pass
             except Exception:
                 # payload shape the columnar path can't express (nested
                 # objects, arrays, mixed types): stick to the row path
@@ -288,8 +299,10 @@ class JsonFormat(Format):
                 # a payload missing the timestamp field surfaced as a
                 # null -> NaN, and astype(int64) on NaN is undefined
                 # behavior (platform-dependent garbage event times); the
-                # row path handles missing fields explicitly
-                raise ValueError(
+                # row path handles missing fields explicitly.  This is a
+                # per-record data glitch, not a structural payload shape
+                # — it must NOT latch the fast path off for the stream.
+                raise _TransientColumnarError(
                     f"null {timestamp_field!r} in columnar JSON batch")
             ts = tcol.astype(np.int64)
         else:

@@ -1,0 +1,152 @@
+"""Device-side equi-join pair computation (SURVEY "Core TPU kernel #3";
+reference: arroyo-worker/src/operators/joins.rs:14-181, per-record Rust
+hash-join loops re-designed as batched XLA kernels).
+
+The join's compute — sorting both key columns, probing match ranges,
+prefix-summing match counts, and expanding (left, right) index pairs for
+the cross product — runs as four static-shape jitted kernels on the
+device.  Only the final materialization (gathering payload columns by
+the computed indices) stays on host, where numpy fancy-indexing is a
+memcpy and every dtype (strings, exact int64) survives untouched.
+
+Static shapes: inputs pad to power-of-two buckets (sentinel keys sort to
+the end and are excluded by valid-count masking), and the pair output
+pads to the bucket of the exact total from the probe's prefix sum — so
+each (bucket_l, bucket_r, bucket_m) triple compiles once.
+
+Dispatch discipline: one sort per side, one probe, one expansion = four
+device round trips per fired window, independent of fan-out.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..obs.perf import timed_device
+
+# padding key: sorts after every real hash; a real key colliding with it
+# (probability ~2^-64 per row) routes the call to the host fallback
+SENTINEL = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def _bucket(n: int, floor: int = 512) -> int:
+    b = floor
+    while b < n:
+        b <<= 1
+    return b
+
+
+@functools.lru_cache(maxsize=64)
+def _sort_kernel(n: int):
+    @jax.jit
+    def run(keys):
+        order = jnp.argsort(keys, stable=True)
+        return order, keys[order]
+
+    return run
+
+
+@functools.lru_cache(maxsize=64)
+def _probe_kernel(nl: int, nr: int):
+    @jax.jit
+    def run(lk_sorted, rk_sorted, nl_valid, nr_valid):
+        start = jnp.searchsorted(rk_sorted, lk_sorted, side="left")
+        end = jnp.searchsorted(rk_sorted, lk_sorted, side="right")
+        # right padding lives in [nr_valid, nr): clamp both bounds
+        start = jnp.minimum(start, nr_valid)
+        end = jnp.minimum(end, nr_valid)
+        counts = jnp.where(jnp.arange(nl) < nl_valid, end - start, 0)
+        cum = jnp.cumsum(counts)
+        return start, counts, cum
+
+    return run
+
+
+@functools.lru_cache(maxsize=64)
+def _expand_kernel(nl: int, m: int):
+    @jax.jit
+    def run(start, cum):
+        # pair j belongs to the left row whose cumulative-count interval
+        # contains j; its right offset is j's position in that interval
+        j = jnp.arange(m)
+        lidx = jnp.searchsorted(cum, j, side="right").clip(0, nl - 1)
+        before = jnp.where(lidx > 0, cum[lidx - 1], 0)
+        ridx = start[lidx] + (j - before)
+        return lidx, ridx
+
+    return run
+
+
+def _host_pairs(lk_sorted: np.ndarray, rk_sorted: np.ndarray
+                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host fallback: identical contract, numpy end to end."""
+    left_start = np.searchsorted(rk_sorted, lk_sorted, side="left")
+    left_end = np.searchsorted(rk_sorted, lk_sorted, side="right")
+    counts = left_end - left_start
+    lidx = np.repeat(np.arange(len(lk_sorted)), counts)
+    offs = np.arange(len(lidx)) - np.repeat(
+        np.cumsum(counts) - counts, counts)
+    ridx = np.repeat(left_start, counts) + offs
+    return lidx, ridx, counts
+
+
+def device_join_enabled(n_rows: int) -> bool:
+    """auto: device path on a real accelerator for batches big enough to
+    amortize dispatch (on the CPU backend the "device" is the same
+    core, so kernel dispatch is pure overhead — measured ~9% on q5);
+    on: always (tests/fuzz parity); off: host numpy only."""
+    mode = os.environ.get("ARROYO_DEVICE_JOIN", "auto")
+    if mode == "off":
+        return False
+    if mode == "on":
+        return True
+    if jax.default_backend() == "cpu":
+        return False
+    return n_rows >= int(os.environ.get("ARROYO_DEVICE_JOIN_MIN", 2048))
+
+
+def join_pairs(lk: np.ndarray, rk: np.ndarray
+               ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                          np.ndarray, np.ndarray]:
+    """(lo, ro, lidx, ridx, counts) for an equi-join of two uint64 key
+    arrays: ``lo``/``ro`` sort each side, ``lidx``/``ridx`` index pairs
+    into the sorted orders, ``counts`` is per-sorted-left-row match
+    count (for outer-join unmatched masks)."""
+    nl, nr = len(lk), len(rk)
+    if not device_join_enabled(nl + nr) or nl == 0 or nr == 0 \
+            or (lk == SENTINEL).any() or (rk == SENTINEL).any():
+        lo = np.argsort(lk, kind="stable")
+        ro = np.argsort(rk, kind="stable")
+        lidx, ridx, counts = _host_pairs(lk[lo], rk[ro])
+        return lo, ro, lidx, ridx, counts
+
+    nlp, nrp = _bucket(nl), _bucket(nr)
+    lk_p = np.full(nlp, SENTINEL, np.uint64)
+    lk_p[:nl] = lk
+    rk_p = np.full(nrp, SENTINEL, np.uint64)
+    rk_p[:nr] = rk
+    lo_d, lks_d = timed_device(_sort_kernel(nlp), lk_p)
+    ro_d, rks_d = timed_device(_sort_kernel(nrp), rk_p)
+    start_d, counts_d, cum_d = timed_device(
+        _probe_kernel(nlp, nrp), lks_d, rks_d, nl, nr)
+    counts = np.asarray(counts_d)[:nl]
+    total = int(counts.sum())
+    if total:
+        m = _bucket(total)
+        lidx_d, ridx_d = timed_device(_expand_kernel(nlp, m),
+                                      start_d, cum_d)
+        lidx = np.asarray(lidx_d)[:total]
+        ridx = np.asarray(ridx_d)[:total]
+    else:
+        lidx = np.zeros(0, dtype=np.int64)
+        ridx = np.zeros(0, dtype=np.int64)
+    lo = np.asarray(lo_d)[:nl]
+    ro = np.asarray(ro_d)[:nr]
+    return lo, ro, lidx, ridx, counts

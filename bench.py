@@ -702,6 +702,23 @@ def run_kernel_microbench() -> dict:
     out["emit_step_ms"] = round(dt * 1e3, 3)
     out["emit_key_panes_per_sec"] = round(C * k / dt, 1)
 
+    # join kernels: sort/probe/expand on device (ops/join.py — the q8
+    # windowed-join hot path), host materialization excluded
+    from arroyo_tpu.ops import join as dj
+
+    os.environ["ARROYO_DEVICE_JOIN"] = "on"
+    nl = nr = 8192
+    jrng = np.random.default_rng(2)
+    lk = jrng.integers(0, 4096, nl).astype(np.uint64)
+    rk = jrng.integers(0, 4096, nr).astype(np.uint64)
+
+    def jstep():
+        dj.join_pairs(lk, rk)
+
+    dt = timeit(jstep, warmup=3, iters=20)
+    out["join_step_ms"] = round(dt * 1e3, 3)
+    out["join_rows_per_sec"] = round((nl + nr) / dt, 1)
+
     # pallas path: the engine's fused custom-kernel state update
     # (pallas_kernels.update_bin_state — x32 scatter + f64 apply)
     try:
@@ -924,8 +941,12 @@ def main() -> None:
                          f"choose from {sorted(QUERIES)}")
     user_forced_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
     _, probe_failures = probe_backend()  # may force JAX_PLATFORMS=cpu
-    if probe_failures and not user_forced_cpu:
-        os.environ["BENCH_FORCED_CPU"] = "1"  # still try kernels on acc
+    if user_forced_cpu:
+        # the kernel microbench honors an EXPLICIT user cpu choice; a
+        # probe failure does NOT set this — the microbench needs only
+        # seconds of tunnel uptime, so it retries the accelerator even
+        # when the full bench could not
+        os.environ["BENCH_FORCED_CPU"] = "1"
     env = dict(os.environ, BENCH_CHILD="1")
     cpu_env = dict(env, JAX_PLATFORMS="cpu")
     cpu_env.pop("PALLAS_AXON_POOL_IPS", None)  # disable axon sitecustomize

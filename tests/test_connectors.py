@@ -40,6 +40,21 @@ def test_json_format_roundtrip():
     assert list(batch.columns["b"]) == ["x", "y"]
 
 
+def test_json_null_timestamp_falls_back_without_latching():
+    """One payload missing the timestamp field must row-path THAT batch
+    (no NaN->int64 undefined behavior) and keep the columnar fast path
+    for subsequent well-formed batches (advisor r3 finding + review)."""
+    fmt = JsonFormat()
+    good = [json.dumps({"ts": 10 + i, "v": i}).encode() for i in range(3)]
+    bad = good[:2] + [json.dumps({"v": 99}).encode()]
+    b1 = fmt.batch(bad, timestamp_field="ts")
+    assert len(b1) == 3  # row path handled the missing field explicitly
+    assert getattr(fmt, "_arrow_ok", True), "fast path must not latch off"
+    b2 = fmt.batch(good, timestamp_field="ts")
+    assert b2.timestamp.tolist() == [10, 11, 12]
+    assert b2.timestamp.dtype == np.int64
+
+
 def test_json_confluent_header_strip():
     fmt = JsonFormat(confluent_schema_registry=True)
     payload = b"\x00\x00\x00\x00\x07" + json.dumps({"v": 42}).encode()

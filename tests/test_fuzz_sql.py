@@ -157,9 +157,13 @@ def test_fuzz_window_aggregates(seed, mode, width_s, slide_s, gap_s, n,
                     seed, key, col, have, want)
 
 
+@pytest.mark.parametrize("device_join", ["off", "on"])
 @pytest.mark.parametrize("seed", [11, 12, 13])
-def test_fuzz_windowed_join(seed):
-    """Random windowed equi-joins (q8 shape) against a set oracle."""
+def test_fuzz_windowed_join(seed, device_join, monkeypatch):
+    """Random windowed equi-joins (q8 shape) against a set oracle —
+    both the host numpy path and the device sort/probe/expand kernels
+    (ops/join.py) must produce identical results."""
+    monkeypatch.setenv("ARROYO_DEVICE_JOIN", device_join)
     rng = np.random.default_rng(seed)
     n = int(rng.integers(500, 3000))
     ts_a, ka, _ = _make_table(rng, n, int(rng.integers(3, 20)), 6, 0.0)
@@ -197,15 +201,17 @@ def test_fuzz_windowed_join(seed):
     assert got == exp, f"seed {seed}"
 
 
+@pytest.mark.parametrize("device_join", ["off", "on"])
 @pytest.mark.parametrize("seed,kind", [
     (21, "LEFT"), (22, "RIGHT"), (23, "FULL"),
     (24, "LEFT"), (25, "FULL")])
-def test_fuzz_outer_join_net_result(seed, kind):
+def test_fuzz_outer_join_net_result(seed, kind, device_join, monkeypatch):
     """Random LEFT/RIGHT/FULL joins: after applying __op retractions,
     the net row multiset must equal the standard SQL outer-join result
     regardless of arrival interleaving."""
     from collections import Counter
 
+    monkeypatch.setenv("ARROYO_DEVICE_JOIN", device_join)
     rng = np.random.default_rng(seed)
     nl = int(rng.integers(5, 60))
     nr = int(rng.integers(5, 60))

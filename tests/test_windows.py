@@ -478,3 +478,38 @@ def test_device_topk_matches_host_lexsort(rng):
         rank = np.arange(n) - is_start.nonzero()[0][seg_id]
         exp = np.sort(order[rank < k])
         np.testing.assert_array_equal(got, exp)
+
+
+def test_device_join_pairs_matches_host(rng, monkeypatch):
+    """ops/join.join_pairs: the device sort/probe/expand kernels must
+    produce exactly the host fallback's (lo, ro, lidx, ridx, counts) —
+    including multi-match fan-out, empty intersections, and sizes
+    crossing the pad buckets."""
+    from arroyo_tpu.ops import join as dj
+
+    for nl, nr, span in [(5, 7, 4), (600, 300, 50), (2048, 4096, 130),
+                         (1000, 1, 9), (1, 1000, 9)]:
+        lk = rng.integers(0, span, nl).astype(np.uint64)
+        rk = rng.integers(0, span, nr).astype(np.uint64)
+        monkeypatch.setenv("ARROYO_DEVICE_JOIN", "off")
+        h = dj.join_pairs(lk, rk)
+        monkeypatch.setenv("ARROYO_DEVICE_JOIN", "on")
+        d = dj.join_pairs(lk, rk)
+        for name, hv, dv in zip(("lo", "ro", "lidx", "ridx", "counts"),
+                                h, d):
+            np.testing.assert_array_equal(hv, dv, err_msg=f"{name} "
+                                          f"nl={nl} nr={nr}")
+
+
+def test_device_join_sentinel_collision_falls_back(monkeypatch):
+    """A real key equal to the pad sentinel routes to the host path and
+    still joins correctly."""
+    from arroyo_tpu.ops import join as dj
+
+    monkeypatch.setenv("ARROYO_DEVICE_JOIN", "on")
+    lk = np.array([3, dj.SENTINEL, 5], dtype=np.uint64)
+    rk = np.array([dj.SENTINEL, 5], dtype=np.uint64)
+    lo, ro, lidx, ridx, counts = dj.join_pairs(lk, rk)
+    pairs = {(int(lk[lo[i]]), int(rk[ro[j]]))
+             for i, j in zip(lidx.tolist(), ridx.tolist())}
+    assert pairs == {(int(dj.SENTINEL), int(dj.SENTINEL)), (5, 5)}

@@ -425,13 +425,86 @@ class SessionWindowOperator(Operator):
     async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
         assert batch.key_hash is not None
         self.buffer.append(batch)
-        order = np.argsort(batch.key_hash, kind="stable")
+        # collapse events -> candidate session intervals for the WHOLE
+        # batch in three vector ops (events within gap of their
+        # predecessor merge, so a burst becomes ONE interval): the
+        # per-key python work then scales with interval count, not event
+        # count — the config5 hot loop (windows.rs:232-302 semantics)
+        order = np.lexsort((batch.timestamp, batch.key_hash))
         kh = batch.key_hash[order]
         ts = batch.timestamp[order]
-        uniq, starts = np.unique(kh, return_index=True)
-        bounds = np.append(starts, len(kh))
-        for i, k in enumerate(uniq.tolist()):
-            self._merge_key(int(k), ts[bounds[i]:bounds[i + 1]], ctx)
+        n = len(kh)
+        newkey = np.empty(n, dtype=bool)
+        newkey[0] = True
+        newkey[1:] = kh[1:] != kh[:-1]
+        brk = newkey.copy()
+        brk[1:] |= (ts[1:] - ts[:-1]) > self.gap
+        ist = ts[brk]                      # interval starts
+        ien = ts[np.append(brk[1:], True)] + self.gap  # last of group + gap
+        ikh = kh[brk]
+        kb = newkey[brk].nonzero()[0]      # key boundaries among intervals
+        kb = np.append(kb, len(ikh))
+        span_ok = (ien - ist) <= MAX_SESSION_SIZE_MICROS
+        key_starts = np.append(newkey.nonzero()[0], n)
+        for i in range(len(kb) - 1):
+            k = int(ikh[kb[i]])
+            lo, hi = kb[i], kb[i + 1]
+            if not span_ok[lo:hi].all() or not self._merge_key_intervals(
+                    k, ist[lo:hi].tolist(), ien[lo:hi].tolist(),
+                    int(ts[key_starts[i + 1] - 1]), ctx):
+                # a burst longer than MAX_SESSION_SIZE, or a merge that
+                # would clamp-truncate past an incoming interval's end
+                # (events beyond the clamp must START a new session, and
+                # only the per-event path knows their positions): rare —
+                # the incremental-clamp-splitting path is authoritative
+                self._merge_key(k, ts[key_starts[i]:key_starts[i + 1]],
+                                ctx)
+
+    def _merge_key_intervals(self, kh: int, ists: List[int],
+                             iens: List[int], max_t: int,
+                             ctx: Context) -> bool:
+        """Union sorted candidate intervals into the key's sorted session
+        list — linear two-pointer sweep with the same touching-merges and
+        incremental max-size clamp as the per-event path.  Returns False
+        WITHOUT touching state when a clamp would truncate below a
+        contributing interval's end (events past the clamp would be
+        silently swallowed; the caller re-runs the per-event path)."""
+        old: List[Tuple[int, int]] = list(self.windows.get(kh) or [])
+        merged: List[Tuple[int, int]] = []
+        i = j = 0
+        no, ni = len(old), len(ists)
+        while i < no or j < ni:
+            if i < no and (j >= ni or old[i][0] <= ists[j]):
+                s, e = old[i]
+                i += 1
+            else:
+                s, e = ists[j], iens[j]
+                j += 1
+            if merged and s <= merged[-1][1]:
+                ps, pe = merged[-1]
+                ne = max(pe, e)
+                if ne - ps > MAX_SESSION_SIZE_MICROS:
+                    if ps + MAX_SESSION_SIZE_MICROS < e:
+                        return False  # clamp would swallow interval tail
+                    ne = ps + MAX_SESSION_SIZE_MICROS
+                merged[-1] = (ps, ne)
+            else:
+                if e - s > MAX_SESSION_SIZE_MICROS:
+                    return False  # guarded by span_ok; belt-and-braces
+                merged.append((s, e))
+        if merged == old:
+            self.windows.insert(max_t, kh, old)
+            return True
+        new_set = set(merged)
+        for (s, e) in old:
+            if (s, e) not in new_set:
+                ctx.timers.cancel(("sess", kh, s))
+        old_set = set(old)
+        self.windows.insert(max_t, kh, merged)
+        for (s, e) in merged:
+            if (s, e) not in old_set:
+                ctx.timers.schedule(int(e), ("sess", kh, s))
+        return True
 
     async def handle_timer(self, time: int, key: Any, payload: Any,
                            ctx: Context) -> None:

@@ -262,17 +262,35 @@ class JsonFormat(Format):
 
     def _batch_arrow(self, payloads: Sequence[bytes],
                      timestamp_field: Optional[str]) -> Batch:
-        import io
-
-        import pyarrow as pa
-        import pyarrow.json as paj
-
+        if not self.confluent_schema_registry and isinstance(
+                payloads, list) and payloads and \
+                isinstance(payloads[0], bytes):
+            # hot path: a list of bytes with nothing to strip — avoid
+            # 200k/s of per-payload isinstance/strip calls.  ONLY the
+            # join is guarded: a None/str mid-list raises TypeError
+            # here; any later error must surface, not silently re-parse
+            try:
+                buf = b"\n".join(payloads)
+            except TypeError:
+                buf = None  # mixed payload types: general path below
+            if buf is not None:
+                return self._batch_arrow_raw(buf, len(payloads),
+                                             timestamp_field)
         raw = [self._strip(p if isinstance(p, bytes) else str(p).encode())
                for p in payloads if p is not None]
         if not raw:
             return Batch(np.zeros(0, dtype=np.int64), {})
-        tbl = paj.read_json(io.BytesIO(b"\n".join(raw)))
-        if len(tbl) != len(raw):
+        return self._batch_arrow_raw(b"\n".join(raw), len(raw),
+                                     timestamp_field)
+
+    def _batch_arrow_raw(self, buf: bytes, n_rows: int,
+                         timestamp_field: Optional[str]) -> Batch:
+        import io
+
+        import pyarrow as pa
+        import pyarrow.json as paj
+        tbl = paj.read_json(io.BytesIO(buf))
+        if len(tbl) != n_rows:
             raise ValueError("row-count mismatch (multi-object payloads)")
         cols: Dict[str, np.ndarray] = {}
         for name in tbl.column_names:
@@ -306,7 +324,7 @@ class JsonFormat(Format):
                     f"null {timestamp_field!r} in columnar JSON batch")
             ts = tcol.astype(np.int64)
         else:
-            ts = np.full(len(raw), now_micros(), dtype=np.int64)
+            ts = np.full(n_rows, now_micros(), dtype=np.int64)
         return Batch(ts, cols)
 
     def deserialize(self, payloads: Sequence[bytes]) -> List[Dict[str, Any]]:

@@ -59,6 +59,25 @@ def _segment_agg_kernel(n_padded: int, n_segments: int, agg_kinds: Tuple[str, ..
     return run
 
 
+def _segmented_median(v: np.ndarray, kh_sorted: np.ndarray,
+                      uniq: np.ndarray, seg_start: np.ndarray
+                      ) -> np.ndarray:
+    """Per-segment median in three vector ops (the np.median UDAF fast
+    path): in-segment value sort via one lexsort, NaNs last, then the
+    two middle elements of each segment's non-null prefix."""
+    n = len(v)
+    if n == 0 or len(seg_start) == 0:
+        return np.zeros(0, dtype=np.float64)
+    so = np.lexsort((v, kh_sorted))  # NaN sorts after every number
+    vs = v[so]
+    sizes = np.diff(np.append(seg_start, n))
+    nn = sizes - np.add.reduceat(np.isnan(vs).astype(np.int64), seg_start)
+    lo_i = seg_start + np.maximum(nn - 1, 0) // 2
+    hi_i = seg_start + np.maximum(nn, 1) // 2
+    med = 0.5 * (vs[np.minimum(lo_i, n - 1)] + vs[np.minimum(hi_i, n - 1)])
+    return np.where(nn > 0, med, np.nan)
+
+
 def segment_aggregate(
     key_hash: np.ndarray,
     timestamps: np.ndarray,
@@ -103,6 +122,13 @@ def segment_aggregate(
             from ..formats import nan_validity
 
             v = agg_inputs[a.column][order]
+            if a.fn is np.median and np.asarray(v).dtype.kind in "if":
+                # vectorized across ALL segments: one in-segment sort,
+                # then middle-element picks — NaNs sort last inside each
+                # segment, so the non-null count bounds the true middle
+                distinct_results[a.output] = _segmented_median(
+                    np.asarray(v, dtype=np.float64), kh, uniq, seg_start)
+                continue
             ok = nan_validity(v, None)
             ok_rows = (np.ones(len(v), dtype=bool) if ok is None
                        else np.asarray(ok))

@@ -129,6 +129,37 @@ def test_session_windows_merge():
     assert rows == [(1, 3, 0), (2, 1, 0), (2, 1, 5 * SEC)]
 
 
+def test_session_windows_max_size_clamp_splits():
+    """Events chaining past the MAX_SESSION_SIZE clamp must START a new
+    session (reference windows.rs clamp), not be swallowed by the
+    vectorized interval merge (r4 review finding: the clamped union
+    would silently drop the tail events)."""
+    from arroyo_tpu.engine.operators_window import MAX_SESSION_SIZE_MICROS
+
+    gap = 10 * SEC
+    MAX = MAX_SESSION_SIZE_MICROS
+    # batch 1: a 9s-spaced chain to MAX-5s — the per-event path (span_ok
+    # routes there) clamps the merged session to [0, MAX).  batch 2:
+    # events at MAX-1 (inside the clamped session) and MAX+2 — the
+    # interval merge would clamp-truncate past MAX+2, so it must fall
+    # back and split: MAX-1 joins session 1, MAX+2 opens session 2.
+    ts1 = np.arange(0, MAX - 5 * SEC + 1, 9 * SEC, dtype=np.int64)
+    ts2 = np.array([MAX - 1, MAX + 2], dtype=np.int64)
+    aggs = [AggSpec(AggKind.COUNT, None, "cnt")]
+    out = run_pipeline(
+        [Batch(ts1, {"k": np.full(len(ts1), 7, np.int64),
+                     "v": np.ones(len(ts1), np.int64)}),
+         Batch(ts2, {"k": np.full(2, 7, np.int64),
+                     "v": np.ones(2, np.int64)})],
+        lambda s: s.key_by("k").window(SessionWindow(gap), aggs)
+        .sink("memory", {"name": "out"}),
+    )
+    rows = sorted((int(out.columns["window_start"][i]),
+                   int(out.columns["cnt"][i]))
+                  for i in range(len(out)))
+    assert rows == [(0, len(ts1) + 1), (MAX + 2, 1)], rows
+
+
 def test_tumbling_top_n(rng):
     ev = make_events(rng, n=3000, n_keys=50, span=3 * SEC)
     out = run_pipeline(

@@ -86,10 +86,11 @@ class Job:
 
 class ControllerServer:
     def __init__(self, scheduler: Optional[Scheduler] = None,
-                 host: str = "127.0.0.1"):
-        if scheduler is None:
-            import os
+                 host: str = "127.0.0.1",
+                 db_path: Optional[str] = None):
+        import os
 
+        if scheduler is None:
             if os.environ.get("SCHEDULER"):
                 from .scheduler import scheduler_from_env
 
@@ -102,6 +103,15 @@ class ControllerServer:
         self.jobs: Dict[str, Job] = {}
         self.addr: Optional[str] = None
         self.sink_subscribers: Dict[str, List[asyncio.Queue]] = {}
+        # durable job state (states/mod.rs:577-628 analog): every
+        # non-terminal job in the sqlite store is resumed on start()
+        db_path = db_path or os.environ.get("CONTROLLER_DB")
+        if db_path:
+            from .store import ControllerStore
+
+            self.store: Optional[ControllerStore] = ControllerStore(db_path)
+        else:
+            self.store = None
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -126,13 +136,63 @@ class ControllerServer:
         self.addr = os.environ.get(
             "CONTROLLER_ADVERTISE_ADDR",
             f"{'127.0.0.1' if self.host == '0.0.0.0' else self.host}:{p}")
+        if self.store is not None:
+            await self._resume_persisted()
         return self.addr
+
+    async def _resume_persisted(self) -> None:
+        """Adopt every non-terminal job from the durable store: reap
+        orphaned workers from the previous controller incarnation, then
+        re-drive each job's FSM with restore=True so it resumes from its
+        last completed checkpoint (states/mod.rs:577-628)."""
+        for row in self.store.resumable():
+            if row.stop_requested:
+                # a stop was in flight when the controller died; without
+                # live workers there is nothing left to checkpoint-stop —
+                # the job's last completed checkpoint already exists
+                self.store.set_state(row.job_id, JobState.STOPPED.value)
+                continue
+            try:
+                program = pickle.loads(row.program)
+            except Exception as e:
+                logger.error("job %s: stored program unreadable: %s",
+                             row.job_id, e)
+                self.store.set_state(row.job_id, JobState.FAILED.value,
+                                     f"stored program unreadable: {e}")
+                continue
+            await self.scheduler.reap(row.job_id,
+                                      self.store.workers(row.job_id))
+            self.store.set_workers(row.job_id, [])
+            job = Job(row.job_id, program, row.checkpoint_url,
+                      max(n.parallelism for n in program.nodes()))
+            job.epoch = row.epoch
+            job.min_epoch = row.min_epoch
+            self._attach_store(job, row.n_workers)
+            self.jobs[row.job_id] = job
+            logger.info("resuming job %s from durable store (stored "
+                        "state %s, epoch %d)", row.job_id, row.state,
+                        row.epoch)
+            job.supervisor = asyncio.ensure_future(
+                self._drive(job, row.n_workers, restore=True))
+
+    def _attach_store(self, job: Job, n_workers: int) -> None:
+        """Persist FSM transitions + progress for this job."""
+        if self.store is None:
+            return
+        store = self.store
+
+        def on_transition(prev: JobState, to: JobState) -> None:
+            store.set_state(job.job_id, to.value, job.fsm.failure_message)
+
+        job.fsm.on_transition = on_transition
 
     async def stop(self) -> None:
         for job in self.jobs.values():
             if job.supervisor:
                 job.supervisor.cancel()
         await self.rpc.stop()
+        if self.store is not None:
+            self.store.close()
 
     # -- job API (what arroyo-api calls via gRPC/DB) ----------------------
 
@@ -145,6 +205,11 @@ class ControllerServer:
                   checkpoint_url or config().checkpoint_url,
                   max(n.parallelism for n in program.nodes()))
         self.jobs[job_id] = job
+        if self.store is not None:
+            self.store.upsert_job(job_id, pickle.dumps(program),
+                                  job.checkpoint_url, n_workers,
+                                  JobState.CREATED.value)
+            self._attach_store(job, n_workers)
         job.supervisor = asyncio.ensure_future(
             self._drive(job, n_workers, restore))
         return job_id
@@ -152,6 +217,8 @@ class ControllerServer:
     async def stop_job(self, job_id: str, checkpoint: bool = True) -> None:
         job = self.jobs[job_id]
         job.stop_requested = True
+        if self.store is not None:
+            self.store.set_stop_requested(job_id)
         if job.fsm.state == JobState.RUNNING:
             if checkpoint:
                 job.fsm.transition(JobState.CHECKPOINT_STOPPING)
@@ -194,6 +261,9 @@ class ControllerServer:
         # checkpoint-stopped above); restore re-shards state by key range
         job.program.update_parallelism(overrides)
         job.n_subtasks = sum(n.parallelism for n in job.program.nodes())
+        if self.store is not None:
+            self.store.set_program(job.job_id, pickle.dumps(job.program),
+                                   n_workers)
         await self._restart_workers(job, n_workers, force_stop=False)
 
     def job_state(self, job_id: str) -> JobState:
@@ -231,6 +301,7 @@ class ControllerServer:
             await self.scheduler.start_workers(
                 job.job_id, self.addr, n_workers,
                 max(1, (job.slots_needed + n_workers - 1) // n_workers))
+            self._persist_workers(job)
             await self._schedule(job, n_workers, restore)
             job.fsm.transition(JobState.RUNNING)
             await self._supervise(job)
@@ -371,6 +442,7 @@ class ControllerServer:
         await self.scheduler.start_workers(
             job.job_id, self.addr, n_workers,
             max(1, (job.slots_needed + n_workers - 1) // n_workers))
+        self._persist_workers(job)
         await self._schedule(job, n_workers, restore=True)
         job.fsm.transition(JobState.RUNNING)
 
@@ -409,6 +481,17 @@ class ControllerServer:
                 job, "StopExecution",
                 {"job_id": job.job_id, "stop_mode": "graceful"},
                 ignore_errors=True)
+
+    def _persist_workers(self, job: Job) -> None:
+        """Record the scheduler's external worker ids so a restarted
+        controller can reap this incarnation's orphans."""
+        if self.store is None:
+            return
+        try:
+            self.store.set_workers(job.job_id,
+                                   self.scheduler.workers_for_job(job.job_id))
+        except NotImplementedError:
+            pass
 
     async def _broadcast_workers(self, job: Job, method: str, payload: Dict,
                                  ignore_errors: bool = False) -> None:
@@ -483,6 +566,9 @@ class ControllerServer:
             }).encode())
         job.last_successful_epoch = tracker.epoch
         del job.trackers[tracker.epoch]
+        if self.store is not None:
+            self.store.set_progress(job.job_id, job.epoch, job.min_epoch,
+                                    job.last_successful_epoch)
         # two-phase commit for sinks with commit behavior
         if tracker.has_committing:
             await self._broadcast_workers(

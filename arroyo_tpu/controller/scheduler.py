@@ -37,6 +37,12 @@ class Scheduler:
     def workers_for_job(self, job_id: str) -> List[str]:
         raise NotImplementedError
 
+    async def reap(self, job_id: str, ext_ids: List[str]) -> None:
+        """Kill workers left over from a PREVIOUS controller incarnation
+        (identified by their persisted external ids).  Default no-op:
+        in-process workers die with the controller, and the k8s/nomad
+        reconcilers re-own replica sets by job label on start_workers."""
+
 
 class InProcessScheduler(Scheduler):
     def __init__(self) -> None:
@@ -102,6 +108,25 @@ class ProcessScheduler(Scheduler):
     def workers_for_job(self, job_id):
         return [f"pid-{p.pid}" for p in self._procs.get(job_id, [])
                 if p.poll() is None]
+
+    async def reap(self, job_id, ext_ids):
+        """SIGKILL orphaned worker pids from a crashed controller — but
+        only when the pid still runs OUR worker entrypoint (pids recycle;
+        killing a stranger would be a disaster)."""
+        import os
+        import signal
+
+        for ext in ext_ids:
+            if not ext.startswith("pid-"):
+                continue
+            try:
+                pid = int(ext.split("-", 1)[1])
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    cmdline = f.read()
+                if b"arroyo_tpu.worker.server" in cmdline:
+                    os.kill(pid, signal.SIGKILL)
+            except (OSError, ValueError):
+                continue  # already gone
 
 
 class KubernetesApiClient:

@@ -1,0 +1,138 @@
+"""Durable controller job state.
+
+The reference persists per-job status in Postgres and, on controller
+boot, resumes every job's state machine from the stored rows
+(arroyo-controller/src/states/mod.rs:577-628).  Here sqlite replaces
+Postgres — the same substitution the API layer makes — and the stored
+program is the cloudpickled logical :class:`Program`, so a restarted
+controller can re-compile, re-schedule, and restore each job from its
+last completed checkpoint without the submitting client.
+
+Also persisted: the scheduler's external worker ids (e.g. ``pid-1234``
+for the process scheduler), so a restarted controller can reap orphaned
+workers from its previous incarnation before starting fresh ones.
+"""
+
+from __future__ import annotations
+
+import sqlite3
+import time
+from dataclasses import dataclass
+from typing import List, Optional
+
+TERMINAL_STATES = ("Stopped", "Finished", "Failed")
+
+
+@dataclass
+class StoredJob:
+    job_id: str
+    program: bytes
+    checkpoint_url: str
+    n_workers: int
+    state: str
+    epoch: int
+    min_epoch: int
+    last_successful_epoch: Optional[int]
+    stop_requested: bool
+
+
+class ControllerStore:
+    def __init__(self, path: str):
+        self.path = path
+        self.db = sqlite3.connect(path)
+        self.db.execute("""
+            CREATE TABLE IF NOT EXISTS jobs (
+                job_id TEXT PRIMARY KEY,
+                program BLOB NOT NULL,
+                checkpoint_url TEXT NOT NULL,
+                n_workers INTEGER NOT NULL,
+                state TEXT NOT NULL,
+                epoch INTEGER NOT NULL DEFAULT 0,
+                min_epoch INTEGER NOT NULL DEFAULT 0,
+                last_successful_epoch INTEGER,
+                stop_requested INTEGER NOT NULL DEFAULT 0,
+                failure TEXT,
+                updated_at REAL NOT NULL
+            )""")
+        self.db.execute("""
+            CREATE TABLE IF NOT EXISTS job_workers (
+                job_id TEXT NOT NULL,
+                ext_id TEXT NOT NULL,
+                PRIMARY KEY (job_id, ext_id)
+            )""")
+        self.db.commit()
+
+    def close(self) -> None:
+        self.db.close()
+
+    # -- job rows ----------------------------------------------------------
+
+    def upsert_job(self, job_id: str, program: bytes, checkpoint_url: str,
+                   n_workers: int, state: str) -> None:
+        self.db.execute(
+            "INSERT INTO jobs (job_id, program, checkpoint_url, n_workers,"
+            " state, updated_at) VALUES (?, ?, ?, ?, ?, ?)"
+            " ON CONFLICT(job_id) DO UPDATE SET program=excluded.program,"
+            " checkpoint_url=excluded.checkpoint_url,"
+            " n_workers=excluded.n_workers, state=excluded.state,"
+            " updated_at=excluded.updated_at",
+            (job_id, program, checkpoint_url, n_workers, state, time.time()))
+        self.db.commit()
+
+    def set_state(self, job_id: str, state: str,
+                  failure: Optional[str] = None) -> None:
+        self.db.execute(
+            "UPDATE jobs SET state=?, failure=?, updated_at=? WHERE "
+            "job_id=?", (state, failure, time.time(), job_id))
+        self.db.commit()
+
+    def set_progress(self, job_id: str, epoch: int, min_epoch: int,
+                     last_successful_epoch: Optional[int]) -> None:
+        self.db.execute(
+            "UPDATE jobs SET epoch=?, min_epoch=?, last_successful_epoch=?,"
+            " updated_at=? WHERE job_id=?",
+            (epoch, min_epoch, last_successful_epoch, time.time(), job_id))
+        self.db.commit()
+
+    def set_program(self, job_id: str, program: bytes,
+                    n_workers: Optional[int] = None) -> None:
+        if n_workers is None:
+            self.db.execute(
+                "UPDATE jobs SET program=?, updated_at=? WHERE job_id=?",
+                (program, time.time(), job_id))
+        else:
+            self.db.execute(
+                "UPDATE jobs SET program=?, n_workers=?, updated_at=? "
+                "WHERE job_id=?",
+                (program, n_workers, time.time(), job_id))
+        self.db.commit()
+
+    def set_stop_requested(self, job_id: str) -> None:
+        self.db.execute(
+            "UPDATE jobs SET stop_requested=1, updated_at=? WHERE job_id=?",
+            (time.time(), job_id))
+        self.db.commit()
+
+    def resumable(self) -> List[StoredJob]:
+        """Jobs a fresh controller must adopt: every non-terminal row."""
+        rows = self.db.execute(
+            "SELECT job_id, program, checkpoint_url, n_workers, state,"
+            " epoch, min_epoch, last_successful_epoch, stop_requested"
+            " FROM jobs WHERE state NOT IN (?, ?, ?)",
+            TERMINAL_STATES).fetchall()
+        return [StoredJob(r[0], r[1], r[2], r[3], r[4], r[5], r[6], r[7],
+                          bool(r[8])) for r in rows]
+
+    # -- scheduler external worker ids ------------------------------------
+
+    def set_workers(self, job_id: str, ext_ids: List[str]) -> None:
+        self.db.execute("DELETE FROM job_workers WHERE job_id=?", (job_id,))
+        self.db.executemany(
+            "INSERT OR IGNORE INTO job_workers (job_id, ext_id) VALUES "
+            "(?, ?)", [(job_id, e) for e in ext_ids])
+        self.db.commit()
+
+    def workers(self, job_id: str) -> List[str]:
+        return [r[0] for r in self.db.execute(
+            "SELECT ext_id FROM job_workers WHERE job_id=?",
+            (job_id,)).fetchall()]

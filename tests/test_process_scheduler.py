@@ -243,3 +243,97 @@ def test_mesh_sharded_state_inside_cluster_worker(tmp_path, monkeypatch):
                     shards_seen.add(int(arr[0]))
     assert 8 in shards_seen, (
         f"no 8-shard mesh checkpoint found (saw {shards_seen})")
+
+
+def test_controller_crash_resumes_job_from_durable_store(tmp_path, monkeypatch):
+    """Durable controller (states/mod.rs:577-628 analog): submit a
+    checkpointing job, CRASH the controller (no graceful stop — workers
+    orphaned), start a fresh controller on the same sqlite store: it must
+    reap the orphans, re-adopt the job, return it to Running, and finish
+    with exactly-once output from the last checkpoint."""
+    import os
+
+    monkeypatch.setenv("HEARTBEAT_INTERVAL_SECS", "0.3")
+    monkeypatch.setenv("HEARTBEAT_TIMEOUT_SECS", "2.0")
+    monkeypatch.setenv("CHECKPOINT_INTERVAL_SECS", "0.5")
+    from arroyo_tpu.config import reset_config
+
+    reset_config()
+    out_path = tmp_path / "out.jsonl"
+    db_path = str(tmp_path / "controller.db")
+    N = 40_000
+
+    def make_prog():
+        return (
+            Stream.source("impulse", {"event_rate": 8000.0,
+                                      "message_count": N,
+                                      "event_time_interval_micros": 1000,
+                                      "batch_size": 256})
+            .watermark(max_lateness_micros=0)
+            .map(lambda c: {"counter": c["counter"],
+                            "bucket": c["counter"] % 5}, name="b")
+            .key_by("bucket")
+            .tumbling_aggregate(
+                500 * 1000, [AggSpec(AggKind.COUNT, None, "cnt")])
+            .sink("single_file", {"path": str(out_path)})
+        )
+
+    async def incarnation_one():
+        sched = ProcessScheduler()
+        ctrl = ControllerServer(sched, db_path=db_path)
+        await ctrl.start()
+        job_id = await ctrl.submit_job(
+            make_prog(), checkpoint_url=f"file://{tmp_path}/ckpt",
+            n_workers=1)
+        await ctrl.wait_for_state(job_id, JobState.RUNNING, timeout=60)
+        for _ in range(600):
+            if (ctrl.jobs[job_id].last_successful_epoch or 0) >= 1:
+                break
+            await asyncio.sleep(0.05)
+        assert (ctrl.jobs[job_id].last_successful_epoch or 0) >= 1
+        orphan_pids = sched.workers_for_job(job_id)
+        assert orphan_pids
+        # CRASH: cancel the supervisor and drop the rpc server without
+        # stopping workers or touching the scheduler
+        ctrl.jobs[job_id].supervisor.cancel()
+        await ctrl.rpc.stop()
+        ctrl.store.close()
+        return job_id, orphan_pids
+
+    async def incarnation_two(job_id, orphan_pids):
+        sched = ProcessScheduler()
+        ctrl = ControllerServer(sched, db_path=db_path)
+        await ctrl.start()  # resumes from the store
+        try:
+            assert job_id in ctrl.jobs, "job not re-adopted from store"
+            state = await ctrl.wait_for_state(
+                job_id, JobState.RUNNING, JobState.FINISHED, timeout=90)
+            assert state in (JobState.RUNNING, JobState.FINISHED)
+            # the first incarnation's workers must be gone (reaped or
+            # self-terminated); pids must not linger running our worker
+            for p in orphan_pids:
+                pid = int(p.split("-", 1)[1])
+                try:
+                    with open(f"/proc/{pid}/cmdline", "rb") as f:
+                        assert b"arroyo_tpu.worker.server" not in f.read()
+                except OSError:
+                    pass  # gone — good
+            state = await ctrl.wait_for_state(job_id, JobState.FINISHED,
+                                              timeout=120)
+            # durable store converged too
+            rows = ctrl.store.resumable()
+            assert all(r.job_id != job_id for r in rows)
+        finally:
+            await sched.stop_workers(job_id)
+            await ctrl.stop()
+        return state
+
+    try:
+        job_id, orphans = asyncio.run(incarnation_one())
+        state = asyncio.run(incarnation_two(job_id, orphans))
+    finally:
+        reset_config()
+    assert state == JobState.FINISHED
+    rows = [json.loads(line) for line in open(out_path)]
+    assert sum(r["cnt"] for r in rows) == N
+    assert len({r["bucket"] for r in rows}) == 5

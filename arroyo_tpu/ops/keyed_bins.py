@@ -128,6 +128,26 @@ def _emit_kernel(kinds: Tuple[str, ...], C: int, B: int, W: int, k: int):
     return run
 
 
+@functools.lru_cache(maxsize=64)
+def _linearize_kernel(kinds: Tuple[str, ...], C: int, B: int, L: int):
+    """Materialize the LINEAR bin span [C, L] from the modular ring —
+    one gather; bins outside the live range read as each channel's
+    aggregation identity.  Feeds the ring-pane emission path."""
+
+    @jax.jit
+    def run(values, counts, ring_idx, ok):
+        outs = []
+        for i, kind in enumerate(kinds):
+            g = values[i][:, ring_idx]  # [C, L]
+            outs.append(jnp.where(ok[None, :], g,
+                                  _init_value(AggKind(kind))))
+        cg = jnp.where(ok[None, :], counts[:, ring_idx], 0)
+        return (jnp.stack(outs) if outs else
+                jnp.zeros((0, C, L), jnp.float64)), cg
+
+    return run
+
+
 @functools.lru_cache(maxsize=256)
 def _evict_kernel(kinds: Tuple[str, ...], C: int, B: int):
     @jax.jit
@@ -498,6 +518,60 @@ class KeyedBinState:
 
     # -- pane emission ------------------------------------------------------
 
+    def _use_ring(self) -> bool:
+        """Select bin-dimension ring-parallel emission (SURVEY §5
+        sequence-parallel discipline) for long windows: the [C, k, W]
+        pane gather materializes W copies of the state, while the ring
+        path does one linear gather plus a cumulative sweep with
+        ``ppermute`` halos — worthwhile once W is large (long window /
+        short slide) and there is a mesh to shard bins over."""
+        import os
+
+        mode = os.environ.get("ARROYO_RING", "auto")
+        if mode == "off":
+            return False
+        if mode == "on":
+            return True
+        w_min = int(os.environ.get("ARROYO_RING_MIN_W", 64))
+        return self.W >= w_min and len(jax.devices()) > 1
+
+    def _ring_shards(self) -> int:
+        nk = 1
+        while nk * 2 <= len(jax.devices()):
+            nk *= 2
+        return nk
+
+    def _emit_ring(self, pane_ends: np.ndarray, k: int):
+        """Pane aggregates for the contiguous ``pane_ends`` range via the
+        bin-sharded ring kernel (parallel/ring_panes.py): linearize the
+        span once, then one trailing-W sweep per channel."""
+        from ..obs.perf import timed_device
+        from ..parallel.ring_panes import _ring_step_2d
+
+        nk = self._ring_shards()
+        a_lo = self.min_bin if self.min_bin is not None else 0
+        a_hi = int(pane_ends[-1])
+        L0 = a_hi - a_lo + 1
+        L = max(-(-L0 // nk) * nk, nk)
+        padl = L - L0
+        abs_bins = np.arange(a_lo - padl, a_hi + 1, dtype=np.int64)
+        ok = (abs_bins >= a_lo) & (abs_bins <= self.max_bin)
+        ring_idx = (abs_bins % self.B).astype(np.int32)
+        lin = _linearize_kernel(self._ch_kinds, self.C, self.B, L)
+        g, cg = timed_device(lin, self.values, self.counts,
+                             jnp.asarray(ring_idx), jnp.asarray(ok))
+        outs = []
+        for i, kind in enumerate(self._ch_kinds):
+            fn, sharding = _ring_step_2d(kind, nk, self.C, L // nk,
+                                         self.W)
+            dev = jax.device_put(g[i], sharding)
+            outs.append(np.asarray(timed_device(fn, dev))[:, -k:])
+        fn, sharding = _ring_step_2d("count", nk, self.C, L // nk, self.W)
+        cdev = jax.device_put(cg.astype(jnp.float64), sharding)
+        cnts = np.asarray(timed_device(fn, cdev))[:, -k:].astype(np.int32)
+        return (np.stack(outs) if outs else
+                np.zeros((0, self.C, k))), cnts
+
     def fire_panes(self, watermark: int, final: bool = False
                    ) -> Optional[Tuple[np.ndarray, Dict[str, np.ndarray],
                                        np.ndarray, np.ndarray]]:
@@ -537,9 +611,14 @@ class KeyedBinState:
 
         from ..obs.perf import timed_device
 
-        kernel = _emit_kernel(self._ch_kinds, self.C, self.B, self.W, kpad)
-        outs, cnts = timed_device(kernel, self.values, self.counts,
-                                  jnp.asarray(ring), jnp.asarray(bin_ok))
+        if self._use_ring():
+            outs, cnts = self._emit_ring(pane_ends, k)
+        else:
+            kernel = _emit_kernel(self._ch_kinds, self.C, self.B, self.W,
+                                  kpad)
+            outs, cnts = timed_device(kernel, self.values, self.counts,
+                                      jnp.asarray(ring),
+                                      jnp.asarray(bin_ok))
         # transfer only the occupied key rows, not all C slots.  2048-row
         # granularity: finer than pow2 buckets (pow2 wastes up to 50% of a
         # remote-tunnel transfer) while bounding the compile-variant count;

@@ -760,14 +760,27 @@ class MeshKeyedBinState:
 def make_bin_state(aggs: Tuple[AggSpec, ...], slide_micros: int,
                    width_micros: int, capacity: int = 0):
     """State factory for BinAggOperator: mesh-sharded when more than one
-    device is available (ARROYO_MESH=auto), single-device otherwise."""
+    device is available (ARROYO_MESH=auto), single-device otherwise.
+
+    Long-window/short-slide shapes (W = width/slide >= ARROYO_RING_MIN_W,
+    e.g. HOP(1s, 300s)) shard the BIN dimension instead of the key
+    dimension: KeyedBinState's ring-pane emission (ops/keyed_bins.py
+    _emit_ring + parallel/ring_panes.py) replaces the [C, k, W] gather
+    that dominates memory at large W — SURVEY §5's sequence-parallel
+    discipline, selected automatically."""
+    import os
+
     import jax
 
     nk = mesh_key_shards()
+    W = width_micros // max(slide_micros, 1)
+    ring_min = int(os.environ.get("ARROYO_RING_MIN_W", 64))
+    ring_shape = (W >= ring_min
+                  and os.environ.get("ARROYO_RING", "auto") != "off")
     # the mesh path ships uint64 key hashes through jit: without x64 JAX
     # would truncate them to uint32 (silently wrong merges/routes), so
     # fall back to the x32-safe single-device kernels
-    if nk > 1 and jax.config.jax_enable_x64:
+    if nk > 1 and jax.config.jax_enable_x64 and not ring_shape:
         return MeshKeyedBinState(aggs, slide_micros, width_micros,
                                  capacity=capacity, n_shards=nk)
     from ..ops.keyed_bins import KeyedBinState

@@ -99,6 +99,82 @@ def _ring_step(kind: str, nk: int, Bl: int, W: int):
     return jax.jit(fn), sharding
 
 
+@functools.lru_cache(maxsize=64)
+def _ring_step_2d(kind: str, nk: int, C: int, Bl: int, W: int):
+    """[C, n_bins] variant of :func:`_ring_step`: every key's bin ring is
+    aggregated at once, bin axis block-sharded, ``ppermute`` halos —
+    the engine's long-window emission kernel (KeyedBinState._emit_ring
+    selects it instead of the [C, k, W] gather when W is large)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .mesh_window import _keys_mesh
+
+    ident = _init_value(AggKind(kind))
+    additive = kind in ("sum", "count")
+    mesh = _keys_mesh(nk)
+    n_rot = max((W - 1 + Bl - 1) // Bl, 0)
+
+    def combine(a, b):
+        return jnp.minimum(a, b) if kind == "min" else jnp.maximum(a, b)
+
+    def sliding(ext):  # [C, L] -> [C, Bl]
+        L = ext.shape[1]
+        if additive:
+            c = jnp.cumsum(ext, axis=1)
+            lo = jnp.arange(Bl) + (L - Bl) - W
+            hi = jnp.arange(Bl) + (L - Bl)
+            head = jnp.where(lo >= 0, c[:, jnp.maximum(lo, 0)], 0.0)
+            return c[:, hi] - head
+        import jax.lax as lax
+
+        Pp = ((L + W - 1) // W) * W
+        x = jnp.concatenate(
+            [jnp.full((ext.shape[0], Pp - L), ident, ext.dtype), ext],
+            axis=1).reshape(ext.shape[0], -1, W)
+        op = lax.cummax if kind == "max" else lax.cummin
+        pre = op(x, axis=2).reshape(ext.shape[0], -1)
+        suf = op(x[:, :, ::-1], axis=2)[:, :, ::-1].reshape(
+            ext.shape[0], -1)
+        j = jnp.arange(Pp - Bl, Pp)
+        return combine(suf[:, j - W + 1], pre[:, j])
+
+    def shard_fn(local):  # [C, Bl] per shard
+        d = jax.lax.axis_index("keys")
+        ext = local
+        block = local
+        for r in range(1, n_rot + 1):
+            block = jax.lax.ppermute(
+                block, "keys", perm=[(i, (i + 1) % nk) for i in range(nk)])
+            valid = d - r >= 0
+            ext = jnp.concatenate(
+                [jnp.where(valid, block, ident), ext], axis=1)
+        return sliding(ext)
+
+    fn = shard_map(shard_fn, mesh=mesh, in_specs=P(None, "keys"),
+                   out_specs=P(None, "keys"))
+    sharding = NamedSharding(mesh, P(None, "keys"))
+    return jax.jit(fn), sharding
+
+
+def ring_pane_aggregate_2d(bins: "np.ndarray", width_bins: int, kind: str,
+                           n_shards: int) -> np.ndarray:
+    """[C, n_bins] batch form of :func:`ring_pane_aggregate`."""
+    import jax
+    import jax.numpy as jnp
+
+    if kind not in ("sum", "count", "min", "max"):
+        raise ValueError(f"ring_pane_aggregate_2d: unsupported {kind!r}")
+    C, n = bins.shape
+    assert n % n_shards == 0
+    fn, sharding = _ring_step_2d(kind, n_shards, C, n // n_shards,
+                                 int(width_bins))
+    dev = jax.device_put(jnp.asarray(bins, jnp.float64), sharding)
+    return np.asarray(jax.device_get(fn(dev)))
+
+
 def ring_pane_aggregate(bins: np.ndarray, width_bins: int, kind: str,
                         n_shards: int) -> np.ndarray:
     """Aggregate of the trailing ``width_bins`` bins ending at every bin

@@ -419,3 +419,77 @@ def test_ring_pane_aggregate_matches_numpy(rng):
         for W in (7, 33, 100):
             got = ring_pane_aggregate(vals, W, kind, shards)
             np.testing.assert_allclose(got, oracle(kind, W))
+
+
+def test_ring_emission_matches_oracle_long_window(rng, monkeypatch):
+    """Long-window (W=100) pane emission through the bin-sharded ring
+    kernels (KeyedBinState._emit_ring) matches the pane oracle across
+    batched updates, interleaved fires, and eviction."""
+    from arroyo_tpu.ops.keyed_bins import KeyedBinState
+
+    monkeypatch.setenv("ARROYO_RING", "on")
+    n = 2000
+    ts = np.sort(rng.integers(0, 400 * SEC, n)).astype(np.int64)
+    keys = rng.integers(0, 15, n).astype(np.int64)
+    vals = rng.integers(-50, 100, n).astype(np.int64)
+    kh = hash_columns([keys])
+    st = KeyedBinState(AGGS, SEC, 100 * SEC, capacity=64)
+    assert st._use_ring()
+    got = drive(st, kh, ts, vals, batches=5)
+    exp = oracle_windows(ts, kh, vals, 100 * SEC, SEC)
+    assert got == exp
+
+
+def test_make_bin_state_selects_ring_shape_for_long_windows(monkeypatch):
+    """HOP(1s, 300s)-style shapes route to the ring-capable state even
+    when a key mesh is available (bin-dim beats key-dim sharding there)."""
+    import jax
+
+    from arroyo_tpu.ops.keyed_bins import KeyedBinState
+
+    monkeypatch.setenv("ARROYO_MESH", "auto")
+    st = make_bin_state(AGGS, SEC, 300 * SEC)
+    assert isinstance(st, KeyedBinState)
+    if len(jax.devices()) > 1:
+        assert st._use_ring()
+    # short windows on a mesh still take the key-sharded state
+    st2 = make_bin_state(AGGS, SEC, 2 * SEC)
+    if len(jax.devices()) > 1 and jax.config.jax_enable_x64:
+        assert isinstance(st2, MeshKeyedBinState)
+
+
+def test_sql_hop_long_window_through_ring(rng, monkeypatch):
+    """A HOP(1s, 300s) query runs end-to-end through the SQL engine with
+    ring-pane emission, with per-(key, window) oracle parity — the
+    SQL-reachable proof the ring path is engine-wired, not a demo."""
+    import collections
+
+    from arroyo_tpu import Batch
+    from arroyo_tpu.connectors.memory import clear_sink, sink_output
+    from arroyo_tpu.engine.engine import LocalRunner
+    from arroyo_tpu.sql import SchemaProvider, plan_sql
+
+    monkeypatch.setenv("ARROYO_RING", "on")
+    n = 400
+    ts = np.sort(rng.integers(0, 600 * SEC, n)).astype(np.int64)
+    keys = rng.integers(0, 5, n).astype(np.int64)
+    p = SchemaProvider()
+    p.add_memory_table("events", {"k": "i"}, [Batch(ts, {"k": keys})])
+    clear_sink("results")
+    LocalRunner(plan_sql(
+        "CREATE TABLE out WITH (connector='memory', name='results');"
+        "INSERT INTO out SELECT k, HOP(INTERVAL '1' SECOND, INTERVAL"
+        " '300' SECOND) as window, count(*) as num "
+        "FROM events GROUP BY 1, 2", p)).run()
+    out = Batch.concat(sink_output("results"))
+    exp = collections.Counter()
+    for t, kk in zip(ts.tolist(), keys.tolist()):
+        e = (t // SEC + 1) * SEC
+        for w in range(300):
+            exp[(kk, e + w * SEC)] += 1
+    got = {}
+    for j in range(len(out)):
+        key = (int(out.columns["k"][j]), int(out.columns["window_end"][j]))
+        assert key not in got, f"pane emitted twice: {key}"
+        got[key] = int(out.columns["num"][j])
+    assert got == dict(exp)

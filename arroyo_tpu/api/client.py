@@ -1,0 +1,154 @@
+"""Runtime-generated API client + structural OpenAPI validation.
+
+The reference generates a Rust client from its utoipa spec and drives
+black-box integration through it (integ/src/main.rs:25-120).  Here the
+client is generated AT RUNTIME from ``/api/v1/openapi.json``: a method
+exists only because the live spec declares the operation, so a drifting
+spec breaks the black-box tests — which is the point of testing through
+a generated client rather than hand-written URLs.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+_METHODS = ("get", "put", "post", "delete", "options", "head", "patch",
+            "trace")
+
+
+def validate_spec(spec: Dict[str, Any]) -> List[str]:
+    """Structural OpenAPI 3.0 validation (the environment ships no
+    openapi-spec-validator; these are the document requirements the
+    generated client depends on).  Returns a list of problems — empty
+    means valid."""
+    problems: List[str] = []
+
+    def p(msg: str) -> None:
+        problems.append(msg)
+
+    if not re.match(r"^3\.\d+\.\d+$", str(spec.get("openapi", ""))):
+        p(f"openapi version {spec.get('openapi')!r} is not a 3.x.y semver")
+    info = spec.get("info")
+    if not isinstance(info, dict):
+        p("missing info object")
+    else:
+        for k in ("title", "version"):
+            if not info.get(k):
+                p(f"info.{k} missing")
+    paths = spec.get("paths")
+    if not isinstance(paths, dict) or not paths:
+        p("paths missing or empty")
+        return problems
+    seen_ops: Dict[str, str] = {}
+    for path, entry in paths.items():
+        if not path.startswith("/"):
+            p(f"path {path!r} must start with '/'")
+        if not isinstance(entry, dict):
+            p(f"path {path!r} entry is not an object")
+            continue
+        tmpl_params = set(re.findall(r"\{(\w+)\}", path))
+        for method, op in entry.items():
+            if method not in _METHODS:
+                p(f"{path}: unknown method {method!r}")
+                continue
+            if not isinstance(op, dict):
+                p(f"{method.upper()} {path}: operation is not an object")
+                continue
+            op_id = op.get("operationId")
+            if not op_id:
+                p(f"{method.upper()} {path}: missing operationId")
+            elif op_id in seen_ops:
+                p(f"operationId {op_id!r} duplicated "
+                  f"({seen_ops[op_id]} and {method.upper()} {path})")
+            else:
+                seen_ops[op_id] = f"{method.upper()} {path}"
+            if not op.get("responses"):
+                p(f"{method.upper()} {path}: missing responses")
+            declared = set()
+            for param in op.get("parameters", []):
+                name = param.get("name")
+                if param.get("in") == "path":
+                    declared.add(name)
+                    if not param.get("required"):
+                        p(f"{method.upper()} {path}: path param "
+                          f"{name!r} must be required")
+                    if name not in tmpl_params:
+                        p(f"{method.upper()} {path}: path param "
+                          f"{name!r} not in the template")
+            missing = tmpl_params - declared
+            if missing:
+                p(f"{method.upper()} {path}: template params "
+                  f"{sorted(missing)} undeclared")
+    return problems
+
+
+class ApiError(RuntimeError):
+    def __init__(self, status: int, body: str):
+        super().__init__(f"HTTP {status}: {body[:300]}")
+        self.status = status
+        self.body = body
+
+
+class GeneratedClient:
+    """Black-box client whose methods are the spec's operationIds.
+
+    ``await client.create_pipeline(body={...})``,
+    ``await client.get_pipeline(id="pl_x")``,
+    ``await client.job_checkpoints(pid="pl_x", jid="job_y")`` — path
+    params by keyword, JSON body via ``body=``, query via ``params=``.
+    """
+
+    def __init__(self, base_url: str, spec: Dict[str, Any], http) -> None:
+        self.base_url = base_url.rstrip("/")
+        self.spec = spec
+        self._http = http  # httpx.AsyncClient
+        self.operations: Dict[str, Dict[str, str]] = {}
+        for path, entry in spec["paths"].items():
+            for method, op in entry.items():
+                if method not in _METHODS or not isinstance(op, dict):
+                    continue
+                op_id = op.get("operationId")
+                if op_id:
+                    self.operations[op_id] = {"method": method,
+                                              "path": path}
+
+    def __getattr__(self, op_id: str):
+        ops = self.__dict__.get("operations") or {}
+        if op_id not in ops:
+            raise AttributeError(
+                f"operation {op_id!r} is not in the spec "
+                f"(has: {sorted(ops)[:8]}...)")
+        meta = ops[op_id]
+
+        async def call(body: Optional[Any] = None,
+                       params: Optional[Dict[str, Any]] = None,
+                       **path_params: Any):
+            path = meta["path"]
+            for k, v in path_params.items():
+                if "{%s}" % k not in path:
+                    raise TypeError(f"{op_id}: unknown path param {k!r}")
+                path = path.replace("{%s}" % k, str(v))
+            left = re.findall(r"\{(\w+)\}", path)
+            if left:
+                raise TypeError(f"{op_id}: missing path params {left}")
+            r = await self._http.request(
+                meta["method"].upper(), self.base_url + path,
+                json=body, params=params)
+            if r.status_code >= 400:
+                raise ApiError(r.status_code, r.text)
+            ctype = r.headers.get("content-type", "")
+            return r.json() if "json" in ctype else r.text
+
+        call.__name__ = op_id
+        return call
+
+
+async def generate_client(base_url: str, http) -> GeneratedClient:
+    """Fetch the live spec, validate it, and build the client."""
+    r = await http.get(base_url.rstrip("/") + "/api/v1/openapi.json")
+    spec = r.json()
+    problems = validate_spec(spec)
+    if problems:
+        raise ValueError("invalid OpenAPI spec: " + "; ".join(problems))
+    return GeneratedClient(base_url, spec, http)

@@ -119,6 +119,7 @@ FROM nexmark WHERE bid is not null GROUP BY 1, 2</textarea>
   </section>
   <section style="grid-column: 1 / 3">
     <h2>Job detail <span id="jobinfo" style="color:var(--dim)"></span></h2>
+    <div id="jobdag"></div>
     <div id="charts">select a job's "watch" for live operator rates…</div>
     <div style="display:grid;grid-template-columns:1fr 1fr;gap:12px;
                 margin-top:10px">
@@ -188,7 +189,12 @@ function layoutDag(g) {
   return {depth, order, offset};
 }
 
-function renderDag(g) {
+const opKey = (id) => String(id).replace(/\\W/g, '_');
+
+function renderDag(g, overlay) {
+  // overlay=true adds per-node live slots (rate text, backpressure bar,
+  // history sparkline) that pollJob refreshes in place — the reference
+  // console's pipeline-details DAG with live metric badges
   const {depth, order, offset} = layoutDag(g);
   const W = 210, H = 54, GX = 60, GY = 16;
   const pos = {};
@@ -216,13 +222,44 @@ function renderDag(g) {
   }
   for (const n of g.nodes) {
     const p = pos[n.operator_id];
+    const k = opKey(n.operator_id);
     out += `<g transform="translate(${p.x},${p.y})">
       <rect class="nodebox" width="${W}" height="${H}" rx="6"/>
       <text x="10" y="21">${esc(n.operator_id).slice(0, 28)}</text>
       <text x="10" y="40" fill="#7a8794">${esc(n.description)
-        .slice(0, 26)} ×${n.parallelism}</text></g>`;
+        .slice(0, 26)} ×${n.parallelism}</text>`;
+    if (overlay) out += `
+      <text id="ov_rate_${k}" x="${W - 8}" y="16" text-anchor="end"
+        fill="#4aa3ff"></text>
+      <polyline id="ov_sp_${k}" points="" fill="none" stroke="#4aa3ff"
+        stroke-width="1" opacity="0.7"/>
+      <rect x="0" y="${H - 4}" width="${W}" height="4" rx="2"
+        fill="#1a222c"/>
+      <rect id="ov_bp_${k}" x="0" y="${H - 4}" width="0" height="4"
+        rx="2" fill="#2e7d32"/>`;
+    out += `</g>`;
   }
   return out + '</svg>';
+}
+
+function updateDagOverlay(rows) {
+  const W = 210, H = 54;
+  for (const r_ of rows) {
+    const k = opKey(r_.op);
+    const rateEl = $('ov_rate_' + k);
+    if (!rateEl) continue;
+    rateEl.textContent = fmtRate(r_.rate);
+    const bp = $('ov_bp_' + k);
+    bp.setAttribute('width', (r_.bp * W).toFixed(0));
+    bp.setAttribute('fill', r_.bp > 0.7 ? '#c62828'
+                           : r_.bp > 0.3 ? '#f9a825' : '#2e7d32');
+    const rates = r_.rates.slice(-40);
+    const max = Math.max(1, ...rates);
+    const pts = rates.map((v, i) =>
+      `${10 + i * ((W - 70) / Math.max(rates.length - 1, 1))},` +
+      `${(H - 10) - (v / max) * 18}`).join(' ');
+    $('ov_sp_' + k).setAttribute('points', pts);
+  }
 }
 
 async function validateSql() {
@@ -335,6 +372,7 @@ async function pollJob() {
     bar.style.width = (r_.bp * 100).toFixed(0) + '%';
     bar.className = r_.bp > 0.7 ? 'hot' : '';
   });
+  updateDagOverlay(rows);
 
   const ck = await fetch(
     `/v1/pipelines/${pid}/jobs/${jid}/checkpoints`);
@@ -382,6 +420,10 @@ function watch(pid, jid) {
   history = {};
   $('jobinfo').textContent = `(${jid})`;
   $('charts').dataset.built = '';
+  $('jobdag').innerHTML = '';
+  fetch('/v1/pipelines/' + pid).then(r => r.json()).then(p => {
+    if (p.graph) $('jobdag').innerHTML = renderDag(p.graph, true);
+  }).catch(() => {});
   seedHistory(pid, jid).then(pollJob);
 }
 

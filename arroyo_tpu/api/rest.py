@@ -41,7 +41,8 @@ CREATE TABLE IF NOT EXISTS pipelines (
     query TEXT NOT NULL,
     parallelism INTEGER NOT NULL DEFAULT 1,
     created_at REAL NOT NULL,
-    stopped INTEGER NOT NULL DEFAULT 0
+    stopped INTEGER NOT NULL DEFAULT 0,
+    graph TEXT
 );
 CREATE TABLE IF NOT EXISTS jobs (
     id TEXT PRIMARY KEY,
@@ -91,6 +92,10 @@ class ApiServer:
         self.db = sqlite3.connect(db_path)
         self.db.row_factory = sqlite3.Row
         self.db.executescript(_SCHEMA)
+        try:  # pre-existing dbs from before the stored-DAG column
+            self.db.execute("ALTER TABLE pipelines ADD COLUMN graph TEXT")
+        except sqlite3.OperationalError:
+            pass
         self.router = Router()
         self._register_routes()
         self.http = HttpServer(self.router)
@@ -270,18 +275,20 @@ class ApiServer:
             pipeline_id = f"pl_{uuid.uuid4().hex[:12]}"
             job_id = f"job_{uuid.uuid4().hex[:8]}"
             now = time.time()
+            graph = _graph_json(prog)
             with self.db:
                 self.db.execute(
                     "INSERT INTO pipelines (id, name, query, parallelism, "
-                    "created_at) VALUES (?,?,?,?,?)",
-                    (pipeline_id, name, query, parallelism, now))
+                    "created_at, graph) VALUES (?,?,?,?,?,?)",
+                    (pipeline_id, name, query, parallelism, now,
+                     json.dumps(graph)))
                 self.db.execute(
                     "INSERT INTO jobs (id, pipeline_id, created_at) "
                     "VALUES (?,?,?)", (job_id, pipeline_id, now))
             await self.controller.submit_job(prog, job_id=job_id)
             return {"id": pipeline_id, "name": name,
                     "jobs": [{"id": job_id}],
-                    "graph": _graph_json(prog)}
+                    "graph": graph}
 
         @r.get("/v1/pipelines")
         async def list_pipelines(req: Request):
@@ -291,7 +298,16 @@ class ApiServer:
 
         @r.get("/v1/pipelines/{id}")
         async def get_pipeline(req: Request):
-            return self._pipeline_json(self._pipeline_row(req.params["id"]))
+            row = self._pipeline_row(req.params["id"])
+            out = self._pipeline_json(row)
+            # detail view carries the stored DAG (console overlay); the
+            # list view stays lean
+            try:
+                out["graph"] = (json.loads(row["graph"])
+                                if row["graph"] else None)
+            except (KeyError, IndexError):
+                out["graph"] = None
+            return out
 
         @r.patch("/v1/pipelines/{id}")
         async def patch_pipeline(req: Request):

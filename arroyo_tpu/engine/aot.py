@@ -86,6 +86,7 @@ def enable_persistent_cache(cache_dir: Optional[str] = None,
          or os.environ.get("ARROYO_COMPILE_CACHE"))
     if d is None:
         import hashlib
+        import json
         import platform
 
         try:  # CPU model distinguishes generations; platform alone doesn't
@@ -99,14 +100,42 @@ def enable_persistent_cache(cache_dir: Optional[str] = None,
                                   "flags")))[:2048]
         except OSError:
             model = ""
-        key = hashlib.md5(
-            (platform.machine() + model).encode()).hexdigest()[:8]
+        # full environment signature: virtualized hosts report identical
+        # generic model strings across different VMs, and XLA:CPU AOT
+        # blobs embed target OPTIONS beyond CPU features (observed: a
+        # shared /tmp carried +prefer-no-scatter blobs from a previous
+        # round's machine into one whose host lacks them — XLA warns of
+        # possible SIGILL).  cpu_count, XLA_FLAGS, jax version, and the
+        # tunnel-plugin presence all change the blob contract.
+        signature = json.dumps({
+            "machine": platform.machine(), "model": model,
+            "cpus": os.cpu_count(),
+            "xla_flags": os.environ.get("XLA_FLAGS", ""),
+            "jax": jax.__version__,
+            "tunnel": bool(os.environ.get("PALLAS_AXON_POOL_IPS")),
+        }, sort_keys=True)
+        key = hashlib.md5(signature.encode()).hexdigest()[:10]
         d = f"/tmp/arroyo_jax_cache_{key}"
         if suffix:
-            # XLA:CPU AOT blobs also embed target OPTIONS (e.g.
-            # prefer-no-gather under a TPU-tunnel session) — segregate by
-            # resolved backend so flag contexts never share blobs
+            # segregate by resolved backend so flag contexts never share
             d += f"_{suffix}"
+        # marker-file check: if the dir exists but was written under a
+        # DIFFERENT signature (hash collision, format change), refuse to
+        # reuse it rather than risk loading foreign AOT blobs
+        try:
+            os.makedirs(d, exist_ok=True)
+            marker = os.path.join(d, "ENV_SIGNATURE.json")
+            if os.path.exists(marker):
+                with open(marker) as f:
+                    if f.read() != signature:
+                        import shutil
+
+                        shutil.rmtree(d, ignore_errors=True)
+                        os.makedirs(d, exist_ok=True)
+            with open(marker, "w") as f:
+                f.write(signature)
+        except OSError:
+            pass
     try:
         jax.config.update("jax_compilation_cache_dir", d)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)

@@ -103,6 +103,33 @@ CASES = [
     ids=[f"s{c[0]}-{c[1]}" for c in CASES])
 def test_fuzz_window_aggregates(seed, mode, width_s, slide_s, gap_s, n,
                                 keys, span_s, null_frac):
+    _run_window_fuzz(seed, mode, width_s, slide_s, gap_s, n, keys,
+                     span_s, null_frac)
+
+
+RING_CASES = [
+    # (seed, width_s, slide_s, n, keys, span_s, null_frac) — W >= 64 so
+    # fire_panes takes the bin-sharded ring emission on the 8-dev mesh
+    (41, 100, 1, 4000, 9, 220, 0.2),
+    (42, 300, 1, 2500, 5, 650, 0.0),
+    (43, 128, 2, 3000, 20, 500, 0.4),
+]
+
+
+@pytest.mark.parametrize(
+    "seed,width_s,slide_s,n,keys,span_s,null_frac", RING_CASES,
+    ids=[f"s{c[0]}-W{c[1] // c[2]}" for c in RING_CASES])
+def test_fuzz_long_window_ring_path(seed, width_s, slide_s, n, keys,
+                                    span_s, null_frac, monkeypatch):
+    """Same differential window fuzz, forced through the ring-pane
+    emission (long-window bin-sharding, ops/keyed_bins._emit_ring)."""
+    monkeypatch.setenv("ARROYO_RING", "on")
+    _run_window_fuzz(seed, "hop", width_s, slide_s, None, n, keys,
+                     span_s, null_frac)
+
+
+def _run_window_fuzz(seed, mode, width_s, slide_s, gap_s, n,
+                     keys, span_s, null_frac):
     rng = np.random.default_rng(seed)
     ts, k, v = _make_table(rng, n, keys, span_s, null_frac)
     where_min = float(rng.integers(-500, 0))
@@ -155,6 +182,54 @@ def test_fuzz_window_aggregates(seed, mode, width_s, slide_s, gap_s, n,
             else:
                 assert have == pytest.approx(want, rel=1e-9, abs=1e-9), (
                     seed, key, col, have, want)
+
+
+@pytest.mark.parametrize("seed", [51, 52, 53, 54])
+def test_fuzz_group_by_window_consolidation(seed):
+    """Random GROUP BY-window re-aggregations (q5 MaxBids shape) at
+    random parallelism and batch splits: exactly ONE final row per
+    window, values matching the oracle — the watermark-consolidation
+    invariant under every interleaving."""
+    import collections
+
+    from arroyo_tpu.sql.planner import Planner
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1500, 6000))
+    width_s = int(rng.integers(1, 4))
+    nkeys = int(rng.integers(3, 25))
+    par = int(rng.integers(1, 4))
+    agg = rng.choice(["max", "min", "sum"])
+    nbatch = int(rng.integers(1, 7))
+    ts = np.sort(rng.integers(0, 8 * SEC, n)).astype(np.int64)
+    k = rng.integers(0, nkeys, n).astype(np.int64)
+    bounds = np.linspace(0, n, nbatch + 1).astype(int)
+    provider = SchemaProvider()
+    provider.add_memory_table("events", {"k": "i"}, [
+        Batch(ts[a:b], {"k": k[a:b]})
+        for a, b in zip(bounds[:-1], bounds[1:]) if b > a])
+    clear_sink("results")
+    prog = Planner(provider).plan(f"""
+        SELECT {agg}(num) AS m, window FROM (
+          SELECT count(*) AS num,
+                 TUMBLE(INTERVAL '{width_s}' SECOND) AS window
+          FROM events GROUP BY k, 2
+        ) GROUP BY 2
+    """, query_parallelism=par)
+    LocalRunner(prog).run()
+    out = Batch.concat(sink_output("results"))
+    per_w = collections.Counter(int(w) for w in out.columns["window_end"])
+    assert all(v == 1 for v in per_w.values()), (seed, per_w)
+    want = collections.defaultdict(collections.Counter)
+    for t, kk in zip(ts.tolist(), k.tolist()):
+        wend = (t // (width_s * SEC) + 1) * width_s * SEC
+        want[wend][kk] += 1
+    assert set(per_w) == set(want), seed
+    fn = {"max": max, "min": min, "sum": sum}[agg]
+    got = {int(w): int(m) for w, m in zip(out.columns["window_end"],
+                                          out.columns["m"])}
+    for wend, cnt in want.items():
+        assert got[wend] == fn(cnt.values()), (seed, agg, wend)
 
 
 @pytest.mark.parametrize("device_join", ["off", "on"])

@@ -88,6 +88,7 @@ FROM nexmark WHERE bid is not null GROUP BY 1, 2</textarea>
     <div>
       <button onclick="validateSql()">Validate</button>
       <button onclick="createPipeline()">Create &amp; run</button>
+      <button onclick="previewPipeline()">Preview</button>
       <span id="planmsg" class="err"></span>
     </div>
     <div id="dag"></div>
@@ -280,6 +281,21 @@ async function createPipeline() {
   const j = await r.json();
   if (!r.ok) $('planmsg').textContent = j.error;
   refresh();
+}
+
+async function previewPipeline() {
+  // bounded run: parallelism 1, sinks swapped to the preview sink, and
+  // the output pane auto-tails the stream (reference preview mode)
+  $('planmsg').textContent = '';
+  const r = await fetch('/v1/pipelines', {method:'POST',
+    headers:{'content-type':'application/json'},
+    body: JSON.stringify({name: ($('plname').value || 'preview') +
+      '-preview', query: $('sql').value, preview: true})});
+  const j = await r.json();
+  if (!r.ok) { $('planmsg').textContent = j.error; return; }
+  refresh();
+  watch(j.id, j.jobs[0].id);
+  tail(j.id, j.jobs[0].id);
 }
 
 // ---- pipelines table ------------------------------------------------------

@@ -270,8 +270,24 @@ class ApiServer:
             name, query = body.get("name"), body.get("query")
             if not name or not query:
                 raise HttpError(400, "missing 'name' or 'query'")
-            parallelism = int(body.get("parallelism", 1))
+            preview = bool(body.get("preview"))
+            parallelism = 1 if preview else int(body.get("parallelism", 1))
             prog = self._plan(query, parallelism)
+            if preview:
+                # the reference's preview mode (pipelines.rs:191-198):
+                # parallelism 1, every connector sink swapped for the
+                # web/preview sink so results stream to the console's
+                # output pane, and the job auto-stops after a TTL
+                from ..graph.logical import ConnectorOpSpec, OpKind
+
+                for node in prog.nodes():
+                    op = node.operator
+                    if (op.kind == OpKind.CONNECTOR_SINK
+                            and op.spec.connector != "preview"):
+                        op.spec = ConnectorOpSpec(
+                            "preview",
+                            {"controller_addr": self.controller.addr},
+                            "preview sink")
             pipeline_id = f"pl_{uuid.uuid4().hex[:12]}"
             job_id = f"job_{uuid.uuid4().hex[:8]}"
             now = time.time()
@@ -286,7 +302,24 @@ class ApiServer:
                     "INSERT INTO jobs (id, pipeline_id, created_at) "
                     "VALUES (?,?,?)", (job_id, pipeline_id, now))
             await self.controller.submit_job(prog, job_id=job_id)
-            return {"id": pipeline_id, "name": name,
+            if preview:
+                ttl = float(body.get("ttl_secs", 60))
+
+                async def reap_preview():
+                    await asyncio.sleep(ttl)
+                    from ..controller.state_machine import JobState
+
+                    job = self.controller.jobs.get(job_id)
+                    if job is not None and not job.fsm.state.terminal:
+                        try:
+                            await self.controller.stop_job(
+                                job_id, checkpoint=False)
+                        except Exception:
+                            logger.warning("preview reap of %s failed",
+                                           job_id, exc_info=True)
+
+                asyncio.ensure_future(reap_preview())
+            return {"id": pipeline_id, "name": name, "preview": preview,
                     "jobs": [{"id": job_id}],
                     "graph": graph}
 

@@ -488,3 +488,50 @@ def test_pipeline_detail_carries_graph_for_console_overlay(api_env):
                 assert needle in html, needle
 
     _run(loop, scenario())
+
+
+def test_preview_pipeline_streams_output_and_reaps(api_env):
+    """preview: true (reference pipelines.rs:191-198) — connector sinks
+    swap to the preview sink, parallelism forces 1, output streams via
+    the SSE endpoint, and the job auto-stops after ttl_secs."""
+    loop, ctrl, base = api_env
+
+    q = """
+    CREATE TABLE f WITH (connector = 'single_file',
+      path = '/tmp/should_not_be_written.jsonl', type = 'sink');
+    CREATE TABLE impulse WITH (connector = 'impulse',
+      event_rate = '500', message_count = '3000', batch_size = '64');
+    INSERT INTO f SELECT counter FROM impulse
+    """
+
+    async def scenario():
+        async with httpx.AsyncClient(base_url=base, timeout=30) as c:
+            r = await c.post("/v1/pipelines", json={
+                "name": "pv", "query": q, "preview": True,
+                "parallelism": 4, "ttl_secs": 20})
+            assert r.status_code == 200, r.text
+            pl = r.json()
+            assert pl["preview"] is True
+            g = pl["graph"]
+            sinks = [n for n in g["nodes"] if "sink" in n["operator_id"]]
+            assert sinks and all(n["parallelism"] == 1
+                                 for n in g["nodes"])
+            jid = pl["jobs"][0]["id"]
+            # output reaches the SSE tail (preview sink -> controller);
+            # the 6s paced run leaves plenty of stream to observe
+            rows = []
+            async with c.stream(
+                    "GET",
+                    f"/v1/pipelines/{pl['id']}/jobs/{jid}/output") as s:
+                async for line in s.aiter_lines():
+                    if line.startswith("data: "):
+                        ev = json.loads(line[6:])
+                        rows.extend(ev.get("rows") or [])
+                        if ev.get("done") or len(rows) >= 300:
+                            break
+            assert len(rows) >= 300
+            assert {r_["counter"] for r_ in rows} <= set(range(3000))
+    _run(loop, scenario())
+    import os
+    assert not os.path.exists("/tmp/should_not_be_written.jsonl"), \
+        "preview must not write to the real connector sink"

@@ -107,6 +107,31 @@ def test_fuzz_window_aggregates(seed, mode, width_s, slide_s, gap_s, n,
                      span_s, null_frac)
 
 
+PARALLEL_CASES = [
+    # (seed, mode, width_s, slide_s, gap_s, n, keys, span_s, null_frac,
+    #  n_batches, parallelism) — shuffle fan-out + multi-subtask panes
+    (61, "tumble", 2, 2, None, 5000, 30, 9, 0.2, 5, 2),
+    (62, "hop", 3, 1, None, 4000, 12, 8, 0.0, 4, 3),
+    (63, "session", None, None, 1, 2500, 10, 25, 0.15, 6, 2),
+    (64, "hop", 2, 1, None, 3000, 40, 7, 0.5, 3, 2),
+]
+
+
+@pytest.mark.parametrize(
+    "seed,mode,width_s,slide_s,gap_s,n,keys,span_s,null_frac,nb,par",
+    PARALLEL_CASES, ids=[f"s{c[0]}-{c[1]}-p{c[10]}"
+                         for c in PARALLEL_CASES])
+def test_fuzz_window_aggregates_parallel(seed, mode, width_s, slide_s,
+                                         gap_s, n, keys, span_s,
+                                         null_frac, nb, par):
+    """The same differential window fuzz through SHUFFLED multi-subtask
+    plans: batches split across arrivals, query_parallelism > 1 — the
+    fan-in watermark and per-subtask pane paths must still match the
+    single-threaded oracle exactly."""
+    _run_window_fuzz(seed, mode, width_s, slide_s, gap_s, n, keys,
+                     span_s, null_frac, n_batches=nb, parallelism=par)
+
+
 RING_CASES = [
     # (seed, width_s, slide_s, n, keys, span_s, null_frac) — W >= 64 so
     # fire_panes takes the bin-sharded ring emission on the 8-dev mesh
@@ -129,14 +154,19 @@ def test_fuzz_long_window_ring_path(seed, width_s, slide_s, n, keys,
 
 
 def _run_window_fuzz(seed, mode, width_s, slide_s, gap_s, n,
-                     keys, span_s, null_frac):
+                     keys, span_s, null_frac, n_batches=1,
+                     parallelism=1):
+    from arroyo_tpu.sql.planner import Planner
+
     rng = np.random.default_rng(seed)
     ts, k, v = _make_table(rng, n, keys, span_s, null_frac)
     where_min = float(rng.integers(-500, 0))
 
+    bounds = np.linspace(0, n, n_batches + 1).astype(int)
     p = SchemaProvider()
-    p.add_memory_table("t", {"k": "i", "v": "f"},
-                       [Batch(ts, {"k": k, "v": v})])
+    p.add_memory_table("t", {"k": "i", "v": "f"}, [
+        Batch(ts[a:b], {"k": k[a:b], "v": v[a:b]})
+        for a, b in zip(bounds[:-1], bounds[1:]) if b > a])
     if mode == "tumble":
         win = f"TUMBLE(INTERVAL '{width_s}' SECOND)"
     elif mode == "hop":
@@ -152,7 +182,8 @@ def _run_window_fuzz(seed, mode, width_s, slide_s, gap_s, n,
     GROUP BY 1, 2
     """
     clear_sink("results")
-    LocalRunner(plan_sql(sql, p)).run()
+    LocalRunner(Planner(p).plan(
+        sql, query_parallelism=parallelism)).run()
     outs = sink_output("results")
     out = Batch.concat(outs) if outs else None
 

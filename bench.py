@@ -743,6 +743,27 @@ def run_kernel_microbench() -> dict:
     out["join_step_ms"] = round(dt * 1e3, 3)
     out["join_rows_per_sec"] = round((nl + nr) / dt, 1)
 
+    # ring-pane emission kernel (long-window bin-sharded sweep): on a
+    # single chip the mesh degenerates to 1 shard but the kernel (cumsum
+    # sweep + halo plumbing) is the one the engine runs at W>=64
+    try:
+        from arroyo_tpu.parallel.ring_panes import _ring_step_2d
+
+        Cr, Lr, Wr = 1024, 512, 300
+        rfn, rsharding = _ring_step_2d("sum", 1, Cr, Lr, Wr)
+        rbins = jax.device_put(
+            jnp.asarray(rng.standard_normal((Cr, Lr)), jnp.float64),
+            rsharding)
+
+        def rstep():
+            jax.block_until_ready(rfn(rbins))
+
+        dt = timeit(rstep, warmup=3, iters=20)
+        out["ring_step_ms"] = round(dt * 1e3, 3)
+        out["ring_key_bins_per_sec"] = round(Cr * Lr / dt, 1)
+    except Exception as e:
+        out["ring_error"] = f"{type(e).__name__}: {e}"[:300]
+
     # pallas path: the engine's fused custom-kernel state update
     # (pallas_kernels.update_bin_state — x32 scatter + f64 apply)
     try:

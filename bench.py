@@ -540,7 +540,9 @@ def run_config5() -> dict:
     # inside the timed run (same discipline as run_query).  The warmup
     # topic holds fewer events than max_messages, so the warm run drains
     # it and exits via the idle-spin bound — a bounded one-time cost.
-    prog = plan_sql(sql, p)
+    # The single-partition topic caps SOURCE parallelism at 1; the keyed
+    # session/aggregate stages still fan out.
+    prog = plan_sql(sql, p, parallelism=bench_parallelism())
 
     def timed_run():
         clear_sink("results")

@@ -535,3 +535,28 @@ def test_preview_pipeline_streams_output_and_reaps(api_env):
     import os
     assert not os.path.exists("/tmp/should_not_be_written.jsonl"), \
         "preview must not write to the real connector sink"
+
+
+def test_preview_ttl_reaps_job(api_env):
+    """A preview pipeline left running auto-stops after ttl_secs."""
+    loop, ctrl, base = api_env
+
+    q = """
+    CREATE TABLE impulse WITH (connector = 'impulse',
+      event_rate = '50', message_count = '10000000', batch_size = '32');
+    SELECT counter FROM impulse
+    """
+
+    async def scenario():
+        from arroyo_tpu.controller.state_machine import JobState
+
+        async with httpx.AsyncClient(base_url=base, timeout=30) as c:
+            r = await c.post("/v1/pipelines", json={
+                "name": "reap", "query": q, "preview": True,
+                "ttl_secs": 2})
+            jid = r.json()["jobs"][0]["id"]
+            state = await ctrl.wait_for_state(
+                jid, JobState.STOPPED, JobState.FINISHED, timeout=45)
+            assert state in (JobState.STOPPED, JobState.FINISHED), state
+
+    _run(loop, scenario())

@@ -1,0 +1,79 @@
+"""``python -m arroyo_tpu`` — single entry point for every role,
+mirroring the reference's one-binary UX (docker/entrypoint role
+selector):
+
+  python -m arroyo_tpu run query.sql     # execute SQL locally, print rows
+  python -m arroyo_tpu api               # REST API + controller
+  python -m arroyo_tpu controller        # standalone controller
+  python -m arroyo_tpu worker            # worker (CONTROLLER_ADDR, JOB_ID)
+  python -m arroyo_tpu node              # node daemon
+"""
+
+from __future__ import annotations
+
+import sys
+
+
+def _run(path_or_dash: str, checkpoint_url: str | None) -> None:
+    import json
+
+    from .connectors.memory import sink_output
+    from .engine.engine import LocalRunner
+    from .formats import batch_to_rows
+    from .sql import plan_sql
+
+    sql = (sys.stdin.read() if path_or_dash == "-"
+           else open(path_or_dash).read())
+    prog = plan_sql(sql)
+    runner = (LocalRunner(prog, checkpoint_url=checkpoint_url)
+              if checkpoint_url else LocalRunner(prog))
+    runner.run()
+    # bare SELECTs land in the "results" memory sink — print them the
+    # way `arroyo run` streams results to stdout
+    for batch in sink_output("results"):
+        for row in batch_to_rows(batch):
+            print(json.dumps(row, default=str))
+
+
+def main(argv: list[str]) -> int:
+    role = argv[0] if argv else "api"
+    if role == "run":
+        if len(argv) < 2:
+            print("usage: python -m arroyo_tpu run <query.sql | ->",
+                  file=sys.stderr)
+            return 2
+        ckpt = None
+        args = argv[1:]
+        if "--checkpoint-url" in args:
+            i = args.index("--checkpoint-url")
+            ckpt = args[i + 1]
+            del args[i:i + 2]
+        _run(args[0], ckpt)
+        return 0
+    if role == "api":
+        from .api.rest import main as api_main
+
+        api_main()
+        return 0
+    if role == "controller":
+        from .controller.controller import main as ctrl_main
+
+        ctrl_main()
+        return 0
+    if role == "worker":
+        from .worker.server import main as worker_main
+
+        worker_main()
+        return 0
+    if role == "node":
+        from .node.daemon import main as node_main
+
+        node_main()
+        return 0
+    print(f"unknown role {role!r}; choose from run/api/controller/"
+          "worker/node", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -1,10 +1,8 @@
 #!/bin/sh
-# Role selector: api (REST + controller in one process), worker, node.
+# Role selector: api (REST + controller in one process), worker, node —
+# all routes through the single `python -m arroyo_tpu` entry point.
 set -e
 case "${1:-api}" in
-  api)        exec python -m arroyo_tpu.api.rest ;;
-  controller) exec python -m arroyo_tpu.controller.controller ;;
-  worker)     exec python -m arroyo_tpu.worker.server ;;
-  node)       exec python -m arroyo_tpu.node.daemon ;;
-  *)          exec "$@" ;;
+  api|controller|worker|node|run) exec python -m arroyo_tpu "$@" ;;
+  *)                              exec "$@" ;;
 esac

@@ -560,3 +560,26 @@ def test_preview_ttl_reaps_job(api_env):
             assert state in (JobState.STOPPED, JobState.FINISHED), state
 
     _run(loop, scenario())
+
+
+def test_cli_run_executes_sql(tmp_path):
+    """`python -m arroyo_tpu run q.sql` executes locally and streams
+    result rows as JSON lines (the reference binary's run UX)."""
+    import os
+    import subprocess
+    import sys
+
+    q = tmp_path / "q.sql"
+    q.write_text(
+        "CREATE TABLE impulse WITH (connector='impulse', "
+        "event_rate='0', message_count='6', batch_size='2');"
+        "SELECT counter FROM impulse WHERE counter % 2 = 0")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "arroyo_tpu", "run", str(q)],
+        capture_output=True, text=True, timeout=240, env=env,
+        cwd="/root/repo")
+    assert r.returncode == 0, r.stderr[-500:]
+    rows = [json.loads(x) for x in r.stdout.strip().splitlines()]
+    assert [row["counter"] for row in rows] == [0, 2, 4]

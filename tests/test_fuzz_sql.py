@@ -757,3 +757,73 @@ def test_fuzz_multi_source_fanin_no_drops_within_lateness(seed):
     assert got == dict(exp), (
         f"seed {seed}: missing {sorted(set(exp) - set(got))[:5]}, "
         f"extra {sorted(set(got) - set(exp))[:5]}")
+
+
+@pytest.mark.parametrize("seed,shape", [
+    (81, "order_limit"), (82, "row_number"), (83, "order_limit"),
+    (84, "row_number"), (85, "row_number")])
+def test_fuzz_windowed_topn(seed, shape):
+    """Random windowed TopN: both the fused ORDER BY-LIMIT plan and the
+    ROW_NUMBER() OVER rewrite, random window kinds/limits/key skew.
+    Per window: at most k rows, the returned counts are exactly the
+    true top-k multiset, and each returned key's count is its own."""
+    import collections
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1500, 5000))
+    nkeys = int(rng.integers(4, 40))
+    k_lim = int(rng.integers(1, 5))
+    width_s = int(rng.integers(1, 4)) * 2
+    slide_s = width_s if rng.random() < 0.5 else width_s // 2
+    ts = np.sort(rng.integers(0, 8 * SEC, n)).astype(np.int64)
+    keys = (rng.zipf(1.3, n) % nkeys).astype(np.int64)  # skewed
+    p = SchemaProvider()
+    p.add_memory_table("t", {"k": "i"}, [Batch(ts, {"k": keys})])
+    win = (f"TUMBLE(INTERVAL '{width_s}' SECOND)" if slide_s == width_s
+           else f"HOP(INTERVAL '{slide_s}' SECOND, "
+                f"INTERVAL '{width_s}' SECOND)")
+    if shape == "order_limit":
+        sql = f"""
+        CREATE TABLE out WITH (connector='memory', name='results');
+        INSERT INTO out
+        SELECT k, {win} as window, count(*) as num
+        FROM t GROUP BY 1, 2 ORDER BY num DESC LIMIT {k_lim}
+        """
+    else:
+        sql = f"""
+        CREATE TABLE out WITH (connector='memory', name='results');
+        INSERT INTO out
+        SELECT k, num, window FROM (
+          SELECT k, count(*) AS num, {win} as window,
+                 ROW_NUMBER() OVER (PARTITION BY window
+                                    ORDER BY num DESC) as rn
+          FROM t GROUP BY 1, 3
+        ) WHERE rn <= {k_lim}
+        """
+    clear_sink("results")
+    LocalRunner(plan_sql(sql, p)).run()
+    out = Batch.concat(sink_output("results"))
+    want = collections.defaultdict(collections.Counter)
+    W = width_s * SEC
+    S = slide_s * SEC
+    for t, kk in zip(ts.tolist(), keys.tolist()):
+        e = (t // S + 1) * S
+        while e - W <= t < e:
+            want[e][kk] += 1
+            e += S
+    per_w = collections.defaultdict(list)
+    for i in range(len(out)):
+        per_w[int(out.columns["window_end"][i])].append(
+            (int(out.columns["k"][i]), int(out.columns["num"][i])))
+    assert set(per_w) <= set(want), seed
+    # every window with data must appear (top-k of a non-empty window
+    # is non-empty)
+    assert set(per_w) == set(want), (
+        f"seed {seed}: missing windows {sorted(set(want) - set(per_w))[:4]}")
+    for wend, rows_ in per_w.items():
+        assert len(rows_) <= k_lim, (seed, wend)
+        true_top = sorted(want[wend].values(), reverse=True)[:k_lim]
+        assert sorted((c for _, c in rows_), reverse=True) == true_top, (
+            seed, wend)
+        for kk, c in rows_:
+            assert want[wend][kk] == c, (seed, wend, kk)

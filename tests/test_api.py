@@ -176,7 +176,7 @@ def test_output_tailing_sse(api_env):
     async def scenario():
         sql = """
         CREATE TABLE impulse WITH (connector = 'impulse',
-          event_rate = '500', message_count = '400', batch_size = '64');
+          event_rate = '500', message_count = '3000', batch_size = '64');
         SELECT counter FROM impulse
         """
         async with httpx.AsyncClient(base_url=base, timeout=30) as c:
@@ -195,10 +195,12 @@ def test_output_tailing_sse(api_env):
                     if not line.startswith("data: "):
                         continue
                     event = json.loads(line[len("data: "):])
-                    if event.get("done"):
+                    if event.get("done") or rows >= 100:
                         break
                     rows += len(event.get("rows", []))
-            assert rows >= 0  # stream terminated cleanly
+            # the 6s paced run guarantees the subscription observes
+            # live data, not just a clean termination
+            assert rows >= 100, rows
 
     _run(loop, scenario())
 

@@ -827,3 +827,94 @@ def test_fuzz_windowed_topn(seed, shape):
             seed, wend)
         for kk, c in rows_:
             assert want[wend][kk] == c, (seed, wend, kk)
+
+
+@pytest.mark.parametrize("seed", [91, 92, 93])
+def test_fuzz_session_max_size_clamp(seed):
+    """Sessions chaining across the 24h MAX_SESSION_SIZE clamp: random
+    near-gap spacings force chains that the engine must split exactly
+    where the incremental per-event clamp splits them.  Oracle replays
+    the reference's windows.rs clamp semantics event by event."""
+    import collections
+
+    from arroyo_tpu.engine.operators_window import MAX_SESSION_SIZE_MICROS
+
+    rng = np.random.default_rng(seed)
+    MAX = MAX_SESSION_SIZE_MICROS
+    gap_s = int(rng.integers(2, 10))
+    gap = gap_s * SEC
+    nkeys = 3
+    ts_parts, k_parts = [], []
+    for key in range(nkeys):
+        # a chain that crosses the clamp: spacings mostly just under the
+        # gap, sprinkled with over-gap breaks
+        m = int(rng.integers(40, 90))
+        steps = rng.integers(1, gap + gap // 4, m)  # some exceed gap
+        base = int(rng.integers(0, 5 * SEC))
+        # scale the chain so cumulative span crosses MAX at least once
+        scale = max(1, int((MAX * 1.5) // max(int(steps.sum()), 1)))
+        t = base + np.cumsum(steps.astype(np.int64) * scale)
+        # re-derive effective spacings vs gap after scaling: keep raw
+        ts_parts.append(t)
+        k_parts.append(np.full(m, key, dtype=np.int64))
+    ts = np.concatenate(ts_parts)
+    keys = np.concatenate(k_parts)
+    o = np.argsort(ts, kind="stable")
+    ts, keys = ts[o], keys[o]
+
+    p = SchemaProvider()
+    nb = int(rng.integers(1, 5))
+    bounds = np.linspace(0, len(ts), nb + 1).astype(int)
+    p.add_memory_table("t", {"k": "i"}, [
+        Batch(ts[a:b], {"k": keys[a:b]})
+        for a, b in zip(bounds[:-1], bounds[1:]) if b > a])
+    clear_sink("results")
+    LocalRunner(plan_sql(f"""
+        SELECT k, count(*) as cnt,
+               SESSION(INTERVAL '{gap_s}' SECOND) as window
+        FROM t GROUP BY 1, 3
+    """, p)).run()
+    out = Batch.concat(sink_output("results"))
+
+    # oracle: the reference's incremental merge + clamp, per event
+    def sessions_of(times):
+        sess = []  # (start, end) clamped
+        for t in times:
+            placed = False
+            for i, (s, e) in enumerate(sess):
+                if s - gap <= t < e:
+                    ns, ne = min(s, t), max(e, t + gap)
+                    if ne - ns > MAX:
+                        ne = ns + MAX
+                    sess[i] = (ns, ne)
+                    placed = True
+                    break
+            if not placed:
+                sess.append((t, t + gap))
+            sess.sort()
+            merged = []
+            for s, e in sess:
+                if merged and s <= merged[-1][1]:
+                    ps, pe = merged[-1]
+                    ne = max(pe, e)
+                    if ne - ps > MAX:
+                        ne = ps + MAX
+                    merged[-1] = (ps, ne)
+                else:
+                    merged.append((s, e))
+            sess = merged
+        return sess
+
+    exp = collections.Counter()
+    for key in range(nkeys):
+        times = np.sort(ts[keys == key]).tolist()
+        for (s, e) in sessions_of(times):
+            cnt = sum(1 for t in times if s <= t < e)
+            if cnt:
+                exp[(key, s, cnt)] += 1
+    got = collections.Counter(
+        (int(out.columns["k"][j]), int(out.columns["window_start"][j]),
+         int(out.columns["cnt"][j])) for j in range(len(out)))
+    assert got == exp, (
+        f"seed {seed}: missing {sorted((exp - got).keys())[:4]}, "
+        f"extra {sorted((got - exp).keys())[:4]}")

@@ -585,3 +585,73 @@ def test_cli_run_executes_sql(tmp_path):
     assert r.returncode == 0, r.stderr[-500:]
     rows = [json.loads(x) for x in r.stdout.strip().splitlines()]
     assert [row["counter"] for row in rows] == [0, 2, 4]
+
+
+def test_black_box_api_process(tmp_path):
+    """Deploy-grade smoke: boot the real `api` role as an OS process
+    (python -m arroyo_tpu api — controller + REST in one), drive a
+    preview pipeline over plain HTTP through the spec-generated client,
+    and observe streamed output.  The closest analog of running the
+    reference's docker image and pointing integ at it."""
+    import os
+    import socket
+    import subprocess
+    import sys
+    import time as _time
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    api_port, ctrl_port = free_port(), free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               API_PORT=str(api_port), API_HOST="127.0.0.1",
+               CONTROLLER_PORT=str(ctrl_port),
+               CONTROLLER_HOST="127.0.0.1",
+               CHECKPOINT_URL=f"file://{tmp_path}/ckpt")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "arroyo_tpu", "api"], env=env,
+        cwd="/root/repo", stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    base = f"http://127.0.0.1:{api_port}"
+    try:
+        from arroyo_tpu.api.client import generate_client
+
+        async def scenario():
+            async with httpx.AsyncClient(timeout=30) as http:
+                for _ in range(100):  # wait for the process to listen
+                    try:
+                        r = await http.get(base + "/api/v1/ping")
+                        if r.status_code == 200:
+                            break
+                    except httpx.TransportError:
+                        await asyncio.sleep(0.2)
+                else:
+                    raise AssertionError("api process never came up")
+                client = await generate_client(base, http)
+                pl = await client.create_pipeline(body={
+                    "name": "bb", "preview": True, "query": (
+                        "CREATE TABLE impulse WITH (connector='impulse',"
+                        " event_rate='0', message_count='500',"
+                        " batch_size='64');"
+                        "SELECT counter FROM impulse")})
+                jid = pl["jobs"][0]["id"]
+                for _ in range(150):
+                    jobs = (await client.list_jobs())["data"]
+                    job = next(j for j in jobs if j["id"] == jid)
+                    if job["state"] in ("Finished", "Stopped", "Failed"):
+                        break
+                    await asyncio.sleep(0.2)
+                assert job["state"] == "Finished", job
+
+        asyncio.run(scenario())
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()

@@ -38,16 +38,20 @@ def _run(path_or_dash: str, checkpoint_url: str | None) -> None:
 def main(argv: list[str]) -> int:
     role = argv[0] if argv else "api"
     if role == "run":
-        if len(argv) < 2:
-            print("usage: python -m arroyo_tpu run <query.sql | ->",
-                  file=sys.stderr)
-            return 2
+        usage = "usage: python -m arroyo_tpu run <query.sql | -> " \
+                "[--checkpoint-url URL]"
         ckpt = None
         args = argv[1:]
         if "--checkpoint-url" in args:
             i = args.index("--checkpoint-url")
+            if i + 1 >= len(args):
+                print(usage, file=sys.stderr)
+                return 2
             ckpt = args[i + 1]
             del args[i:i + 2]
+        if len(args) != 1:
+            print(usage, file=sys.stderr)
+            return 2
         _run(args[0], ckpt)
         return 0
     if role == "api":

@@ -272,6 +272,12 @@ class ApiServer:
                 raise HttpError(400, "missing 'name' or 'query'")
             preview = bool(body.get("preview"))
             parallelism = 1 if preview else int(body.get("parallelism", 1))
+            try:  # validate BEFORE the job exists: a bad ttl must not
+                # leave an unreaped preview running behind a 500
+                ttl_secs = (float(body.get("ttl_secs", 60))
+                            if preview else None)
+            except (TypeError, ValueError):
+                raise HttpError(400, "ttl_secs must be a number")
             prog = self._plan(query, parallelism)
             if preview:
                 # the reference's preview mode (pipelines.rs:191-198):
@@ -301,24 +307,11 @@ class ApiServer:
                 self.db.execute(
                     "INSERT INTO jobs (id, pipeline_id, created_at) "
                     "VALUES (?,?,?)", (job_id, pipeline_id, now))
-            await self.controller.submit_job(prog, job_id=job_id)
-            if preview:
-                ttl = float(body.get("ttl_secs", 60))
-
-                async def reap_preview():
-                    await asyncio.sleep(ttl)
-                    from ..controller.state_machine import JobState
-
-                    job = self.controller.jobs.get(job_id)
-                    if job is not None and not job.fsm.state.terminal:
-                        try:
-                            await self.controller.stop_job(
-                                job_id, checkpoint=False)
-                        except Exception:
-                            logger.warning("preview reap of %s failed",
-                                           job_id, exc_info=True)
-
-                asyncio.ensure_future(reap_preview())
+            # ttl enforcement lives in the controller's supervisor (and
+            # its durable store), so a restarted controller still reaps
+            # resumed previews
+            await self.controller.submit_job(prog, job_id=job_id,
+                                             ttl_secs=ttl_secs)
             return {"id": pipeline_id, "name": name, "preview": preview,
                     "jobs": [{"id": job_id}],
                     "graph": graph}

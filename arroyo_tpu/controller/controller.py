@@ -78,6 +78,11 @@ class Job:
         self.failure: Optional[str] = None
         self.supervisor: Optional[asyncio.Task] = None
         self.stop_requested = False
+        # absolute wall deadline (time.time()) after which the
+        # supervisor stops the job — preview pipelines (reference
+        # pipelines.rs ttl_micros); persisted so a restarted controller
+        # still reaps resumed previews
+        self.ttl_deadline: Optional[float] = None
 
     @property
     def slots_needed(self) -> int:
@@ -163,10 +168,21 @@ class ControllerServer:
             await self.scheduler.reap(row.job_id,
                                       self.store.workers(row.job_id))
             self.store.set_workers(row.job_id, [])
+            if (row.ttl_deadline is not None
+                    and time.time() > row.ttl_deadline):
+                # an expired preview from the previous incarnation: its
+                # workers are already reaped below via the worker table —
+                # settle it instead of resuming
+                await self.scheduler.reap(row.job_id,
+                                          self.store.workers(row.job_id))
+                self.store.set_workers(row.job_id, [])
+                self.store.set_state(row.job_id, JobState.STOPPED.value)
+                continue
             job = Job(row.job_id, program, row.checkpoint_url,
                       max(n.parallelism for n in program.nodes()))
             job.epoch = row.epoch
             job.min_epoch = row.min_epoch
+            job.ttl_deadline = row.ttl_deadline
             self._attach_store(job, row.n_workers)
             self.jobs[row.job_id] = job
             logger.info("resuming job %s from durable store (stored "
@@ -199,16 +215,20 @@ class ControllerServer:
     async def submit_job(self, program: Program, job_id: Optional[str] = None,
                          checkpoint_url: Optional[str] = None,
                          n_workers: int = 1,
-                         restore: bool = False) -> str:
+                         restore: bool = False,
+                         ttl_secs: Optional[float] = None) -> str:
         job_id = job_id or f"job-{uuid.uuid4().hex[:8]}"
         job = Job(job_id, program,
                   checkpoint_url or config().checkpoint_url,
                   max(n.parallelism for n in program.nodes()))
+        if ttl_secs is not None:
+            job.ttl_deadline = time.time() + float(ttl_secs)
         self.jobs[job_id] = job
         if self.store is not None:
             self.store.upsert_job(job_id, pickle.dumps(program),
                                   job.checkpoint_url, n_workers,
-                                  JobState.CREATED.value)
+                                  JobState.CREATED.value,
+                                  ttl_deadline=job.ttl_deadline)
             self._attach_store(job, n_workers)
         job.supervisor = asyncio.ensure_future(
             self._drive(job, n_workers, restore))
@@ -404,6 +424,18 @@ class ControllerServer:
                     continue
                 return
             if state != JobState.RUNNING:
+                continue
+            # ttl reap (preview pipelines): enforced HERE so a durable
+            # controller restart keeps the deadline armed
+            if (job.ttl_deadline is not None
+                    and time.time() > job.ttl_deadline
+                    and not job.stop_requested):
+                logger.info("job %s ttl expired; stopping", job.job_id)
+                try:
+                    await self.stop_job(job.job_id, checkpoint=False)
+                except Exception:
+                    logger.warning("ttl stop of %s failed", job.job_id,
+                                   exc_info=True)
                 continue
             # heartbeat timeout (30s)
             now = time.monotonic()

@@ -34,6 +34,7 @@ class StoredJob:
     min_epoch: int
     last_successful_epoch: Optional[int]
     stop_requested: bool
+    ttl_deadline: Optional[float] = None
 
 
 class ControllerStore:
@@ -52,8 +53,13 @@ class ControllerStore:
                 last_successful_epoch INTEGER,
                 stop_requested INTEGER NOT NULL DEFAULT 0,
                 failure TEXT,
-                updated_at REAL NOT NULL
+                updated_at REAL NOT NULL,
+                ttl_deadline REAL
             )""")
+        try:  # stores created before the ttl column
+            self.db.execute("ALTER TABLE jobs ADD COLUMN ttl_deadline REAL")
+        except sqlite3.OperationalError:
+            pass
         self.db.execute("""
             CREATE TABLE IF NOT EXISTS job_workers (
                 job_id TEXT NOT NULL,
@@ -68,15 +74,18 @@ class ControllerStore:
     # -- job rows ----------------------------------------------------------
 
     def upsert_job(self, job_id: str, program: bytes, checkpoint_url: str,
-                   n_workers: int, state: str) -> None:
+                   n_workers: int, state: str,
+                   ttl_deadline: Optional[float] = None) -> None:
         self.db.execute(
             "INSERT INTO jobs (job_id, program, checkpoint_url, n_workers,"
-            " state, updated_at) VALUES (?, ?, ?, ?, ?, ?)"
+            " state, updated_at, ttl_deadline) VALUES (?, ?, ?, ?, ?, ?, ?)"
             " ON CONFLICT(job_id) DO UPDATE SET program=excluded.program,"
             " checkpoint_url=excluded.checkpoint_url,"
             " n_workers=excluded.n_workers, state=excluded.state,"
-            " updated_at=excluded.updated_at",
-            (job_id, program, checkpoint_url, n_workers, state, time.time()))
+            " updated_at=excluded.updated_at,"
+            " ttl_deadline=excluded.ttl_deadline",
+            (job_id, program, checkpoint_url, n_workers, state, time.time(),
+             ttl_deadline))
         self.db.commit()
 
     def set_state(self, job_id: str, state: str,
@@ -117,11 +126,12 @@ class ControllerStore:
         """Jobs a fresh controller must adopt: every non-terminal row."""
         rows = self.db.execute(
             "SELECT job_id, program, checkpoint_url, n_workers, state,"
-            " epoch, min_epoch, last_successful_epoch, stop_requested"
+            " epoch, min_epoch, last_successful_epoch, stop_requested,"
+            " ttl_deadline"
             " FROM jobs WHERE state NOT IN (?, ?, ?)",
             TERMINAL_STATES).fetchall()
         return [StoredJob(r[0], r[1], r[2], r[3], r[4], r[5], r[6], r[7],
-                          bool(r[8])) for r in rows]
+                          bool(r[8]), r[9]) for r in rows]
 
     # -- scheduler external worker ids ------------------------------------
 

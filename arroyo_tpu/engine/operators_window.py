@@ -375,6 +375,8 @@ class SessionWindowOperator(Operator):
         self.flatten = flatten or not aggs
         self.projection = (CompiledExpr(projection.name, projection.fn)
                            if projection else None)
+        self._pending_fires: List[Tuple[int, int, int]] = []
+        self._min_end: Optional[int] = None  # no-fire fast-path bound
 
     def tables(self) -> List[TableDescriptor]:
         return [
@@ -414,8 +416,7 @@ class SessionWindowOperator(Operator):
         self.windows.insert(int(times.max()), kh, sessions)
         if sessions:
             me = min(e for _, e in sessions)
-            if getattr(self, "_min_end", None) is not None \
-                    and me < self._min_end:
+            if self._min_end is not None and me < self._min_end:
                 self._min_end = me
 
     async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
@@ -493,8 +494,7 @@ class SessionWindowOperator(Operator):
             # keep the no-fire fast-path bound conservative: a fresh
             # short session may end before the cached minimum
             me = min(e for _, e in merged)
-            if getattr(self, "_min_end", None) is not None \
-                    and me < self._min_end:
+            if self._min_end is not None and me < self._min_end:
                 self._min_end = me
         return True
 
@@ -507,11 +507,8 @@ class SessionWindowOperator(Operator):
         a session (measured ~13% of the config5 run).  A min-end bound
         skips the scan entirely while nothing can fire (many dormant
         keys, slowly advancing watermark)."""
-        bound = getattr(self, "_min_end", None)
-        if bound is not None and watermark < bound:
+        if self._min_end is not None and watermark < self._min_end:
             return
-        if not hasattr(self, "_pending_fires"):
-            self._pending_fires = []
         expired_keys = []
         min_end = None
         for kh, sessions in self.windows.items():
@@ -536,7 +533,7 @@ class SessionWindowOperator(Operator):
         self._min_end = min_end
 
     async def _flush_fires(self, ctx: Context) -> None:
-        fires = getattr(self, "_pending_fires", None)
+        fires = self._pending_fires
         if not fires:
             return
         self._pending_fires = []

@@ -337,3 +337,47 @@ def test_controller_crash_resumes_job_from_durable_store(tmp_path, monkeypatch):
     rows = [json.loads(line) for line in open(out_path)]
     assert sum(r["cnt"] for r in rows) == N
     assert len({r["bucket"] for r in rows}) == 5
+
+
+def test_expired_ttl_job_settles_on_controller_restart(tmp_path):
+    """A preview (ttl) job whose deadline passed while the controller
+    was down must settle to Stopped on resume — not run forever (the
+    API-side reaper died with the old process; the deadline lives in
+    the durable store)."""
+    from arroyo_tpu.controller.scheduler import InProcessScheduler
+
+    db_path = str(tmp_path / "c.db")
+
+    async def one():
+        ctrl = ControllerServer(InProcessScheduler(), db_path=db_path)
+        await ctrl.start()
+        prog = (
+            Stream.source("impulse", {"event_rate": 50.0,
+                                      "message_count": 10_000_000,
+                                      "batch_size": 32})
+            .map(lambda c: {"counter": c["counter"]}, name="m")
+            .sink("blackhole", {})
+        )
+        jid = await ctrl.submit_job(
+            prog, checkpoint_url=f"file://{tmp_path}/ckpt",
+            ttl_secs=1.0)
+        await ctrl.wait_for_state(jid, JobState.RUNNING, timeout=60)
+        # crash without stopping the job
+        ctrl.jobs[jid].supervisor.cancel()
+        await ctrl.rpc.stop()
+        ctrl.store.close()
+        return jid
+
+    async def two(jid):
+        await asyncio.sleep(1.2)  # deadline passes while "down"
+        ctrl = ControllerServer(InProcessScheduler(), db_path=db_path)
+        await ctrl.start()
+        try:
+            assert jid not in ctrl.jobs, "expired ttl job was resumed"
+            rows = ctrl.store.resumable()
+            assert all(r.job_id != jid for r in rows)
+        finally:
+            await ctrl.stop()
+
+    jid = asyncio.run(one())
+    asyncio.run(two(jid))

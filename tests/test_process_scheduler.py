@@ -381,3 +381,45 @@ def test_expired_ttl_job_settles_on_controller_restart(tmp_path):
 
     jid = asyncio.run(one())
     asyncio.run(two(jid))
+
+
+def test_live_ttl_survives_controller_restart(tmp_path):
+    """A ttl job restarted BEFORE its deadline resumes — and the new
+    controller's supervisor still stops it when the deadline passes."""
+    from arroyo_tpu.controller.scheduler import InProcessScheduler
+
+    db_path = str(tmp_path / "c.db")
+
+    async def one():
+        ctrl = ControllerServer(InProcessScheduler(), db_path=db_path)
+        await ctrl.start()
+        prog = (
+            Stream.source("impulse", {"event_rate": 50.0,
+                                      "message_count": 10_000_000,
+                                      "batch_size": 32})
+            .map(lambda c: {"counter": c["counter"]}, name="m")
+            .sink("blackhole", {})
+        )
+        jid = await ctrl.submit_job(
+            prog, checkpoint_url=f"file://{tmp_path}/ckpt",
+            ttl_secs=6.0)
+        await ctrl.wait_for_state(jid, JobState.RUNNING, timeout=60)
+        ctrl.jobs[jid].supervisor.cancel()
+        await ctrl.rpc.stop()
+        ctrl.store.close()
+        return jid
+
+    async def two(jid):
+        ctrl = ControllerServer(InProcessScheduler(), db_path=db_path)
+        await ctrl.start()
+        try:
+            assert jid in ctrl.jobs, "live ttl job not resumed"
+            assert ctrl.jobs[jid].ttl_deadline is not None
+            state = await ctrl.wait_for_state(
+                jid, JobState.STOPPED, timeout=60)
+            assert state == JobState.STOPPED, state
+        finally:
+            await ctrl.stop()
+
+    jid = asyncio.run(one())
+    asyncio.run(two(jid))

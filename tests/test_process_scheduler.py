@@ -349,7 +349,8 @@ def test_expired_ttl_job_settles_on_controller_restart(tmp_path):
     db_path = str(tmp_path / "c.db")
 
     async def one():
-        ctrl = ControllerServer(InProcessScheduler(), db_path=db_path)
+        sched = InProcessScheduler()
+        ctrl = ControllerServer(sched, db_path=db_path)
         await ctrl.start()
         prog = (
             Stream.source("impulse", {"event_rate": 50.0,
@@ -362,8 +363,11 @@ def test_expired_ttl_job_settles_on_controller_restart(tmp_path):
             prog, checkpoint_url=f"file://{tmp_path}/ckpt",
             ttl_secs=1.0)
         await ctrl.wait_for_state(jid, JobState.RUNNING, timeout=60)
-        # crash without stopping the job
+        # crash without stopping the job; in-process workers die with
+        # the process, so kill them too (leaving their grpc servers to
+        # the GC raises unraisable-exception noise on loop close)
         ctrl.jobs[jid].supervisor.cancel()
+        await sched.stop_workers(jid, force=True)
         await ctrl.rpc.stop()
         ctrl.store.close()
         return jid
@@ -391,7 +395,8 @@ def test_live_ttl_survives_controller_restart(tmp_path):
     db_path = str(tmp_path / "c.db")
 
     async def one():
-        ctrl = ControllerServer(InProcessScheduler(), db_path=db_path)
+        sched = InProcessScheduler()
+        ctrl = ControllerServer(sched, db_path=db_path)
         await ctrl.start()
         prog = (
             Stream.source("impulse", {"event_rate": 50.0,
@@ -405,6 +410,7 @@ def test_live_ttl_survives_controller_restart(tmp_path):
             ttl_secs=6.0)
         await ctrl.wait_for_state(jid, JobState.RUNNING, timeout=60)
         ctrl.jobs[jid].supervisor.cancel()
+        await sched.stop_workers(jid, force=True)
         await ctrl.rpc.stop()
         ctrl.store.close()
         return jid

@@ -376,7 +376,7 @@ def test_fuzz_outer_join_net_result(seed, kind, device_join, monkeypatch):
         f"(net-exp={+(net - exp)!r}, exp-net={+(exp - net)!r})")
 
 
-@pytest.mark.parametrize("seed", [31, 32, 33, 34])
+@pytest.mark.parametrize("seed", [31, 32, 33, 34, 35, 36, 37])
 def test_fuzz_checkpoint_restore_exactly_once(seed, tmp_path):
     """Random pipeline shapes x random crash points: checkpoint, crash,
     restore — output must be exactly-once (no gaps, no duplicates)

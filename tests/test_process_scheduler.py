@@ -429,3 +429,75 @@ def test_live_ttl_survives_controller_restart(tmp_path):
 
     jid = asyncio.run(one())
     asyncio.run(two(jid))
+
+
+def test_rescaled_parallelism_survives_controller_restart(tmp_path):
+    """rescale_job persists the updated program; a controller crash
+    right after the rescale must resume the job at the NEW parallelism,
+    not the submitted one."""
+    from arroyo_tpu.controller.scheduler import InProcessScheduler
+
+    db_path = str(tmp_path / "c.db")
+
+    async def one():
+        sched = InProcessScheduler()
+        ctrl = ControllerServer(sched, db_path=db_path)
+        await ctrl.start()
+        prog = (
+            Stream.source("impulse", {"event_rate": 4000.0,
+                                      "message_count": 10_000_000,
+                                      "event_time_interval_micros": 1000,
+                                      "batch_size": 256})
+            .watermark(max_lateness_micros=0)
+            .map(lambda c: {"counter": c["counter"],
+                            "bucket": c["counter"] % 5}, name="b")
+            .key_by("bucket")
+            .tumbling_aggregate(
+                500 * 1000, [AggSpec(AggKind.COUNT, None, "cnt")],
+                parallelism=1)
+            .sink("blackhole", {})
+        )
+        jid = await ctrl.submit_job(
+            prog, checkpoint_url=f"file://{tmp_path}/ckpt")
+        await ctrl.wait_for_state(jid, JobState.RUNNING, timeout=60)
+        for _ in range(400):  # need a checkpoint for the rescale stop
+            if (ctrl.jobs[jid].last_successful_epoch or 0) >= 1:
+                break
+            await asyncio.sleep(0.05)
+        agg_ops = [n.operator_id
+                   for n in ctrl.jobs[jid].program.nodes()
+                   if "aggregator" in n.operator_id]
+        await ctrl.rescale_job(jid, {op: 2 for op in agg_ops})
+        await ctrl.wait_for_state(jid, JobState.RUNNING, timeout=60)
+        # crash
+        ctrl.jobs[jid].supervisor.cancel()
+        await sched.stop_workers(jid, force=True)
+        await ctrl.rpc.stop()
+        ctrl.store.close()
+        return jid, agg_ops
+
+    async def two(jid, agg_ops):
+        ctrl = ControllerServer(InProcessScheduler(), db_path=db_path)
+        await ctrl.start()
+        try:
+            assert jid in ctrl.jobs
+            await ctrl.wait_for_state(jid, JobState.RUNNING, timeout=60)
+            prog = ctrl.jobs[jid].program
+            for op in agg_ops:
+                assert prog.node(op).parallelism == 2, op
+            await ctrl.stop_job(jid, checkpoint=False)
+            await ctrl.wait_for_state(jid, JobState.STOPPED, timeout=60)
+        finally:
+            await ctrl.stop()
+
+    import os
+    os.environ["CHECKPOINT_INTERVAL_SECS"] = "0.5"
+    from arroyo_tpu.config import reset_config
+
+    reset_config()
+    try:
+        jid, agg_ops = asyncio.run(one())
+        asyncio.run(two(jid, agg_ops))
+    finally:
+        os.environ.pop("CHECKPOINT_INTERVAL_SECS", None)
+        reset_config()

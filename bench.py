@@ -805,8 +805,10 @@ def main_child() -> None:
     """The actual benchmark, run inside a supervised subprocess."""
     os.environ.setdefault("BATCH_SIZE", str(BATCH))
     # pre-size keyed state near the expected Nexmark key cardinality so the
-    # timed run never pays a capacity-growth recompile (config.py hint)
-    os.environ.setdefault("STATE_CAPACITY", str(1 << 15))
+    # timed run never pays a capacity-growth recompile (config.py hint);
+    # 2M-event q5 sees >32k distinct auctions, so 128k slots (~67 MB of
+    # f64 state at B=16) keeps the whole run growth-free
+    os.environ.setdefault("STATE_CAPACITY", str(1 << 17))
     # initialize the jax backend before any asyncio loop runs: the axon
     # TPU-tunnel plugin's device discovery can deadlock when first
     # triggered from inside a running event loop

@@ -578,7 +578,7 @@ def _gen_expr(rng, depth):
     return f"ABS({ls})", f
 
 
-@pytest.mark.parametrize("seed", list(range(51, 76)))
+@pytest.mark.parametrize("seed", list(range(51, 91)))
 def test_fuzz_scalar_expressions(seed):
     """Random expression trees (arithmetic, CASE, COALESCE, ABS) over a
     nullable float column, evaluated through the full engine and checked

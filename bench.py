@@ -838,8 +838,15 @@ def main_child() -> None:
         # bandwidth-bound, not MXU work) and keep the keyed window state
         # on the TPU — override with ARROYO_EXPR_DEVICE=default
         os.environ.setdefault("ARROYO_EXPR_DEVICE", "cpu")
+        # joins too: the device join sorts uint64 key hashes, and the TPU
+        # has no native 64-bit integers — the emulated-u64 argsort measured
+        # 537 ms/step vs sub-ms host numpy at 16k rows (see
+        # BENCH_TPU_KERNELS_r04.json join_step_ms) — override with
+        # ARROYO_DEVICE_JOIN=auto/on
+        os.environ.setdefault("ARROYO_DEVICE_JOIN", "off")
         print("axon tunnel detected: expressions pinned to host "
-              f"(ARROYO_EXPR_DEVICE={os.environ['ARROYO_EXPR_DEVICE']})",
+              f"(ARROYO_EXPR_DEVICE={os.environ['ARROYO_EXPR_DEVICE']}, "
+              f"ARROYO_DEVICE_JOIN={os.environ['ARROYO_DEVICE_JOIN']})",
               file=sys.stderr)
     headline = os.environ.get("BENCH_QUERY", "q5")
     if headline not in QUERIES:

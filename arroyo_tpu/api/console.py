@@ -124,7 +124,10 @@ FROM nexmark WHERE bid is not null GROUP BY 1, 2</textarea>
     <div id="charts">select a job's "watch" for live operator rates…</div>
     <div style="display:grid;grid-template-columns:1fr 1fr;gap:12px;
                 margin-top:10px">
-      <div><h2>Checkpoints</h2><pre id="ckpts">—</pre></div>
+      <div><h2>Checkpoints
+        <span style="color:var(--dim)">(click an epoch for detail)</span>
+        </h2><pre id="ckpts">—</pre>
+        <pre id="ckptdetail" style="display:none;margin-top:8px"></pre></div>
       <div><h2>Errors</h2><pre id="errors">—</pre></div>
     </div>
   </section>
@@ -394,8 +397,11 @@ async function pollJob() {
     `/v1/pipelines/${pid}/jobs/${jid}/checkpoints`);
   if (ck.ok) {
     const cj = await ck.json();
-    $('ckpts').textContent = (cj.data || []).slice(-8).reverse().map(c =>
-      `epoch ${c.epoch}  ${c.backend ?? ''} ${c.finished ? '✓' : '…'}`)
+    $('ckpts').innerHTML = (cj.data || []).slice(-8).reverse().map(c =>
+      `<a href="#" style="color:var(--accent);text-decoration:none"
+        onclick="ckptDetail(${c.epoch});return false">epoch ${c.epoch}</a>` +
+      `  ${esc(c.backend ?? '')} ${c.finished ? '✓' : '…'} ` +
+      `(${c.subtasks_completed}/${c.subtasks_total} subtasks)`)
       .join('\\n') || '—';
   }
   const er = await fetch(`/v1/pipelines/${pid}/jobs/${jid}/errors`);
@@ -405,6 +411,34 @@ async function pollJob() {
       `${e.created_at ?? ''} ${e.message ?? JSON.stringify(e)}`)
       .join('\\n') || '—';
   }
+}
+
+function fmtBytes(b) {
+  if (b >= 1e6) return (b / 1e6).toFixed(2) + ' MB';
+  if (b >= 1e3) return (b / 1e3).toFixed(1) + ' kB';
+  return b + ' B';
+}
+
+async function ckptDetail(epoch) {
+  // per-operator files + bytes for one checkpoint epoch (the reference
+  // console's checkpoint-details panel, jobs.rs get_checkpoint_details)
+  if (!watching) return;
+  const {pid, jid} = watching;
+  const el = $('ckptdetail');
+  el.style.display = '';
+  el.textContent = `epoch ${epoch}: loading…`;
+  const r = await fetch(`/v1/pipelines/${pid}/jobs/${jid}/checkpoints/` +
+                        `${epoch}/operator_checkpoint_groups`);
+  // the user may have switched jobs while the fetch was in flight
+  if (!watching || watching.pid !== pid || watching.jid !== jid) return;
+  if (!r.ok) { el.textContent = `epoch ${epoch}: ${r.status}`; return; }
+  const j = await r.json();
+  const rows = (j.data || []).map(g =>
+    `${g.operator_id.padEnd(28)} ${fmtBytes(g.bytes).padStart(10)}` +
+    `  ${g.files.length} file${g.files.length === 1 ? '' : 's'}`);
+  el.textContent = `epoch ${epoch} ` +
+    `${j.finished === false ? '(in progress)' : ''}\\n` +
+    (rows.join('\\n') || '(no files)');
 }
 
 async function seedHistory(pid, jid) {
@@ -437,6 +471,7 @@ function watch(pid, jid) {
   $('jobinfo').textContent = `(${jid})`;
   $('charts').dataset.built = '';
   $('jobdag').innerHTML = '';
+  $('ckptdetail').style.display = 'none';
   fetch('/v1/pipelines/' + pid).then(r => r.json()).then(p => {
     if (p.graph) $('jobdag').innerHTML = renderDag(p.graph, true);
   }).catch(() => {});

@@ -60,17 +60,17 @@ def _init_value(kind: AggKind) -> float:
 @functools.lru_cache(maxsize=256)
 def _update_kernel(kinds: Tuple[str, ...], C: int, B: int, n: int):
     @jax.jit
-    def run(values, counts, packed):
-        # ONE packed f64[k+3, n] input (one host->device transfer — a
-        # tunneled TPU pays per-transfer latency): rows are
-        # [slots, bins, rowcount, channel values...] per pre-aggregated
-        # (key, bin) cell; slot/bin/count values are small integers,
-        # exact in f64 to 2^53.  rowcount 0 marks padding.
-        slots = packed[0].astype(jnp.int32)
-        bins = packed[1].astype(jnp.int32)
-        rowcnt = packed[2]
+    def run(values, counts, idx, packed):
+        # TWO packed inputs (two host->device transfers — a tunneled TPU
+        # pays per-transfer latency, so indices don't ride as f64):
+        # idx i32[2, n] rows are [slots, bins]; packed f64[k+1, n] rows
+        # are [rowcount, channel values...] per pre-aggregated (key, bin)
+        # cell.  rowcount 0 marks padding.
+        slots = idx[0]
+        bins = idx[1]
+        rowcnt = packed[0]
         valid = rowcnt > 0.5
-        vals = packed[3:]
+        vals = packed[1:]
         s = jnp.where(valid, slots, C)  # trash row
         b = jnp.where(valid, bins, 0)
         counts = counts.at[s.clip(0, C - 1), b].add(
@@ -449,21 +449,19 @@ class KeyedBinState:
             return
 
         npad = _bucket(m, floor=256)
-        # slot/bin indices ride the packed f64 transfer: exact below 2^53
-        # (a key table that size is unreachable; the Pallas path keeps its
-        # own tighter f32 2^24 guard in pallas_kernels.update_bin_state)
-        assert self.C <= 1 << 53, "key capacity exceeds f64-exact packing"
-        packed = np.zeros((len(self._ch_kinds) + 3, npad), dtype=ACC_DTYPE)
-        packed[0, :m] = slots_c
-        packed[1, :m] = bins_c
-        packed[2, :m] = rowcnt
-        packed[3:, :m] = vals_c
+        idx = np.zeros((2, npad), dtype=np.int32)
+        idx[0, :m] = slots_c
+        idx[1, :m] = bins_c
+        packed = np.zeros((len(self._ch_kinds) + 1, npad), dtype=ACC_DTYPE)
+        packed[0, :m] = rowcnt
+        packed[1:, :m] = vals_c
 
         from ..obs.perf import timed_device
 
         kernel = _update_kernel(self._ch_kinds, self.C, self.B, npad)
         self.values, self.counts = timed_device(
-            kernel, self.values, self.counts, jnp.asarray(packed))
+            kernel, self.values, self.counts, jnp.asarray(idx),
+            jnp.asarray(packed))
 
     def _channel_input(self, j: int, agg_inputs: Dict[str, np.ndarray],
                        n: int) -> np.ndarray:

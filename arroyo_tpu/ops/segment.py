@@ -59,6 +59,25 @@ def _segment_agg_kernel(n_padded: int, n_segments: int, agg_kinds: Tuple[str, ..
     return run
 
 
+def _segment_host() -> bool:
+    """True when the per-fire segment reduce should run as numpy
+    reduceat instead of the device kernel: expressions are pinned to
+    host while an accelerator backend is active — the tunnel regime,
+    where every device readback pays ~70 ms fixed latency (BASELINE.md
+    round-4).  This reduce runs once per watermark flush and its result
+    is consumed on host immediately, so it follows the expressions.
+    ARROYO_SEGMENT_HOST forces either path (tests cover the host branch
+    from the CPU mesh this way)."""
+    import os
+
+    forced = os.environ.get("ARROYO_SEGMENT_HOST")
+    if forced is not None:
+        return forced.lower() not in ("", "0", "off", "false", "no")
+    from .expr import _expr_device
+
+    return _expr_device() is not None and jax.default_backend() != "cpu"
+
+
 def _segmented_median(v: np.ndarray, kh_sorted: np.ndarray,
                       uniq: np.ndarray, seg_start: np.ndarray
                       ) -> np.ndarray:
@@ -181,16 +200,31 @@ def segment_aggregate(
         kinds.append("sum")
         rows.append(ok.astype(np.float64))
 
-    vals = np.zeros((len(kinds), npad), dtype=np.float64)
-    for i, row in enumerate(rows):
-        vals[i, :n] = row
+    if _segment_host():
+        row_counts = np.diff(np.append(seg_start, n))
+        outs = np.empty((len(kinds), n_seg), dtype=np.float64)
+        for i, kind in enumerate(kinds):
+            row = rows[i]
+            if kind == "sum":
+                outs[i] = np.add.reduceat(row, seg_start)
+            elif kind == "min":
+                outs[i] = np.minimum.reduceat(row, seg_start)
+            elif kind == "max":
+                outs[i] = np.maximum.reduceat(row, seg_start)
+            else:  # count: rows per segment
+                outs[i] = row_counts
+        counts = row_counts
+    else:
+        vals = np.zeros((len(kinds), npad), dtype=np.float64)
+        for i, row in enumerate(rows):
+            vals[i, :n] = row
 
-    from ..obs.perf import timed_device
+        from ..obs.perf import timed_device
 
-    kernel = _segment_agg_kernel(npad, spad, tuple(kinds))
-    outs, counts = timed_device(kernel, jnp.asarray(vals),
-                                jnp.asarray(sid_p), jnp.asarray(valid))
-    outs = np.asarray(outs)[:, :n_seg]
+        kernel = _segment_agg_kernel(npad, spad, tuple(kinds))
+        outs, counts = timed_device(kernel, jnp.asarray(vals),
+                                    jnp.asarray(sid_p), jnp.asarray(valid))
+        outs = np.asarray(outs)[:, :n_seg]
     out_cols = dict(distinct_results)
     valid_counts: Dict[str, np.ndarray] = {}
     for a, ci, vi in specs:

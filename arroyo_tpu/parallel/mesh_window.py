@@ -187,8 +187,24 @@ def _update_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int, N: int):
         new_keys = jnp.full((C,), EMPTY, jnp.uint64).at[tgt2].set(
             jnp.where(slot_ok2, s_keys, EMPTY), mode="drop")
 
+        def count_less(table, q_sorted):
+            # #(table < q_i) per (sorted) query — searchsorted-left
+            # semantics without jnp.searchsorted, which lowers to a
+            # sequential per-bit scan on TPU (measured 78 ms per 16k
+            # queries; BASELINE.md round-4).  Stable argsort of the
+            # concatenation with queries FIRST (equal table entries sort
+            # after equal queries), inverse-permute, subtract own rank.
+            nq = q_sorted.shape[0]
+            nt = nq + table.shape[0]
+            o = jnp.argsort(jnp.concatenate([q_sorted, table]),
+                            stable=True)
+            inv = jnp.zeros(nt, jnp.int32).at[o].set(
+                jnp.arange(nt, dtype=jnp.int32))
+            return inv[:nq] - jnp.arange(nq, dtype=jnp.int32)
+
         # ---- re-map old per-key state into the new slot layout
-        old_idx = jnp.searchsorted(new_keys, keys).clip(0, C - 1)
+        # (keys is sorted: it was built as new_keys by the previous step)
+        old_idx = count_less(new_keys, keys).clip(0, C - 1)
         old_found = (new_keys[old_idx] == keys) & (keys != EMPTY)
         o_tgt = jnp.where(old_found, old_idx, C)
         new_counts = jnp.zeros_like(counts).at[o_tgt].add(
@@ -209,7 +225,9 @@ def _update_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int, N: int):
 
         # ---- scatter routed cells (host pre-aggregated per (key, bin):
         # row 0 of the value payload is the per-cell ROW COUNT)
-        row_idx = jnp.searchsorted(new_keys, buf_key).clip(0, C - 1)
+        qo = jnp.argsort(buf_key, stable=True)
+        row_idx = jnp.zeros(R, jnp.int32).at[qo].set(
+            count_less(new_keys, buf_key[qo])).clip(0, C - 1)
         row_found = (new_keys[row_idx] == buf_key) & buf_ok
         si = jnp.where(row_found, row_idx, C)
         bi = jnp.where(row_found, buf_bin, 0).clip(0, B - 1)

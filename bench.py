@@ -683,29 +683,50 @@ def run_kernel_microbench() -> dict:
                 warmup=2, iters=10)
     out["h2d_MBps"] = round(buf.nbytes / dt / 1e6, 1)
 
+    # device->host: both directions matter and the tunnel is asymmetric
+    # (measured ~70 ms FIXED latency per readback vs 1.5 GB/s h2d) —
+    # per-transfer latency (tiny array) and bandwidth (8 MB) separately.
+    # jax Arrays cache their host copy after the first np.asarray, so
+    # each readback goes through a fresh jitted no-op result.
+    bump = jax.jit(lambda x: x + 1)
+    buf_d = jax.block_until_ready(jax.device_put(buf, dev))
+    tiny_d = jax.block_until_ready(jax.device_put(
+        np.zeros(16, np.float32), dev))
+    jax.block_until_ready(bump(tiny_d))
+    out["d2h_lat_ms"] = round(
+        timeit(lambda: np.asarray(bump(tiny_d)), warmup=2, iters=10)
+        * 1e3, 3)
+    jax.block_until_ready(bump(buf_d))
+    dt = timeit(lambda: np.asarray(bump(buf_d)), warmup=2, iters=10)
+    out["d2h_MBps"] = round(buf.nbytes / dt / 1e6, 1)
+
     # update kernel: the q5-shaped hot loop.  C keys x B bins resident
-    # state, n pre-aggregated (key,bin) cells per step, ONE packed
-    # f64[3+k, n] transfer per step — exactly KeyedBinState.update's
-    # device path (keyed_bins.py:61-95).
+    # state, n pre-aggregated (key,bin) cells per step, one i32[2, n]
+    # index + one f64[k+1, n] value transfer per step — exactly
+    # KeyedBinState.update's device path (keyed_bins.py:61-95), with
+    # i32 counts state as the engine holds it.
     kinds = ("count", "sum", "max")
     C, B, n = 8192, 16, 16384
     kern = kb._update_kernel(kinds, C, B, n)
     values = jax.device_put(jnp.stack(
         [jnp.full((C, B), kb._init_value(kb.AggKind(k)), jnp.float64)
          for k in kinds]), dev)
-    counts = jax.device_put(jnp.zeros((C, B), jnp.float64), dev)
+    counts = jax.device_put(jnp.zeros((C, B), jnp.int32), dev)
     rng = np.random.default_rng(1)
-    packed_np = np.empty((3 + len(kinds), n), np.float64)
-    packed_np[0] = rng.integers(0, C, n)
-    packed_np[1] = rng.integers(0, B, n)
-    packed_np[2] = 1.0
-    packed_np[3:] = rng.standard_normal((len(kinds), n))
+    idx_np = np.empty((2, n), np.int32)
+    idx_np[0] = rng.integers(0, C, n)
+    idx_np[1] = rng.integers(0, B, n)
+    packed_np = np.empty((1 + len(kinds), n), np.float64)
+    packed_np[0] = 1.0
+    packed_np[1:] = rng.standard_normal((len(kinds), n))
 
     state = [values, counts]
 
     def step():
-        packed = jax.device_put(packed_np, dev)  # one transfer per step
-        v, c = kern(state[0], state[1], packed)
+        # two transfers per step (indices stay i32, values exact f64)
+        idx = jax.device_put(idx_np, dev)
+        packed = jax.device_put(packed_np, dev)
+        v, c = kern(state[0], state[1], idx, packed)
         state[0], state[1] = v, c
         jax.block_until_ready(c)
 
@@ -731,7 +752,11 @@ def run_kernel_microbench() -> dict:
     out["emit_key_panes_per_sec"] = round(C * k / dt, 1)
 
     # join kernels: sort/probe/expand on device (ops/join.py — the q8
-    # windowed-join hot path), host materialization excluded
+    # windowed-join hot path).  Two numbers: the device kernels alone
+    # (state in, indices computed, one block — what a resident-state
+    # engine pays), and the full join_pairs including result readback
+    # (what the host-materializing engine pays; on the tunnel the ~70 ms
+    # fixed per-readback latency dominates it — see d2h_lat_ms).
     from arroyo_tpu.ops import join as dj
 
     os.environ["ARROYO_DEVICE_JOIN"] = "on"
@@ -739,11 +764,32 @@ def run_kernel_microbench() -> dict:
     jrng = np.random.default_rng(2)
     lk = jrng.integers(0, 4096, nl).astype(np.uint64)
     rk = jrng.integers(0, 4096, nr).astype(np.uint64)
+    nlp, nrp = dj._bucket(nl), dj._bucket(nr)
+    lk_p = np.full(nlp, dj.SENTINEL, np.uint64)
+    lk_p[:nl] = lk
+    rk_p = np.full(nrp, dj.SENTINEL, np.uint64)
+    rk_p[:nr] = rk
+    sk, pk = dj._sort_kernel(nlp), dj._probe_kernel(nlp, nrp, True)
+    _, lks_d = sk(lk_p)
+    _, rks_d = sk(rk_p)
+    _, counts_d, cum_d = pk(lks_d, rks_d, nl, nr)
+    m = dj._bucket(int(np.asarray(counts_d)[:nl].sum()))
+    ek = dj._expand_kernel(nlp, m)
+
+    def jkernels():
+        lo_d, lks = sk(lk_p)
+        ro_d, rks = sk(rk_p)
+        start_d, cnt_d, cm_d = pk(lks, rks, nl, nr)
+        jax.block_until_ready(ek(start_d, cm_d))
+
+    dt = timeit(jkernels, warmup=3, iters=20)
+    out["join_kernels_ms"] = round(dt * 1e3, 3)
+    out["join_kernel_rows_per_sec"] = round((nl + nr) / dt, 1)
 
     def jstep():
         dj.join_pairs(lk, rk)
 
-    dt = timeit(jstep, warmup=3, iters=20)
+    dt = timeit(jstep, warmup=3, iters=10)
     out["join_step_ms"] = round(dt * 1e3, 3)
     out["join_rows_per_sec"] = round((nl + nr) / dt, 1)
 
@@ -774,10 +820,9 @@ def run_kernel_microbench() -> dict:
         from arroyo_tpu.ops import pallas_kernels as pk
 
         if pk.pallas_enabled():
-            slots = packed_np[0].astype(np.int32)
-            bins = packed_np[1].astype(np.int32)
-            weights = np.concatenate(
-                [packed_np[2:3], packed_np[3:]]).astype(np.float32)
+            slots = idx_np[0]
+            bins = idx_np[1]
+            weights = packed_np.astype(np.float32)
 
             def pstep():
                 v, c = pk.update_bin_state(
@@ -909,9 +954,13 @@ def emit_config5(backend: str):
         return {"error": f"{type(e).__name__}: {e}"[:300]}
     c5["backend"] = backend
     print(json.dumps(c5), file=sys.stderr)
+    # backend-qualified artifact path: a tunnel-TPU run must not clobber
+    # the CPU baseline artifact (they differ by ~16x through the tunnel)
+    name = ("BENCH_CONFIG5.json" if backend == "cpu"
+            else f"BENCH_CONFIG5_{backend.upper()}.json")
     try:
         with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                               "BENCH_CONFIG5.json"), "w") as f:
+                               name), "w") as f:
             json.dump(c5, f)
             f.write("\n")
     except OSError:

@@ -484,9 +484,10 @@ def test_pipeline_detail_carries_graph_for_console_overlay(api_env):
                        for e in g["edges"])
             listing = (await c.get("/v1/pipelines")).json()["data"]
             assert all("graph" not in p for p in listing)
-            # console ships the overlay machinery
+            # console ships the overlay + checkpoint-detail machinery
             html = (await c.get("/")).text
-            for needle in ("updateDagOverlay", "ov_bp_", "jobdag"):
+            for needle in ("updateDagOverlay", "ov_bp_", "jobdag",
+                           "ckptDetail", "operator_checkpoint_groups"):
                 assert needle in html, needle
 
     _run(loop, scenario())

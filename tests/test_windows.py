@@ -453,6 +453,40 @@ def test_min_max_beyond_float32_range():
     assert cols["lo"][0] == -1e300 and cols["hi"][0] == 1e300
 
 
+def test_segment_aggregate_host_branch_parity(rng, monkeypatch):
+    """The tunnel-regime numpy-reduceat branch of segment_aggregate
+    (ops/segment._segment_host) must match the device kernel on every
+    channel kind — sums to f64 association tolerance, min/max/count
+    exactly — including null skipping and all-null segments."""
+    from arroyo_tpu.graph.logical import AggKind, AggSpec
+    from arroyo_tpu.ops.segment import segment_aggregate
+
+    n = 4000
+    kh = rng.integers(0, 60, n).astype(np.uint64)
+    ts = rng.integers(0, 10**7, n).astype(np.int64)
+    v = rng.standard_normal(n)
+    v[rng.random(n) < 0.15] = np.nan
+    v[kh == kh.min()] = np.nan  # one all-null segment
+    aggs = (AggSpec(AggKind.SUM, "v", "s"), AggSpec(AggKind.MIN, "v", "mn"),
+            AggSpec(AggKind.MAX, "v", "mx"),
+            AggSpec(AggKind.COUNT, None, "c"),
+            AggSpec(AggKind.AVG, "v", "a"),
+            AggSpec(AggKind.COUNT, "v", "cv"))
+    monkeypatch.setenv("ARROYO_SEGMENT_HOST", "0")
+    dev = segment_aggregate(kh, ts, {"v": v}, aggs)
+    monkeypatch.setenv("ARROYO_SEGMENT_HOST", "1")
+    host = segment_aggregate(kh, ts, {"v": v}, aggs)
+    np.testing.assert_array_equal(dev[0], host[0])
+    for k in ("s", "a"):
+        np.testing.assert_allclose(dev[1][k], host[1][k], rtol=1e-12,
+                                   equal_nan=True, err_msg=k)
+    for k in ("mn", "mx", "c", "cv"):
+        np.testing.assert_array_equal(dev[1][k], host[1][k], err_msg=k)
+    np.testing.assert_array_equal(dev[3], host[3])
+    for k in dev[4]:
+        np.testing.assert_array_equal(dev[4][k], host[4][k], err_msg=k)
+
+
 def test_apply_top_n_host_device_boundary_parity(rng):
     """_apply_top_n routes to the device segment_top_k only at >= 512
     rows: the kept-row set AND the materialized rank column must agree
@@ -511,17 +545,27 @@ def test_device_topk_matches_host_lexsort(rng):
         np.testing.assert_array_equal(got, exp)
 
 
-def test_device_join_pairs_matches_host(rng, monkeypatch):
+@pytest.mark.parametrize("probe", ["search", "merged"])
+def test_device_join_pairs_matches_host(rng, monkeypatch, probe):
     """ops/join.join_pairs: the device sort/probe/expand kernels must
     produce exactly the host fallback's (lo, ro, lidx, ridx, counts) —
     including multi-match fan-out, empty intersections, and sizes
-    crossing the pad buckets."""
+    crossing the pad buckets — on both the searchsorted probe and the
+    TPU merged-rank probe (ops/join._merged_probe)."""
     from arroyo_tpu.ops import join as dj
 
+    monkeypatch.setenv("ARROYO_JOIN_PROBE", probe)
     for nl, nr, span in [(5, 7, 4), (600, 300, 50), (2048, 4096, 130),
                          (1000, 1, 9), (1, 1000, 9)]:
         lk = rng.integers(0, span, nl).astype(np.uint64)
         rk = rng.integers(0, span, nr).astype(np.uint64)
+        if span == 130:
+            # exercise the hi/lo word split: keys above 2^32 whose low
+            # words collide across distinct high words
+            hi = rng.integers(0, 3, nl).astype(np.uint64) << np.uint64(32)
+            lk = lk | hi
+            rk = rk | (rng.integers(0, 3, nr).astype(np.uint64)
+                       << np.uint64(32))
         monkeypatch.setenv("ARROYO_DEVICE_JOIN", "off")
         h = dj.join_pairs(lk, rk)
         monkeypatch.setenv("ARROYO_DEVICE_JOIN", "on")

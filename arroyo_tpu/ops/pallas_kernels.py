@@ -52,11 +52,17 @@ TILE_C = 512  # key slots per grid tile
 
 
 def pallas_enabled() -> bool:
-    """Pallas path on by default on TPU; opt-in elsewhere (ARROYO_PALLAS=1)."""
+    """Pallas update path is opt-in (ARROYO_PALLAS=1) on every backend:
+    on real TPU v5 hardware the XLA scatter update measured 1.17 ms per
+    16k-cell step against the engine's 8192x16 resident state while this
+    kernel measured 52-76 ms at the identical shape across three
+    sessions (BENCH_TPU_KERNELS_r04.json) — the one-hot MXU scatter
+    does not pay off at bin-ring widths, so defaulting it on would
+    silently cost the q5 hot loop ~44x."""
     env = os.environ.get("ARROYO_PALLAS")
     if env is not None:
         return env not in ("0", "false", "no") and HAVE_PALLAS
-    return HAVE_PALLAS and jax.default_backend() == "tpu"
+    return False
 
 
 def _interpret() -> bool:

@@ -815,8 +815,12 @@ def run_kernel_microbench() -> dict:
         out["ring_error"] = f"{type(e).__name__}: {e}"[:300]
 
     # pallas path: the engine's fused custom-kernel state update
-    # (pallas_kernels.update_bin_state — x32 scatter + f64 apply)
+    # (pallas_kernels.update_bin_state — x32 scatter + f64 apply).
+    # Engine default is OFF per this very comparison (pallas_enabled);
+    # the microbench force-enables it so the artifact keeps recording
+    # both paths side by side.
     try:
+        os.environ["ARROYO_PALLAS"] = "1"
         from arroyo_tpu.ops import pallas_kernels as pk
 
         if pk.pallas_enabled():

@@ -17,6 +17,7 @@ XLA constraints shape the design:
 from __future__ import annotations
 
 import functools
+from contextlib import nullcontext
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -195,10 +196,19 @@ def eval_host_expr(fn: Callable[[Dict[str, np.ndarray]], Any], batch: Batch
                    ) -> Batch:
     """Host-side (non-jitted) record expression over raw numpy columns —
     the UDF escape hatch (the reference runs UDFs in wasmtime,
-    operators/mod.rs:347-494; ours run as plain Python over the batch)."""
-    cols = {"__timestamp": batch.timestamp, **batch.columns}
-    out = fn(cols)
-    assert isinstance(out, dict)
-    ts = np.asarray(out.pop("__timestamp", batch.timestamp))
-    return Batch(ts, {k: np.asarray(v) for k, v in out.items()},
-                 batch.key_hash, batch.key_cols)
+    operators/mod.rs:347-494; ours run as plain Python over the batch).
+
+    When expressions are pinned to host (the tunnel regime), any jnp
+    call the function makes internally must ALSO stay off the
+    accelerator: an uncommitted jnp op lands on the default backend, and
+    converting its result back is a ~70 ms tunnel readback per column
+    (measured: 33 s of a 47 s config5 run before this guard)."""
+    dev = _expr_device()
+    ctx = jax.default_device(dev) if dev is not None else nullcontext()
+    with ctx:
+        cols = {"__timestamp": batch.timestamp, **batch.columns}
+        out = fn(cols)
+        assert isinstance(out, dict)
+        ts = np.asarray(out.pop("__timestamp", batch.timestamp))
+        return Batch(ts, {k: np.asarray(v) for k, v in out.items()},
+                     batch.key_hash, batch.key_cols)

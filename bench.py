@@ -379,8 +379,16 @@ def device_share(name: str, sql_template: str) -> dict:
     finally:
         os.environ.pop("ARROYO_TIMING", None)
     dev = perf.counter_ns("device_ns") / 1e9
-    return {"device_time_share": round(dev / dt, 3),
-            "host_time_share": round(1 - dev / dt, 3)}
+    # device_ns sums per-operator timed_device spans; concurrent
+    # operators (q8's two parallel aggregates) can overlap, so the share
+    # may exceed 1 — report the raw ratio and mark overlap instead of
+    # fabricating a negative host share
+    share = round(dev / dt, 3)
+    out = {"device_time_share": share,
+           "host_time_share": round(max(1 - dev / dt, 0.0), 3)}
+    if share > 1:
+        out["device_time_overlapped"] = True
+    return out
 
 
 LAT_SQL = """
@@ -819,6 +827,7 @@ def run_kernel_microbench() -> dict:
     # Engine default is OFF per this very comparison (pallas_enabled);
     # the microbench force-enables it so the artifact keeps recording
     # both paths side by side.
+    prev_pallas = os.environ.get("ARROYO_PALLAS")
     try:
         os.environ["ARROYO_PALLAS"] = "1"
         from arroyo_tpu.ops import pallas_kernels as pk
@@ -841,6 +850,11 @@ def run_kernel_microbench() -> dict:
             out["pallas"] = "disabled"
     except Exception as e:  # pallas failure must not kill the microbench
         out["pallas_error"] = f"{type(e).__name__}: {e}"[:300]
+    finally:
+        if prev_pallas is None:
+            os.environ.pop("ARROYO_PALLAS", None)
+        else:
+            os.environ["ARROYO_PALLAS"] = prev_pallas
     return out
 
 

@@ -58,14 +58,19 @@ def _init_value(kind: AggKind) -> float:
 
 
 @functools.lru_cache(maxsize=256)
-def _update_kernel(kinds: Tuple[str, ...], C: int, B: int, n: int):
+def _update_kernel(kinds: Tuple[str, ...], C: int, B: int, n: int,
+                   dup: Tuple[int, ...] = ()):
+    dup_set = frozenset(dup)
+
     @jax.jit
     def run(values, counts, idx, packed):
         # TWO packed inputs (two host->device transfers — a tunneled TPU
         # pays per-transfer latency, so indices don't ride as f64):
         # idx i32[2, n] rows are [slots, bins]; packed f64[k+1, n] rows
         # are [rowcount, channel values...] per pre-aggregated (key, bin)
-        # cell.  rowcount 0 marks padding.
+        # cell.  rowcount 0 marks padding.  Channels in ``dup`` (COUNT(*))
+        # accumulate exactly the rowcount, so their input never rides the
+        # transfer — the kernel reconstructs it from packed[0].
         slots = idx[0]
         bins = idx[1]
         rowcnt = packed[0]
@@ -76,9 +81,14 @@ def _update_kernel(kinds: Tuple[str, ...], C: int, B: int, n: int):
         counts = counts.at[s.clip(0, C - 1), b].add(
             jnp.where(valid & (s < C), rowcnt, 0.0).astype(counts.dtype))
         outs = []
+        r = 0
         for i, kind in enumerate(kinds):
             v = values[i]
-            x = vals[i]
+            if i in dup_set:
+                x = rowcnt
+            else:
+                x = vals[r]
+                r += 1
             ok = valid & (s < C)
             si = s.clip(0, C - 1)
             if kind in ("sum", "avg", "count"):
@@ -95,35 +105,93 @@ def _update_kernel(kinds: Tuple[str, ...], C: int, B: int, n: int):
     return run
 
 
+def _pane_reduce(kind: str, g, bin_ok):
+    """Reduce one channel's gathered [..., k, W] window bins to [..., k]
+    pane aggregates (shared by the dense and compacted emit kernels so the
+    two paths cannot diverge)."""
+    if kind in ("sum", "avg", "count"):
+        return jnp.sum(jnp.where(bin_ok[None], g, 0.0), axis=-1)
+    if kind == "min":
+        return jnp.min(jnp.where(bin_ok[None], g, POS_INF), axis=-1)
+    if kind == "max":
+        return jnp.max(jnp.where(bin_ok[None], g, NEG_INF), axis=-1)
+    raise ValueError(kind)
+
+
 @functools.lru_cache(maxsize=256)
-def _emit_kernel(kinds: Tuple[str, ...], C: int, B: int, W: int, k: int):
+def _emit_kernel(kinds: Tuple[str, ...], C: int, B: int, W: int, k: int,
+                 keep: Optional[Tuple[int, ...]] = None,
+                 cnt16: bool = False):
     """Compute per-key aggregates for k panes.  ``ring[k, W]`` (int32) and
     ``bin_ok[k, W]`` are computed on host from the absolute (int64) bin
     indices — keeping 64-bit bin arithmetic out of jit, where x64-disabled
-    JAX would truncate it."""
+    JAX would truncate it.  ``keep`` selects the channels that ride the
+    device->host transfer (COUNT(*) channels are dropped — their pane
+    output is exactly the counts plane, which transfers as integers
+    anyway).  ``cnt16`` downcasts the count grid to u16 for the transfer —
+    the caller proves pane sums fit (host-tracked bound), halving the
+    dominant readback."""
+    if keep is None:
+        keep = tuple(range(len(kinds)))
 
     @jax.jit
     def run(values, counts, ring, bin_ok):
         # counts per key per pane: gather [C, k, W] then sum
         cnt_g = counts[:, ring]  # [C, k, W]
         cnt = jnp.sum(jnp.where(bin_ok[None], cnt_g, 0), axis=-1)  # [C, k]
+        if cnt16:
+            cnt = cnt.astype(jnp.uint16)
 
         outs = []
-        for i, kind in enumerate(kinds):
-            v = values[i]  # [C, B]
-            g = v[:, ring]  # [C, k, W]
-            if kind in ("sum", "avg", "count"):
-                r = jnp.sum(jnp.where(bin_ok[None], g, 0.0), axis=-1)
-                # (avg division happens on host from the validity-count
-                # channel — NOT from cnt, which counts null rows too)
-            elif kind == "min":
-                r = jnp.min(jnp.where(bin_ok[None], g, POS_INF), axis=-1)
-            elif kind == "max":
-                r = jnp.max(jnp.where(bin_ok[None], g, NEG_INF), axis=-1)
-            else:
-                raise ValueError(kind)
-            outs.append(r)
+        for i in keep:
+            # (avg division happens on host from the validity-count
+            # channel — NOT from cnt, which counts null rows too)
+            outs.append(_pane_reduce(kinds[i], values[i][:, ring], bin_ok))
         return (jnp.stack(outs) if outs else jnp.zeros((0, C, k))), cnt
+
+    return run
+
+
+@functools.lru_cache(maxsize=256)
+def _emit_count_kernel(C: int, B: int, W: int, k: int):
+    """Phase 1 of compacted emission: pane counts stay device-resident;
+    only the live-cell total crosses (4 bytes instead of the [C, k]
+    grid — the scalar sizes phase 2's static-shape compaction)."""
+
+    @jax.jit
+    def run(counts, ring, bin_ok):
+        cnt_g = counts[:, ring]  # [C, k, W]
+        cnt = jnp.sum(jnp.where(bin_ok[None], cnt_g, 0), axis=-1)  # [C, k]
+        return cnt, jnp.sum(cnt > 0)
+
+    return run
+
+
+@functools.lru_cache(maxsize=256)
+def _emit_compact_kernel(kinds: Tuple[str, ...], C: int, B: int, W: int,
+                         k: int, keep: Tuple[int, ...], npad: int):
+    """Phase 2: gather ONLY live (key, pane) cells.  The dense pane grid
+    is C*k cells of which a fire typically touches a few percent (keys
+    active inside one window span vs every key ever seen) — compacting on
+    device shrinks the tunnel readback by that ratio and replaces the
+    host-side np.nonzero scan."""
+
+    @jax.jit
+    def run(values, cnt, ring, bin_ok):
+        flat = cnt.reshape(-1)  # [C * k]
+        idx = jnp.nonzero(flat > 0, size=npad, fill_value=C * k)[0]
+        ok = idx < C * k
+        safe = jnp.where(ok, idx, 0)
+        key_idx = (safe // k).astype(jnp.int32)
+        pane_idx = (safe % k).astype(jnp.int32)
+        cnt_c = jnp.where(ok, flat[safe], 0)
+        outs = []
+        for i in keep:
+            r = _pane_reduce(kinds[i], values[i][:, ring], bin_ok)
+            outs.append(r.reshape(-1)[safe])
+        idx2 = jnp.stack([key_idx, pane_idx])
+        return idx2, cnt_c, (jnp.stack(outs) if outs else
+                             jnp.zeros((0, npad), jnp.float64))
 
     return run
 
@@ -170,6 +238,32 @@ def _bucket(n: int, floor: int = 8) -> int:
     while b < n:
         b <<= 1
     return b
+
+
+def restored_count_state(raw_counts: np.ndarray, promote_at: int
+                         ) -> Tuple[int, np.dtype]:
+    """(total restored rows, counts-plane dtype) for a snapshot restore —
+    the single policy both KeyedBinState and MeshKeyedBinState apply: the
+    plane dtype must cover pane SUMS (bounded by total mass), so restored
+    mass at or beyond the promotion threshold restores straight into i64
+    (fire_panes may run before any update(), where promotion normally
+    triggers)."""
+    total = int(raw_counts.sum())
+    return total, (np.int64 if total >= promote_at else np.int32)
+
+
+def _prefetch_host(*arrays) -> None:
+    """Start device->host copies for every array before any blocking
+    ``np.asarray``: on a tunneled TPU each readback pays a fixed ~70 ms
+    round-trip, so N sequential materializations cost N round-trips while
+    prefetched ones overlap into ~one."""
+    for a in arrays:
+        start = getattr(a, "copy_to_host_async", None)
+        if start is not None:
+            try:
+                start()
+            except Exception:  # pragma: no cover - non-committed arrays
+                pass
 
 
 # -- shared channel + directory semantics (single-device AND mesh state) -----
@@ -316,6 +410,10 @@ def _append_new_keys(state, new_keys: np.ndarray, ensure_capacity) -> None:
 class KeyedBinState:
     """Sharded keyed bin-ring aggregation state for one subtask."""
 
+    # rows after which the i32 counts plane could wrap (class attr so
+    # tests can exercise the promotion without 2^31 rows)
+    _i32_promote = 2**31 - 1
+
     def __init__(self, aggs: Tuple[AggSpec, ...], slide_micros: int,
                  width_micros: int, capacity: int = 0):
         if capacity <= 0:
@@ -331,6 +429,17 @@ class KeyedBinState:
         self.kinds = tuple(a.kind.value for a in aggs)
         self._ch_kinds, self._valid_ch = build_channels(aggs)
         self._valid_of = {v: k for k, v in self._valid_ch.items()}
+        # COUNT(*) channels accumulate exactly the per-cell row count that
+        # the i32 counts plane already holds — they never ride a tunnel
+        # transfer: updates reconstruct them on device from the rowcount
+        # row, emission reads them from the counts output (state still
+        # carries them so canonical snapshots stay topology-portable)
+        self._dup_ch = tuple(i for i, a in enumerate(aggs)
+                             if a.kind == AggKind.COUNT and a.column is None)
+        dup_set = frozenset(self._dup_ch)
+        self._xfer_ch = tuple(j for j in range(len(self._ch_kinds))
+                              if j not in dup_set)
+        self._xfer_pos = {j: r for r, j in enumerate(self._xfer_ch)}
         self.slide = slide_micros
         self.W = width_micros // slide_micros  # bins per window
         # ring must hold all open bins: W for the widest window plus headroom
@@ -357,6 +466,21 @@ class KeyedBinState:
         self.min_bin: Optional[int] = None  # oldest retained absolute bin
         self.max_bin: Optional[int] = None
         self.last_fired_pane: Optional[int] = None
+        # rows ever accumulated into the counts plane: any cell or pane
+        # sum is bounded by it, so while it stays below 2^31 the i32 plane
+        # (and the COUNT(*) outputs read from it) cannot wrap — once it
+        # could, update() promotes the plane to i64 (one recompile)
+        self.total_rows = 0
+        # observed live-cell fraction of the last fire's pane grid (None
+        # until a fire happens); drives the compact-emission prediction
+        self._fire_density: Optional[float] = None
+        # per-ABSOLUTE-bin upper bound on any (key, bin) cell count (each
+        # touched bin accrues the batch's largest pre-aggregated cell;
+        # evicted bins drop out).  The max sliding-window sum over W bins
+        # bounds any pane sum, proving when the emit count grid can ride
+        # the tunnel as u16 instead of i32 — per-bin (vs one monotone
+        # scalar) keeps the proof live on long-running streams
+        self._bin_bound: Dict[int, int] = {}
 
     # -- key directory -----------------------------------------------------
 
@@ -380,7 +504,8 @@ class KeyedBinState:
                        for kind in self._ch_kinds]) if self._ch_kinds else
             jnp.zeros((0, pad, self.B), jnp.float64)], axis=1)
         self.counts = jnp.concatenate(
-            [self.counts, jnp.zeros((pad, self.B), jnp.int32)], axis=0)
+            [self.counts, jnp.zeros((pad, self.B), self.counts.dtype)],
+            axis=0)
         self.slot_to_key = np.concatenate(
             [self.slot_to_key, np.zeros(pad, dtype=np.uint64)])
         self.C = newC
@@ -417,30 +542,48 @@ class KeyedBinState:
             bins_mod = ((timestamps // self.slide) % self.B).astype(np.int32)
         self.min_bin = lo_new
         self.max_bin = hi_new
+        self.total_rows += int(n_live)
+        if (self.total_rows >= self._i32_promote
+                and self.counts.dtype == jnp.int32):
+            # the next accumulation could wrap an i32 cell or pane sum:
+            # promote BEFORE it lands (kernels retrace on the new dtype)
+            self.counts = self.counts.astype(jnp.int64)
 
         slots = self._lookup_or_insert(key_hash)
 
         # two-phase, local half: reduce rows per (slot, bin) on the host
         # before any device work (TumblingLocalAggregator analog) — under
         # hot-key skew this collapses the batch by orders of magnitude
-        vals = np.empty((len(self._ch_kinds), n), dtype=ACC_DTYPE)
-        for j in range(len(self._ch_kinds)):
-            vals[j] = self._channel_input(j, agg_inputs, n)
+        # COUNT(*) channels are reconstructed from the rowcount on device;
+        # only the remaining channels are materialized, pre-aggregated, and
+        # shipped (for a bare COUNT(*) query the f64 pack shrinks to the
+        # rowcount row alone — half the h2d bytes per batch)
+        xfer = self._xfer_ch
+        xfer_kinds = tuple(self._ch_kinds[j] for j in xfer)
+        vals = np.empty((len(xfer), n), dtype=ACC_DTYPE)
+        for r, j in enumerate(xfer):
+            vals[r] = self._channel_input(j, agg_inputs, n)
         from ..native import HAVE_NATIVE, agg_cells
 
         if HAVE_NATIVE:
             # one O(n) native hash pass (liveness filter folded in)
             slots_c, bins_c, rowcnt, vals_c = agg_cells(
                 slots, bins_mod, None if live.all() else live,
-                self.B, vals, self._ch_kinds)
+                self.B, vals, xfer_kinds)
         else:
             if not live.all():
                 idx = live.nonzero()[0]
                 slots, bins_mod, vals = \
                     slots[idx], bins_mod[idx], vals[:, idx]
             slots_c, bins_c, rowcnt, vals_c = preaggregate(
-                slots, bins_mod, self._ch_kinds, vals)
+                slots, bins_mod, xfer_kinds, vals)
         m = len(slots_c)
+        if m:
+            # coarse but sound: every bin this batch touched could have
+            # grown by at most the batch's largest cell
+            bmax = int(rowcnt.max())
+            for b in range(lo, hi + 1):
+                self._bin_bound[b] = self._bin_bound.get(b, 0) + bmax
 
         # additive aggregates route through the Pallas MXU scatter (one-hot
         # matmul) instead of XLA's serial scatter; min/max stay on XLA
@@ -452,13 +595,14 @@ class KeyedBinState:
         idx = np.zeros((2, npad), dtype=np.int32)
         idx[0, :m] = slots_c
         idx[1, :m] = bins_c
-        packed = np.zeros((len(self._ch_kinds) + 1, npad), dtype=ACC_DTYPE)
+        packed = np.zeros((len(self._xfer_ch) + 1, npad), dtype=ACC_DTYPE)
         packed[0, :m] = rowcnt
         packed[1:, :m] = vals_c
 
         from ..obs.perf import timed_device
 
-        kernel = _update_kernel(self._ch_kinds, self.C, self.B, npad)
+        kernel = _update_kernel(self._ch_kinds, self.C, self.B, npad,
+                                self._dup_ch)
         self.values, self.counts = timed_device(
             kernel, self.values, self.counts, jnp.asarray(idx),
             jnp.asarray(packed))
@@ -475,6 +619,8 @@ class KeyedBinState:
             return False
         if not all(k in ("sum", "avg", "count") for k in self._ch_kinds):
             return False
+        if self.counts.dtype != jnp.int32:
+            return False  # promoted i64 plane: the Pallas kernel is f32-pair
         # packed width P = 2 channels (hi/lo) x (channels + count) x B lanes;
         # the kernel holds [CHUNK, P] + [TILE_C, P] f32 blocks in VMEM, so
         # wide rings (long window / short slide) must fall back to XLA
@@ -487,7 +633,17 @@ class KeyedBinState:
                                      update_bin_state)
 
         # pre-aggregated cells: counts channel carries the per-cell row
-        # count (the kernel sums weight channels, so this is exact)
+        # count (the kernel sums weight channels, so this is exact).
+        # vals_c holds transferred channels only — COUNT(*) rows are the
+        # rowcount itself
+        if self._dup_ch:
+            full = np.empty((len(self._ch_kinds), len(rowcnt)),
+                            dtype=ACC_DTYPE)
+            for r, j in enumerate(self._xfer_ch):
+                full[j] = vals_c[r]
+            for j in self._dup_ch:
+                full[j] = rowcnt
+            vals_c = full
         weights = np.concatenate([rowcnt[None], vals_c], axis=0)
         s, b, w = pad_batch(slots_c.astype(np.int32), bins_c, weights)
         c_act = active_capacity(self.next_slot, self.C)
@@ -505,7 +661,7 @@ class KeyedBinState:
                             dtype=ACC_DTYPE)
         for j, kind in enumerate(self._ch_kinds):
             new_vals[j] = _init_value(AggKind(kind))
-        new_cnts = np.zeros((self.C, newB), dtype=np.int32)
+        new_cnts = np.zeros((self.C, newB), dtype=cnts.dtype)
         if self.min_bin is not None and self.max_bin is not None:
             for ab in range(self.min_bin, self.max_bin + 1):
                 new_vals[:, :, ab % newB] = vals[:, :, ab % self.B]
@@ -533,6 +689,77 @@ class KeyedBinState:
         w_min = int(os.environ.get("ARROYO_RING_MIN_W", 64))
         return self.W >= w_min and len(jax.devices()) > 1
 
+    def _pane_bound(self, first_pane: int, last_pane: int) -> int:
+        """Largest provable pane sum over the firing range: max sliding
+        W-sum of the per-bin cell bounds.  Sound by construction — every
+        pane's true count is at most the sum of its bins' bounds."""
+        W = self.W
+        span = last_pane - first_pane + 1
+        if span + W > 100_000:  # degenerate range: don't scan, stay i32
+            return 1 << 40
+        lo_b = first_pane - W + 1
+        n = last_pane - lo_b + 1
+        arr = np.fromiter((self._bin_bound.get(b, 0)
+                           for b in range(lo_b, last_pane + 1)),
+                          dtype=np.int64, count=n)
+        c = np.concatenate([[0], np.cumsum(arr)])
+        sums = c[W:] - c[:-W]  # sums[i] covers bins [first_pane+i-W+1, ..]
+        return int(sums.max()) if len(sums) else 0
+
+    def _use_compact_emit(self, c_slice: int, k: int) -> bool:
+        """Two-phase compacted emission: worth one extra (4-byte) scalar
+        round-trip only when fires are SPARSE (keys active inside one
+        window span vs every key ever seen).  ``auto`` predicts from the
+        last observed fire density — nexmark q5 measures density 1.0
+        (every auction bids in every window), where compaction is
+        strictly worse; long-window/churning-key shapes measure a few
+        percent, where it wins by that ratio."""
+        import os
+
+        mode = os.environ.get("ARROYO_EMIT_COMPACT", "auto")
+        if mode == "off":
+            return False
+        if mode == "on":
+            return True
+        if self._fire_density is None:
+            return False  # no evidence yet: dense is the safe default
+        itemsize = self.counts.dtype.itemsize
+        row_bytes = 8 + itemsize + 8 * len(self._xfer_ch)  # idx2+cnt+chans
+        compact_bytes = self._fire_density * self.next_slot * k * row_bytes
+        dense_bytes = (8 * len(self._xfer_ch) + itemsize) * c_slice * k
+        # margin stands in for the extra scalar round-trip + gather pass
+        margin = int(os.environ.get("ARROYO_EMIT_COMPACT_MARGIN",
+                                    256 * 1024))
+        return compact_bytes + margin < dense_bytes
+
+    def _emit_compact(self, ring: np.ndarray, bin_ok: np.ndarray, kpad: int
+                      ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                 np.ndarray]:
+        """(key_idx, pane_idx, counts, channel values [n_xfer, m]) for the
+        live cells only, compacted on device (row-major order — identical
+        to the dense path's np.nonzero order)."""
+        from ..obs.perf import timed_device
+
+        ring_j = jnp.asarray(ring)
+        ok_j = jnp.asarray(bin_ok)
+        ck = _emit_count_kernel(self.C, self.B, self.W, kpad)
+        cnt_dev, nnz_dev = timed_device(ck, self.counts, ring_j, ok_j)
+        nnz = int(nnz_dev)  # the only blocking readback: one scalar
+        if nnz == 0:
+            return (np.zeros(0, np.int64), np.zeros(0, np.int64),
+                    np.zeros(0, np.int64),
+                    np.zeros((len(self._xfer_ch), 0)))
+        npad = _bucket(nnz, floor=256)
+        gk = _emit_compact_kernel(self._ch_kinds, self.C, self.B, self.W,
+                                  kpad, self._xfer_ch, npad)
+        idx2_d, cnt_d, ch_d = timed_device(gk, self.values, cnt_dev,
+                                           ring_j, ok_j)
+        _prefetch_host(idx2_d, cnt_d, ch_d)
+        idx2 = np.asarray(idx2_d)
+        return (idx2[0, :nnz].astype(np.int64),
+                idx2[1, :nnz].astype(np.int64),
+                np.asarray(cnt_d)[:nnz], np.asarray(ch_d)[:, :nnz])
+
     def _ring_shards(self) -> int:
         nk = 1
         while nk * 2 <= len(jax.devices()):
@@ -558,15 +785,25 @@ class KeyedBinState:
         lin = _linearize_kernel(self._ch_kinds, self.C, self.B, L)
         g, cg = timed_device(lin, self.values, self.counts,
                              jnp.asarray(ring_idx), jnp.asarray(ok))
-        outs = []
-        for i, kind in enumerate(self._ch_kinds):
-            fn, sharding = _ring_step_2d(kind, nk, self.C, L // nk,
-                                         self.W)
-            dev = jax.device_put(g[i], sharding)
-            outs.append(np.asarray(timed_device(fn, dev))[:, -k:])
+        # dispatch every channel sweep, then materialize: the transfers
+        # overlap instead of each paying its own tunnel round-trip.
+        # Channel set matches _emit_kernel's ``keep`` (COUNT(*) channels
+        # come from the count sweep, which rides as i32)
+        devs = []
+        for i in self._xfer_ch:
+            fn, sharding = _ring_step_2d(self._ch_kinds[i], nk, self.C,
+                                         L // nk, self.W)
+            out = timed_device(fn, jax.device_put(g[i], sharding))
+            devs.append(out[:, -k:])  # slice on device: transfer k panes
         fn, sharding = _ring_step_2d("count", nk, self.C, L // nk, self.W)
-        cdev = jax.device_put(cg.astype(jnp.float64), sharding)
-        cnts = np.asarray(timed_device(fn, cdev))[:, -k:].astype(np.int32)
+        cdev = timed_device(fn, jax.device_put(cg.astype(jnp.float64),
+                                               sharding))[:, -k:]
+        _prefetch_host(*devs, cdev)
+        outs = [np.asarray(d) for d in devs]
+        # match the plane dtype: a promoted i64 plane can hold pane sums
+        # beyond i32 (the sweep itself is exact in f64 to 2^53)
+        cnt_np = (np.int64 if self.counts.dtype == jnp.int64 else np.int32)
+        cnts = np.asarray(cdev).astype(cnt_np)
         return (np.stack(outs) if outs else
                 np.zeros((0, self.C, k))), cnts
 
@@ -609,14 +846,6 @@ class KeyedBinState:
 
         from ..obs.perf import timed_device
 
-        if self._use_ring():
-            outs, cnts = self._emit_ring(pane_ends, k)
-        else:
-            kernel = _emit_kernel(self._ch_kinds, self.C, self.B, self.W,
-                                  kpad)
-            outs, cnts = timed_device(kernel, self.values, self.counts,
-                                      jnp.asarray(ring),
-                                      jnp.asarray(bin_ok))
         # transfer only the occupied key rows, not all C slots.  2048-row
         # granularity: finer than pow2 buckets (pow2 wastes up to 50% of a
         # remote-tunnel transfer) while bounding the compile-variant count;
@@ -625,8 +854,30 @@ class KeyedBinState:
             c_slice = min(_bucket(max(self.next_slot, 1), floor=256), self.C)
         else:
             c_slice = min(-(-self.next_slot // 2048) * 2048, self.C)
-        outs = np.asarray(outs[:, :c_slice])  # [n_aggs, c_slice, kpad]
-        cnts = np.asarray(cnts[:c_slice])  # [c_slice, kpad]
+        compact = None
+        use_ring = self._use_ring()
+        if use_ring:
+            outs, cnts = self._emit_ring(pane_ends, k)
+        elif self._use_compact_emit(c_slice, k):
+            compact = self._emit_compact(ring, bin_ok, kpad)
+        else:
+            # pane sums provably fit u16 -> halve the dominant transfer
+            cnt16 = (self.counts.dtype == jnp.int32
+                     and self._pane_bound(first_pane, last_pane) < 65_000)
+            kernel = _emit_kernel(self._ch_kinds, self.C, self.B, self.W,
+                                  kpad, self._xfer_ch, cnt16)
+            outs, cnts = timed_device(kernel, self.values, self.counts,
+                                      jnp.asarray(ring),
+                                      jnp.asarray(bin_ok))
+        if compact is None and not use_ring:
+            # device-slice to occupied keys AND real panes (k, not the
+            # pow2-padded kpad — a 5-pane fire in an 8-pane kernel grid
+            # would ship 37% dead bytes), then overlap the round-trips
+            outs_d = outs[:, :c_slice, :k]  # [n_xfer, c_slice, k]
+            cnts_d = cnts[:c_slice, :k]  # [c_slice, k]
+            _prefetch_host(outs_d, cnts_d)
+            outs = np.asarray(outs_d)
+            cnts = np.asarray(cnts_d)
 
         self.last_fired_pane = last_pane
         # evict bins that no future pane needs: abs bins <= last_pane - W + 1
@@ -643,29 +894,45 @@ class KeyedBinState:
                 self.values, self.counts = ek(self.values, self.counts,
                                               jnp.asarray(ring), jnp.asarray(ev))
             self.min_bin = new_min
+            # evicted bins leave the u16 proof, keeping it live on
+            # long-running streams (the bound would otherwise only grow)
+            self._bin_bound = {b: v for b, v in self._bin_bound.items()
+                               if b >= new_min}
 
-        # flatten (key, pane) pairs with data on host
-        C_used = self.next_slot
-        cnts_u = cnts[:C_used, :k]
-        key_idx, pane_idx = np.nonzero(cnts_u)
+        # flatten (key, pane) pairs with data
+        if compact is not None:
+            key_idx, pane_idx, cnt_sel, ch_sel = compact
+        else:
+            C_used = self.next_slot
+            cnts_u = cnts[:C_used, :k]
+            key_idx, pane_idx = np.nonzero(cnts_u)
+            cnt_sel = cnts_u[key_idx, pane_idx]
+            ch_sel = outs[:, :C_used, :k][:, key_idx, pane_idx]
+        self._fire_density = len(key_idx) / max(self.next_slot * k, 1)
         if len(key_idx) == 0:
             return None
         keys = self.slot_to_key[key_idx]
         window_end = (pane_ends[pane_idx] + 1) * self.slide
         out_cols: Dict[str, np.ndarray] = {}
+        dup_set = frozenset(self._dup_ch)
         for i, a in enumerate(self.aggs):
-            col = outs[i, :C_used, :k][key_idx, pane_idx]
+            if i in dup_set:
+                # COUNT(*): the counts plane IS the aggregate (integer
+                # counts, no f64 channel ever crossed the tunnel)
+                out_cols[a.output] = cnt_sel.astype(np.int64)
+                continue
+            col = ch_sel[self._xfer_pos[i]]
             if a.kind == AggKind.COUNT:
                 col = col.astype(np.int64)
             elif i in self._valid_ch:
                 # nulls-skipping semantics from the validity-count channel:
                 # AVG divides by non-null rows; an all-null pane is NULL
-                nv = outs[self._valid_ch[i], :C_used, :k][key_idx, pane_idx]
+                nv = ch_sel[self._xfer_pos[self._valid_ch[i]]]
                 if a.kind == AggKind.AVG:
                     col = col / np.maximum(nv, 1)
                 col = np.where(nv > 0, col, np.nan)
             out_cols[a.output] = col
-        return keys, out_cols, window_end, cnts_u[key_idx, pane_idx]
+        return keys, out_cols, window_end, cnt_sel
 
     # -- checkpoint ---------------------------------------------------------
     #
@@ -678,6 +945,7 @@ class KeyedBinState:
 
     def snapshot(self) -> Dict[str, np.ndarray]:
         n = self.next_slot
+        _prefetch_host(self.values, self.counts)
         values = np.asarray(jax.device_get(self.values))
         counts = np.asarray(jax.device_get(self.counts))
         if self.min_bin is not None and self.max_bin is not None:
@@ -723,13 +991,26 @@ class KeyedBinState:
 
         bin_keys = arrays["bin_keys"].astype(np.uint64)
         bin_vals = np.asarray(arrays["bin_vals"], dtype=ACC_DTYPE)
-        bin_counts = np.asarray(arrays["bin_counts"], dtype=np.int32)
+        raw_counts = np.asarray(arrays["bin_counts"])
+        self.total_rows, cnt_dtype = restored_count_state(
+            raw_counts, self._i32_promote)
+        bin_counts = raw_counts.astype(cnt_dtype)
+        # the u16-downcast proof must survive restore: seed each restored
+        # bin's bound from its largest restored cell so cnt16 never
+        # "proves" a vacuous empty bound over non-empty state (review r4:
+        # pane counts wrapped modulo 65536 after any checkpoint restore)
+        self._bin_bound = {}
+        if raw_counts.size and lo >= 0:
+            col_max = raw_counts.max(axis=0)
+            for j, bnd in enumerate(col_max.tolist()):
+                if bnd > 0:
+                    self._bin_bound[lo + j] = int(bnd)
         span = bin_vals.shape[-1]
         self.B = _bucket(max(span, 2 * self.W + 4), floor=8)
         values = np.zeros((len(self._ch_kinds), self.C, self.B), ACC_DTYPE)
         for j, k in enumerate(self._ch_kinds):
             values[j] = _init_value(AggKind(k))
-        counts = np.zeros((self.C, self.B), np.int32)
+        counts = np.zeros((self.C, self.B), cnt_dtype)
         if len(bin_keys) and span and lo >= 0:
             # bin rows land at their DIRECTORY slot (restores from a mesh
             # snapshot may order rows differently than this host's slots)

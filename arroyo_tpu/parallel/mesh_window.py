@@ -46,6 +46,7 @@ from ..graph.logical import AggKind, AggSpec
 from ..ops.keyed_bins import (
     NEG_INF,
     POS_INF,
+    KeyedBinState,
     _bucket,
     _init_value,
     build_channels,
@@ -382,6 +383,9 @@ class MeshKeyedBinState:
         self.max_bin: Optional[int] = None
         self.last_fired_pane: Optional[int] = None
         self.late_rows = 0
+        # mirror of KeyedBinState.total_rows: bounds any cell/pane count
+        # sum, driving i32 -> i64 plane promotion before a wrap is possible
+        self.total_rows = 0
 
         self._alloc_device()
 
@@ -444,7 +448,7 @@ class MeshKeyedBinState:
         keys2[:, :self.C] = keys  # EMPTY pads sort AFTER real keys
         bins2 = _init_filled(self._ch_kinds, (self.nk, C2, self.B))
         bins2[:, :, :self.C] = bins
-        counts2 = np.zeros((self.nk, C2, self.B), np.int32)
+        counts2 = np.zeros((self.nk, C2, self.B), counts.dtype)
         counts2[:, :self.C] = counts
         self.C = C2
         self.d_keys = jax.device_put(
@@ -471,7 +475,7 @@ class MeshKeyedBinState:
         CT = bins.shape[1]
         bins2 = _init_filled(self._ch_kinds, (CT, B2))
         bins2[:, :, :self.B] = bins
-        counts2 = np.zeros((CT, B2), np.int32)
+        counts2 = np.zeros((CT, B2), counts.dtype)
         counts2[:, :self.B] = counts
         self.B = B2
         self.d_bins = jax.device_put(
@@ -495,7 +499,7 @@ class MeshKeyedBinState:
         CT = bins.shape[1]
         bins2 = _init_filled(self._ch_kinds, (CT, B2))
         bins2[:, :, off:off + self.B] = bins
-        counts2 = np.zeros((CT, B2), np.int32)
+        counts2 = np.zeros((CT, B2), counts.dtype)
         counts2[:, off:off + self.B] = counts
         self.B = B2
         self.base_bin = new_base
@@ -529,6 +533,14 @@ class MeshKeyedBinState:
         self.late_rows += int((~live).sum())
         if not live.any():
             return
+        self.total_rows += int(live.sum())
+        if self.total_rows >= KeyedBinState._i32_promote:
+            import jax.numpy as _jnp
+
+            if self.d_counts.dtype == _jnp.int32:
+                # promote BEFORE the crossing batch lands (same policy as
+                # KeyedBinState.update; kernels retrace on the new dtype)
+                self.d_counts = self.d_counts.astype(_jnp.int64)
         lo = int(abs_bin[live].min())
         hi = int(abs_bin[live].max())
         self.min_bin = lo if self.min_bin is None else min(self.min_bin, lo)
@@ -622,11 +634,19 @@ class MeshKeyedBinState:
         outs, cnts, mask = timed_device(
             fire, self.d_keys, self.d_bins, self.d_counts,
             jnp.asarray([first_rel, wm_rel], jnp.int32))
-        # transfer only the fired pane range, not the whole [.., B+W-1]
+        # transfer only the fired pane range, not the whole [.., B+W-1];
+        # prefetch all four buffers so the readbacks overlap into ~one
+        # round-trip instead of four
+        from ..ops.keyed_bins import _prefetch_host
+
         k = wm_rel - first_rel + 1
-        outs = np.asarray(jax.device_get(outs[:, :, first_rel:first_rel + k]))
-        cnts = np.asarray(jax.device_get(cnts[:, first_rel:first_rel + k]))
-        mask = np.asarray(jax.device_get(mask[:, first_rel:first_rel + k]))
+        outs_d = outs[:, :, first_rel:first_rel + k]
+        cnts_d = cnts[:, first_rel:first_rel + k]
+        mask_d = mask[:, first_rel:first_rel + k]
+        _prefetch_host(outs_d, cnts_d, mask_d, self.d_keys)
+        outs = np.asarray(jax.device_get(outs_d))
+        cnts = np.asarray(jax.device_get(cnts_d))
+        mask = np.asarray(jax.device_get(mask_d))
         keys_h = np.asarray(jax.device_get(self.d_keys))
 
         self.last_fired_pane = last_pane
@@ -731,14 +751,19 @@ class MeshKeyedBinState:
 
         keys = arrays["bin_keys"].astype(np.uint64)
         bins = np.asarray(arrays["bin_vals"], dtype=np.float64)
-        counts = np.asarray(arrays["bin_counts"], dtype=np.int32)
+        raw_counts = np.asarray(arrays["bin_counts"])
+        from ..ops.keyed_bins import restored_count_state
+
+        self.total_rows, cnt_dtype = restored_count_state(
+            raw_counts, KeyedBinState._i32_promote)
+        counts = raw_counts.astype(cnt_dtype)
         span = bins.shape[-1]
         self.B = _bucket(max(span, 2 * self.W + 4), floor=8)
         if span < self.B:  # pad linear columns out to the ring width
             bins_p = _init_filled(self._ch_kinds, bins.shape[1:-1] + (self.B,))
             bins_p[..., :span] = bins
             bins = bins_p
-            counts_p = np.zeros(counts.shape[:-1] + (self.B,), np.int32)
+            counts_p = np.zeros(counts.shape[:-1] + (self.B,), cnt_dtype)
             counts_p[..., :span] = counts
             counts = counts_p
         # admission control counts come from the HOST directory (a strict
@@ -752,7 +777,7 @@ class MeshKeyedBinState:
             self.C *= 2
         keys2 = np.full((self.nk, self.C), EMPTY, np.uint64)
         bins2 = _init_filled(self._ch_kinds, (self.nk, self.C, self.B))
-        counts2 = np.zeros((self.nk, self.C, self.B), np.int32)
+        counts2 = np.zeros((self.nk, self.C, self.B), counts.dtype)
         for s in range(self.nk):
             sel = shard == s
             ks = keys[sel]

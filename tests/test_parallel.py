@@ -493,3 +493,39 @@ def test_sql_hop_long_window_through_ring(rng, monkeypatch):
         assert key not in got, f"pane emitted twice: {key}"
         got[key] = int(out.columns["num"][j])
     assert got == dict(exp)
+
+
+def test_mesh_i32_counts_plane_promotes_to_i64(rng, monkeypatch):
+    """The mesh state mirrors KeyedBinState's i32 -> i64 counts-plane
+    promotion: once total ingested rows could wrap an i32 cell or pane
+    sum, d_counts promotes (and the promotion survives a checkpoint
+    round-trip) — otherwise COUNT wraps negative and _fire_step's
+    cnts > 0 mask silently drops rows (code-review r4 finding)."""
+    import jax
+    import jax.numpy as jnp
+
+    from arroyo_tpu.ops.keyed_bins import KeyedBinState
+
+    if len(jax.devices()) < 4:
+        pytest.skip("not enough devices")
+    monkeypatch.setattr(KeyedBinState, "_i32_promote", 500)
+    st = MeshKeyedBinState(AGGS, SEC, 2 * SEC, capacity=64, n_shards=4)
+    n = 300
+    total = 0
+    for _ in range(3):
+        ts = np.sort(rng.integers(0, 3 * SEC, n)).astype(np.int64)
+        keys = rng.integers(0, 10, n).astype(np.int64)
+        vals = rng.integers(1, 50, n).astype(np.int64)
+        st.update(hash_columns([keys]), ts, {"v": vals})
+        total += n
+    assert st.d_counts.dtype == jnp.int64
+    # round-trip: a promoted snapshot restores promoted (no i32 recast)
+    st2 = MeshKeyedBinState(AGGS, SEC, 2 * SEC, capacity=64, n_shards=4)
+    st2.restore(st.snapshot())
+    assert st2.total_rows == total
+    assert st2.d_counts.dtype == jnp.int64
+    r = st2.fire_panes(10 ** 9, final=True)
+    assert r is not None
+    _, cols, _, cnts = r
+    assert int(cols["cnt"].sum()) == 2 * total  # W=2 panes, nothing lost
+    assert (cnts > 0).all()

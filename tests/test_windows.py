@@ -588,3 +588,143 @@ def test_device_join_sentinel_collision_falls_back(monkeypatch):
     pairs = {(int(lk[lo[i]]), int(rk[ro[j]]))
              for i, j in zip(lidx.tolist(), ridx.tolist())}
     assert pairs == {(int(dj.SENTINEL), int(dj.SENTINEL)), (5, 5)}
+
+
+def test_i32_counts_plane_promotes_to_i64(monkeypatch):
+    """COUNT(*) reads the i32 counts plane directly (no f64 channel rides
+    the transfer), so once total ingested rows could wrap an i32 cell or
+    pane sum the plane must promote to i64 — otherwise a hot key wraps to
+    a negative count (code-review r4 finding)."""
+    import jax.numpy as jnp
+
+    from arroyo_tpu.ops.keyed_bins import KeyedBinState
+
+    monkeypatch.setattr(KeyedBinState, "_i32_promote", 600)
+    aggs = (AggSpec(kind=AggKind.COUNT, column=None, output="n"),)
+    st = KeyedBinState(aggs, slide_micros=1000, width_micros=1000,
+                       capacity=16)
+    rng = np.random.default_rng(3)
+    total = 0
+    for _ in range(5):
+        n = 200
+        keys = rng.integers(0, 3, n).astype(np.uint64)
+        ts = np.zeros(n, dtype=np.int64)  # one bin, one hot pane
+        st.update(keys, ts, {})
+        total += n
+    assert st.counts.dtype == jnp.int64  # crossed the promotion threshold
+    # total_rows survives a checkpoint round-trip (snapshot before the
+    # final fire: firing evicts the bins, legitimately zeroing the mass)
+    st2 = KeyedBinState(aggs, 1000, 1000, capacity=16)
+    st2.restore(st.snapshot())
+    assert st2.total_rows == total
+    keys_o, cols, wend, cnts = st.fire_panes(10**9, final=True)
+    assert int(cols["n"].sum()) == total  # every row counted, no wrap
+    # ring emission follows the promoted dtype instead of recasting i32
+    monkeypatch.setenv("ARROYO_RING", "on")
+    st3 = KeyedBinState(aggs, 1000, 1000, capacity=16)
+    st3.restore(st2.snapshot())
+    assert st3.counts.dtype == jnp.int64
+    k3, c3, w3, n3 = st3.fire_panes(10**9, final=True)
+    assert n3.dtype == np.int64
+    assert int(c3["n"].sum()) == total
+
+
+def test_count_star_skips_f64_transfer():
+    """A bare COUNT(*) query ships no f64 emit channels at all — the
+    aggregate IS the counts plane (tunnel-transfer optimization); mixed
+    aggs keep their channels and stay correct alongside it."""
+    from arroyo_tpu.ops.keyed_bins import KeyedBinState
+
+    aggs = (AggSpec(kind=AggKind.COUNT, column=None, output="n"),
+            AggSpec(kind=AggKind.SUM, column="v", output="s"))
+    st = KeyedBinState(aggs, slide_micros=1000, width_micros=2000,
+                       capacity=16)
+    assert st._dup_ch == (0,)
+    # channels that ride the transfer: SUM + its validity, not COUNT(*)
+    assert st._ch_kinds[st._xfer_ch[0]] == "sum"
+    rng = np.random.default_rng(4)
+    n = 500
+    keys = rng.integers(0, 5, n).astype(np.uint64)
+    ts = rng.integers(0, 5000, n).astype(np.int64)
+    v = rng.normal(size=n)
+    st.update(keys, ts, {"v": v})
+    keys_o, cols, wend, cnts = st.fire_panes(10**9, final=True)
+    assert int(cols["n"].sum()) == 2 * n  # each row in W=2 panes
+    np.testing.assert_array_equal(cols["n"], cnts)  # COUNT(*) == row count
+    oracle = {}
+    for k, t, vv in zip(keys, ts, v):
+        b = t // 1000
+        for pane in range(b, b + 2):
+            key = (int(k), int((pane + 1) * 1000))
+            c, s = oracle.get(key, (0, 0.0))
+            oracle[key] = (c + 1, s + vv)
+    for i in range(len(keys_o)):
+        c, s = oracle[(int(keys_o[i]), int(wend[i]))]
+        assert cols["n"][i] == c
+        assert np.isclose(cols["s"][i], s, rtol=1e-12)
+
+
+def test_compact_emission_matches_dense(monkeypatch):
+    """Device-compacted emission (two-phase nnz + gather) returns exactly
+    the dense path's rows, in the same row-major order, for every agg
+    kind incl. null-skipping AVG."""
+    from arroyo_tpu.ops.keyed_bins import KeyedBinState
+
+    aggs = (AggSpec(kind=AggKind.COUNT, column=None, output="n"),
+            AggSpec(kind=AggKind.SUM, column="v", output="s"),
+            AggSpec(kind=AggKind.AVG, column="w", output="a"),
+            AggSpec(kind=AggKind.MIN, column="v", output="mn"))
+    rng = np.random.default_rng(11)
+    n = 4000
+    keys = rng.integers(0, 50, n).astype(np.uint64)
+    ts = rng.integers(0, 9000, n).astype(np.int64)
+    v = rng.normal(size=n)
+    w = rng.normal(size=n)
+    w[rng.random(n) < 0.4] = np.nan
+
+    def run(mode):
+        monkeypatch.setenv("ARROYO_EMIT_COMPACT", mode)
+        st = KeyedBinState(aggs, slide_micros=1000, width_micros=4000,
+                           capacity=64)
+        out = []
+        for i in range(0, n, 800):
+            sl = slice(i, i + 800)
+            st.update(keys[sl], ts[sl], {"v": v[sl], "w": w[sl]})
+            r = st.fire_panes(int(ts[sl].max()))  # mid-stream fires too
+            if r is not None:
+                out.append(r)
+        r = st.fire_panes(10 ** 9, final=True)
+        if r is not None:
+            out.append(r)
+        return out
+
+    dense = run("off")
+    comp = run("on")
+    assert len(dense) == len(comp)
+    for (k1, c1, w1, n1), (k2, c2, w2, n2) in zip(dense, comp):
+        np.testing.assert_array_equal(k1, k2)
+        np.testing.assert_array_equal(w1, w2)
+        np.testing.assert_array_equal(n1, n2)
+        for name in ("n", "s", "a", "mn"):
+            np.testing.assert_allclose(c1[name].astype(float),
+                                       c2[name].astype(float),
+                                       rtol=1e-12, atol=1e-15)
+
+
+def test_cnt16_bound_survives_restore():
+    """The u16 emit-downcast proof (W * _cell_bound < 65000) must not be
+    vacuously true after restore: 70k rows in one (key, bin) cell wrapped
+    COUNT(*) to 70000 % 65536 = 4464 through a checkpoint round-trip
+    (code-review r4 finding, live repro)."""
+    from arroyo_tpu.ops.keyed_bins import KeyedBinState
+
+    aggs = (AggSpec(kind=AggKind.COUNT, column=None, output="n"),)
+    st = KeyedBinState(aggs, slide_micros=1000, width_micros=1000,
+                       capacity=16)
+    n = 70_000
+    st.update(np.full(n, 5, np.uint64), np.zeros(n, np.int64), {})
+    st2 = KeyedBinState(aggs, 1000, 1000, capacity=16)
+    st2.restore(st.snapshot())
+    assert max(st2._bin_bound.values()) >= n  # proof sees restored mass
+    keys_o, cols, wend, cnts = st2.fire_panes(10 ** 9, final=True)
+    assert int(cols["n"][0]) == n  # not n % 65536

@@ -1098,6 +1098,11 @@ class NonWindowAggOperator(Operator):
         self.expiration = expiration_micros
         self.aggs = aggs
         self.flush_key = flush_key
+        # highest flush bound already released: a record re-created for a
+        # window at or below it is a LATE refinement (its panes arrived
+        # after the watermark released the window) — emitting it again
+        # would duplicate the window's final row downstream
+        self._released_wm: Optional[int] = None
         self.projection = (CompiledExpr(projection.name, projection.fn)
                            if projection else None)
 
@@ -1107,6 +1112,12 @@ class NonWindowAggOperator(Operator):
 
     async def on_start(self, ctx: Context) -> None:
         self.table = ctx.state.get_keyed_state("u")
+        # re-arm the duplicate-flush guard across restore: every window at
+        # or below the checkpoint watermark was already released before
+        # the crash (flush runs on each watermark ahead of the barrier),
+        # so restored records at or below it are late re-creations
+        if ctx.last_watermark is not None:
+            self._released_wm = ctx.last_watermark
 
     async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
         assert batch.key_hash is not None
@@ -1189,7 +1200,17 @@ class NonWindowAggOperator(Operator):
             # above 2^53), where a float round-trip can round DOWN and
             # flush a window before a lagging subtask's pane arrives
             if bound is None or int(bound) <= watermark:
+                if (bound is not None and self._released_wm is not None
+                        and int(bound) <= self._released_wm):
+                    # late re-creation of an already-released window:
+                    # its final row went downstream at an earlier
+                    # watermark — a second (partial) row would duplicate
+                    # it.  Late panes drop, matching lateness semantics.
+                    self.table.remove(k)
+                    continue
                 ready.append((t, k, rec))
+        self._released_wm = (watermark if self._released_wm is None
+                             else max(self._released_wm, watermark))
         if not ready:
             return
         ts = np.array([t for t, _, _ in ready], dtype=np.int64)

@@ -297,6 +297,11 @@ class LogicalOperator:
         tok: Dict[str, Any] = {"kind": self.kind.value, "name": self.name}
         if self.expr is not None:
             tok["expr"] = self.expr.hash_token()
+            if self.expr.sql:
+                # a structural sql token fully describes the computation;
+                # the generated display name (agg_input_<n>) must not
+                # break equality between duplicated subplans
+                del tok["name"]
         if self.key_cols:
             tok["key"] = list(self.key_cols)
         if self.spec is not None:
@@ -412,6 +417,73 @@ class Program:
                 return True
         return False
 
+    # -- common-subplan elimination ----------------------------------------
+
+    def eliminate_common_subplans(self) -> int:
+        """Merge operators that compute the same thing over the same
+        inputs (equal structural hash token + equal predecessor set with
+        equal edge types), redirecting the duplicate's out-edges to the
+        kept node — downstream fan-out is one Collector edge group per
+        consumer, so both consumers see identical batches/watermarks.
+
+        SQL with textually repeated subqueries (nexmark q5's
+        AuctionBids/CountBids, WITH-clause reuse across the reference
+        ledger) otherwise runs the whole duplicated chain twice — twice
+        the device updates AND twice the pane-emission readbacks, which
+        on a tunneled TPU is the dominant cost.  The reference planner
+        leans on DataFusion, which does not dedupe across the join
+        inputs either — this pass is a genuine win over it.
+
+        Sources (consumption/offset state) and sinks (side effects) never
+        merge.  A merge that would create a parallel edge (e.g. both
+        sides of a self-join collapsing onto one node, which a DiGraph
+        cannot represent and the engine's per-(src, dst) queues do not
+        support) is skipped.  Returns the number of nodes removed."""
+        import os
+
+        if os.environ.get("ARROYO_CSE", "1") in ("0", "off", "false"):
+            return 0
+        removed = 0
+        changed = True
+        while changed:
+            changed = False
+            by_sig: Dict[tuple, str] = {}
+            for op_id in self.topo_order():
+                node = self.node(op_id)
+                preds = tuple(sorted(
+                    (s, d["edge"].typ.value, d["edge"].key_schema)
+                    for s, _, d in self.graph.in_edges(op_id, data=True)))
+                sig = (node.operator.hash_token(), node.parallelism,
+                       node.max_parallelism, preds)
+                if node.operator.kind in (OpKind.CONNECTOR_SOURCE,
+                                          OpKind.CONNECTOR_SINK):
+                    continue
+                keep = by_sig.get(sig)
+                if keep is None:
+                    by_sig[sig] = op_id
+                    continue
+                # expression tokens without a structural sql form are just
+                # display names ("map"): equality proves nothing about the
+                # wrapped fn, so only merge when the fns are literally the
+                # same object (Stream-API callers need not discipline
+                # their names for the pass to stay sound)
+                expr = node.operator.expr
+                if expr is not None and not expr.sql:
+                    kept_expr = self.node(keep).operator.expr
+                    if kept_expr is None or kept_expr.fn is not expr.fn:
+                        continue
+                # candidate duplicate: every out-edge must be movable
+                outs = list(self.graph.out_edges(op_id, data=True))
+                if any(self.graph.has_edge(keep, dst) for _, dst, _ in outs):
+                    continue
+                for _, dst, data in outs:
+                    self.graph.add_edge(keep, dst, **data)
+                self.graph.remove_node(op_id)
+                removed += 1
+                changed = True
+                break  # graph changed: recompute signatures
+        return removed
+
     # -- hashing (lib.rs:1140-1154) ---------------------------------------
 
     def get_hash(self) -> str:
@@ -495,8 +567,9 @@ class Stream:
 
     # -- element-wise ------------------------------------------------------
 
-    def map(self, fn: Callable, name: str = "map") -> "Stream":
-        expr = ColumnExpr(name, fn, ExprReturnType.RECORD)
+    def map(self, fn: Callable, name: str = "map",
+            sql: str = "") -> "Stream":
+        expr = ColumnExpr(name, fn, ExprReturnType.RECORD, sql=sql)
         return self._chain(LogicalOperator(OpKind.EXPRESSION, name, expr=expr))
 
     def filter(self, fn: Callable, name: str = "filter") -> "Stream":
@@ -514,8 +587,9 @@ class Stream:
     def flatten(self, name: str = "flatten") -> "Stream":
         return self._chain(LogicalOperator(OpKind.FLATTEN, name))
 
-    def udf(self, fn: Callable, name: str = "udf") -> "Stream":
-        expr = ColumnExpr(name, fn, ExprReturnType.RECORD)
+    def udf(self, fn: Callable, name: str = "udf",
+            sql: str = "") -> "Stream":
+        expr = ColumnExpr(name, fn, ExprReturnType.RECORD, sql=sql)
         return self._chain(LogicalOperator(OpKind.UDF, name, expr=expr))
 
     # -- time --------------------------------------------------------------

@@ -330,6 +330,8 @@ class Planner:
         for op_cfg, used in self._pushdowns:
             if used:
                 op_cfg["projection"] = sorted(used)
+        # merge textually duplicated subplans (q5's double hop aggregate)
+        prog.eliminate_common_subplans()
         return prog
 
     def _plan_insert(self, ins: Insert, prog: Program) -> None:
@@ -914,8 +916,20 @@ class Planner:
         pre_fn = _wrap_record(pre_compiled, [])
         pre_host = any(c.needs_host for _, c in pre_compiled)
         pname = f"agg_input_{self._next_id()}"
-        stream = (planned.stream.udf(pre_fn, name=pname) if pre_host
-                  else planned.stream.map(pre_fn, name=pname))
+        # STRUCTURAL hash token (AST reprs after resolving column refs to
+        # PHYSICAL columns, so table aliases like q5's B1/B2 don't break
+        # equality): textually duplicated subqueries (q5's
+        # AuctionBids/CountBids pattern) get equal tokens, which is what
+        # lets the common-subplan pass merge the whole duplicated
+        # aggregate chain into one operator
+        pre_tok = ("aggin:"
+                   + repr([(n, self._canon_token(e, schema))
+                           for n, e in group_exprs])
+                   + "|" + repr([self._canon_token(fc, schema)
+                                 for fc in collector.aggs]))
+        stream = (planned.stream.udf(pre_fn, name=pname, sql=pre_tok)
+                  if pre_host
+                  else planned.stream.map(pre_fn, name=pname, sql=pre_tok))
 
         # key + window operator
         if key_cols:
@@ -1027,6 +1041,32 @@ class Planner:
                      if isinstance(e, ColumnRef) and e.qualifier is None
                      and e.name in agg_outputs} if fusable else None,
             updating=post_updating)
+
+    @staticmethod
+    def _canon_token(e: Expr, schema) -> str:
+        """Structural token for an expression with column refs resolved to
+        PHYSICAL columns (record=False probe: no projection side effects).
+        Equal tokens <=> same computation over the same input schema, so
+        duplicated subqueries differing only in table aliases compare
+        equal for common-subplan elimination.  Unresolvable refs keep
+        their qualifier — a collision-averse fallback (a missed merge is
+        only a missed optimization; a wrong merge would be a bug)."""
+        def walk(x: Expr) -> Expr:
+            if isinstance(x, ColumnRef):
+                try:
+                    tag, phys = schema.resolve(x, record=False)
+                except Exception:
+                    return ColumnRef(x.name.lower(), x.qualifier
+                                     and x.qualifier.lower())
+                if tag == "col":
+                    return ColumnRef(phys)
+                if tag == "window":
+                    return ColumnRef("__window__")
+                return ColumnRef(x.name.lower(), x.qualifier
+                                 and x.qualifier.lower())
+            return map_children(x, walk)
+
+        return repr(walk(e))
 
     @staticmethod
     def _mask_indicator(c: Compiled) -> Compiled:

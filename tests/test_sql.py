@@ -1225,3 +1225,63 @@ def test_explain_rejects_mixed_statements():
     events_table(p)
     with pytest.raises(Exception, match="only executable"):
         run_sql("SELECT k FROM events; EXPLAIN SELECT k FROM events", p)
+
+
+def test_common_subplan_elimination_q5_shape():
+    """Textually duplicated subqueries (nexmark q5's AuctionBids vs
+    CountBids — same hop aggregate behind different table aliases) merge
+    into ONE aggregate chain; output is identical with the pass off.
+    Reference comparison: DataFusion does not dedupe across join inputs,
+    so the reference runs the chain twice (double state, double fires)."""
+    import os
+
+    sql = """
+    CREATE TABLE nexmark WITH (
+      connector = 'nexmark', event_rate = '1000000',
+      num_events = '60000', rate_limited = 'false', batch_size = '8192',
+      base_time_micros = '1700000000000000'
+    );
+    WITH bids as (SELECT bid.auction as auction, bid.datetime as datetime
+        FROM nexmark where bid is not null)
+    SELECT AuctionBids.auction as auction, AuctionBids.num as num
+    FROM (
+      SELECT B1.auction, HOP(INTERVAL '2' SECOND, INTERVAL '10' SECOND)
+             as window, count(*) AS num
+      FROM bids B1 GROUP BY 1, 2
+    ) AS AuctionBids
+    JOIN (
+      SELECT max(num) AS maxn, window
+      FROM (
+        SELECT count(*) AS num,
+               HOP(INTERVAL '2' SECOND, INTERVAL '10' SECOND) AS window
+        FROM bids B2 GROUP BY B2.auction, 2
+      ) AS CountBids
+      GROUP BY 2
+    ) AS MaxBids
+    ON AuctionBids.num = MaxBids.maxn
+       and AuctionBids.window = MaxBids.window
+    """
+    prog = plan_sql(sql)
+    aggs = [n for n in prog.graph.nodes if "sliding_window" in n]
+    assert len(aggs) == 1, f"duplicated hop aggregate not merged: {aggs}"
+
+    def run():
+        clear_sink("results")
+        LocalRunner(plan_sql(sql)).run()
+        rows = []
+        for b in sink_output("results"):
+            for i in range(len(next(iter(b.columns.values())))):
+                rows.append(tuple(int(b.columns[c][i])
+                                  for c in sorted(b.columns)))
+        return sorted(rows)
+
+    merged = run()
+    os.environ["ARROYO_CSE"] = "0"
+    try:
+        dup_prog = plan_sql(sql)
+        assert len([n for n in dup_prog.graph.nodes
+                    if "sliding_window" in n]) == 2
+        unmerged = run()
+    finally:
+        os.environ.pop("ARROYO_CSE", None)
+    assert merged == unmerged and len(merged) > 0

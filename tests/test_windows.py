@@ -728,3 +728,68 @@ def test_cnt16_bound_survives_restore():
     assert max(st2._bin_bound.values()) >= n  # proof sees restored mass
     keys_o, cols, wend, cnts = st2.fire_panes(10 ** 9, final=True)
     assert int(cols["n"][0]) == n  # not n % 65536
+
+
+def test_group_by_window_flush_is_idempotent():
+    """A record re-created for an already-released window (late panes —
+    e.g. a racing upstream) must NOT emit a second final row: q5's join
+    would match the stale partial max and duplicate output rows
+    (observed once as a 6th q5 row on a cold-compile run)."""
+    from arroyo_tpu.engine.operators_window import NonWindowAggOperator
+    from arroyo_tpu.state.store import StateStore
+    from arroyo_tpu.types import TaskInfo
+
+    class Ctx:
+        def __init__(self, store, last_watermark=None):
+            self.state = store
+            self.last_watermark = last_watermark
+            self.out = []
+
+        async def collect(self, batch):
+            self.out.append(batch)
+
+        async def broadcast(self, msg):
+            pass
+
+    op = NonWindowAggOperator(
+        "max_per_window", 86_400_000_000,
+        (AggSpec(AggKind.MAX, "num", "maxn"),), flush_key="window_end")
+    store = StateStore.new_in_memory(
+        TaskInfo("job", "op", "max_per_window", 0, 1))
+    ctx = Ctx(store)
+
+    async def drive():
+        await op.on_start(ctx)
+        wend = 10_000_000
+        b1 = Batch(np.array([wend - 1, wend - 1], dtype=np.int64),
+                   {"window_end": np.array([wend, wend], dtype=np.int64),
+                    "num": np.array([5, 7], dtype=np.int64)},
+                   np.array([1, 1], dtype=np.uint64), ("window_end",))
+        await op.process_batch(b1, ctx)
+        await op.handle_watermark(wend, ctx)  # releases the window
+        assert len(ctx.out) == 1
+        assert int(ctx.out[0].columns["maxn"][0]) == 7
+        # late re-creation: more rows for the SAME window after release
+        b2 = Batch(np.array([wend - 1], dtype=np.int64),
+                   {"window_end": np.array([wend], dtype=np.int64),
+                    "num": np.array([7], dtype=np.int64)},
+                   np.array([1], dtype=np.uint64), ("window_end",))
+        await op.process_batch(b2, ctx)
+        await op.handle_watermark(wend + 2_000_000, ctx)
+        assert len(ctx.out) == 1, "late re-creation must not re-emit"
+
+        # the guard survives a checkpoint restore: a fresh operator whose
+        # context restores at watermark `wend` must also drop the late
+        # re-creation instead of emitting a duplicate final row
+        op2 = NonWindowAggOperator(
+            "max_per_window", 86_400_000_000,
+            (AggSpec(AggKind.MAX, "num", "maxn"),), flush_key="window_end")
+        ctx2 = Ctx(StateStore.new_in_memory(
+            TaskInfo("job", "op", "max_per_window", 0, 1)),
+            last_watermark=wend)
+        await op2.on_start(ctx2)
+        await op2.process_batch(b2, ctx2)
+        await op2.handle_watermark(wend + 2_000_000, ctx2)
+        assert len(ctx2.out) == 0, "restored guard must drop late windows"
+
+    asyncio.run(drive())

@@ -419,6 +419,17 @@ class Program:
 
     # -- common-subplan elimination ----------------------------------------
 
+    # sources whose output is a pure deterministic function of their
+    # config AND whose config is faithfully comparable by repr: two
+    # scans of the same definition are interchangeable with one scan
+    # fanned out, so the dedup pass may merge them.  Anything with
+    # consumption state (kafka/kinesis offsets, consumer groups,
+    # sse/webhook/polling network reads) must NOT be here.  'memory' is
+    # deliberately absent: its config embeds raw numpy batches whose
+    # reprs TRUNCATE past 1000 elements, so equal reprs would not prove
+    # equal data.
+    _REPLAYABLE_SOURCES = frozenset({"nexmark", "impulse"})
+
     def eliminate_common_subplans(self) -> int:
         """Merge operators that compute the same thing over the same
         inputs (equal structural hash token + equal predecessor set with
@@ -434,8 +445,12 @@ class Program:
         leans on DataFusion, which does not dedupe across the join
         inputs either — this pass is a genuine win over it.
 
-        Sources (consumption/offset state) and sinks (side effects) never
-        merge.  A merge that would create a parallel edge (e.g. both
+        Sinks (side effects) never merge.  Sources merge only when the
+        connector is in ``_REPLAYABLE_SOURCES`` (deterministic output,
+        repr-comparable config — e.g. q8's two nexmark scans become one
+        generation pass with the union of their projections); anything
+        with consumption state (kafka offsets, consumer groups) never
+        does.  A merge that would create a parallel edge (e.g. both
         sides of a self-join collapsing onto one node, which a DiGraph
         cannot represent and the engine's per-(src, dst) queues do not
         support) is skipped.  Returns the number of nodes removed."""
@@ -453,11 +468,27 @@ class Program:
                 preds = tuple(sorted(
                     (s, d["edge"].typ.value, d["edge"].key_schema)
                     for s, _, d in self.graph.in_edges(op_id, data=True)))
-                sig = (node.operator.hash_token(), node.parallelism,
-                       node.max_parallelism, preds)
-                if node.operator.kind in (OpKind.CONNECTOR_SOURCE,
-                                          OpKind.CONNECTOR_SINK):
-                    continue
+                if node.operator.kind == OpKind.CONNECTOR_SINK:
+                    continue  # side effects: two sinks are two sinks
+                if node.operator.kind == OpKind.CONNECTOR_SOURCE:
+                    # two scans of the same DETERMINISTIC table (q8 reads
+                    # nexmark twice: persons side + auctions side) merge
+                    # into one generation pass; projections union.
+                    # Consumption-stateful connectors (kafka offsets,
+                    # consumer groups) stay excluded — merging would
+                    # change their delivery semantics.
+                    spec = node.operator.spec
+                    if getattr(spec, "connector", None) \
+                            not in self._REPLAYABLE_SOURCES:
+                        continue
+                    cfg = {k: v for k, v in spec.config.items()
+                           if k != "projection"}
+                    sig = ("src", spec.connector,
+                           repr(sorted(cfg.items(), key=lambda kv: kv[0])),
+                           node.parallelism, node.max_parallelism)
+                else:
+                    sig = (node.operator.hash_token(), node.parallelism,
+                           node.max_parallelism, preds)
                 keep = by_sig.get(sig)
                 if keep is None:
                     by_sig[sig] = op_id
@@ -476,6 +507,14 @@ class Program:
                 outs = list(self.graph.out_edges(op_id, data=True))
                 if any(self.graph.has_edge(keep, dst) for _, dst, _ in outs):
                     continue
+                if node.operator.kind == OpKind.CONNECTOR_SOURCE:
+                    kcfg = self.node(keep).operator.spec.config
+                    pa = kcfg.get("projection")
+                    pb = node.operator.spec.config.get("projection")
+                    if pa and pb:  # both pruned: keep the union
+                        kcfg["projection"] = sorted(set(pa) | set(pb))
+                    else:  # either side needs every column
+                        kcfg.pop("projection", None)
                 for _, dst, data in outs:
                     self.graph.add_edge(keep, dst, **data)
                 self.graph.remove_node(op_id)

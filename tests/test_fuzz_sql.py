@@ -918,3 +918,78 @@ def test_fuzz_session_max_size_clamp(seed):
     assert got == exp, (
         f"seed {seed}: missing {sorted((exp - got).keys())[:4]}, "
         f"extra {sorted((got - exp).keys())[:4]}")
+
+
+@pytest.mark.parametrize("seed", [61, 62, 63, 64, 65, 66])
+def test_fuzz_common_subplan_elimination(seed):
+    """Random q5-SHAPED self-join-on-window-aggregate queries: the
+    duplicated inner aggregate must merge into one chain (the pass's
+    whole point) and the merged plan's rows must equal the unmerged
+    plan's rows exactly — across agg kinds, window shapes, parallelism,
+    and batch splits."""
+    import os
+
+    from arroyo_tpu.sql.planner import Planner
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1000, 5000))
+    hop = bool(rng.integers(0, 2))
+    width_s = int(rng.choice([2, 3, 4]))
+    # slide must divide width (bin-path invariant, as in the reference)
+    slide_s = (int(rng.choice([d for d in (1, 2) if width_s % d == 0]))
+               if hop else width_s)
+    nkeys = int(rng.integers(3, 30))
+    par = int(rng.integers(1, 4))
+    inner = rng.choice(["count(*)", "sum(v)", "max(v)"])
+    outer = rng.choice(["max", "min"])
+    nbatch = int(rng.integers(1, 6))
+    ts = np.sort(rng.integers(0, 9 * SEC, n)).astype(np.int64)
+    k = rng.integers(0, nkeys, n).astype(np.int64)
+    v = rng.integers(1, 50, n).astype(np.int64)
+    bounds = np.linspace(0, n, nbatch + 1).astype(int)
+    win = (f"HOP(INTERVAL '{slide_s}' SECOND, INTERVAL '{width_s}' SECOND)"
+           if hop else f"TUMBLE(INTERVAL '{width_s}' SECOND)")
+    sql = f"""
+        WITH ev AS (SELECT k AS k, v AS v FROM events)
+        SELECT A.k AS k, A.num AS num
+        FROM (
+          SELECT T1.k, {win} AS window, {inner} AS num
+          FROM ev T1 GROUP BY 1, 2
+        ) AS A
+        JOIN (
+          SELECT {outer}(num) AS mx, window FROM (
+            SELECT {inner} AS num, {win} AS window
+            FROM ev T2 GROUP BY T2.k, 2
+          ) AS B0 GROUP BY 2
+        ) AS B
+        ON A.num = B.mx AND A.window = B.window
+    """
+
+    def run():
+        provider = SchemaProvider()
+        provider.add_memory_table("events", {"k": "i", "v": "i"}, [
+            Batch(ts[a:b], {"k": k[a:b], "v": v[a:b]})
+            for a, b in zip(bounds[:-1], bounds[1:]) if b > a])
+        clear_sink("results")
+        prog = Planner(provider).plan(sql, query_parallelism=par)
+        n_aggs = sum(1 for nd in prog.graph.nodes
+                     if "window_aggregator" in nd
+                     and "non_window" not in nd)
+        LocalRunner(prog).run()
+        rows = []
+        for b in sink_output("results"):
+            for i in range(len(next(iter(b.columns.values())))):
+                rows.append((int(b.columns["k"][i]),
+                             int(b.columns["num"][i])))
+        return n_aggs, sorted(rows)
+
+    merged_aggs, merged = run()
+    assert merged_aggs == 1, (seed, "inner aggregate did not merge")
+    os.environ["ARROYO_CSE"] = "0"
+    try:
+        dup_aggs, unmerged = run()
+    finally:
+        os.environ.pop("ARROYO_CSE", None)
+    assert dup_aggs == 2, seed
+    assert merged == unmerged, (seed, len(merged), len(unmerged))
+    assert len(merged) > 0, seed

@@ -119,7 +119,14 @@ FROM nexmark WHERE bid is not null GROUP BY 1, 2</textarea>
       <th>type</th><th></th></tr></thead><tbody id="ctrows"></tbody></table>
   </section>
   <section style="grid-column: 1 / 3">
-    <h2>Job detail <span id="jobinfo" style="color:var(--dim)"></span></h2>
+    <h2>Job detail <span id="jobinfo" style="color:var(--dim)"></span>
+      <span style="float:right;text-transform:none;letter-spacing:0">
+        <input id="rescale_p" type="number" min="1" max="64"
+               placeholder="parallelism" style="width:110px">
+        <button class="secondary" style="margin:0;padding:4px 10px"
+                onclick="rescaleJob()">Rescale live</button>
+        <span id="rescale_msg" style="color:var(--dim)"></span>
+      </span></h2>
     <div id="jobdag"></div>
     <div id="charts">select a job's "watch" for live operator rates…</div>
     <div style="display:grid;grid-template-columns:1fr 1fr;gap:12px;
@@ -312,7 +319,8 @@ async function refresh() {
     <td>${job.checkpoint_epoch ?? '—'}</td>
     <td><a href="#" onclick="watch('${p.id}','${job.id}');return false">watch</a>
         <a href="#" onclick="tail('${p.id}','${job.id}');return false">tail</a>
-        <a href="#" onclick="stopPipeline('${p.id}');return false">stop</a></td>
+        <a href="#" onclick="stopPipeline('${p.id}');return false">stop</a>
+        <a href="#" onclick="deletePipeline('${p.id}');return false">delete</a></td>
     </tr>`)).join('');
 }
 
@@ -321,6 +329,42 @@ async function stopPipeline(pid) {
     headers:{'content-type':'application/json'},
     body: JSON.stringify({stop: 'checkpoint'})});
   refresh();
+}
+
+async function deletePipeline(pid) {
+  if (!confirm('Delete pipeline (stops its jobs)?')) return;
+  const r = await fetch('/v1/pipelines/' + pid, {method:'DELETE'});
+  if (!r.ok) {
+    const j = await r.json().catch(() => ({}));
+    alert('delete failed: ' + (j.error || r.status));
+    return;  // pipeline still exists: keep watching it
+  }
+  if (watching && watching.pid === pid) watching = null;
+  refresh();
+}
+
+async function rescaleJob() {
+  // live elastic rescale: snapshot -> re-shard state -> resume at the
+  // new parallelism (reference console job-actions analog)
+  if (!watching) { $('rescale_msg').textContent = 'watch a job first'; return; }
+  const p = parseInt($('rescale_p').value);
+  if (!p || p < 1 || p > 64) {
+    $('rescale_msg').textContent = 'parallelism must be 1–64'; return;
+  }
+  $('rescale_msg').textContent = 'rescaling…';
+  const r = await fetch('/v1/pipelines/' + watching.pid, {method:'PATCH',
+    headers:{'content-type':'application/json'},
+    body: JSON.stringify({parallelism: p})});
+  const j = await r.json().catch(() => ({}));
+  if (!r.ok) { $('rescale_msg').textContent = j.error || 'failed'; return; }
+  if (!(j.rescaled_jobs || []).length) {
+    $('rescale_msg').textContent = 'no live job to rescale'; return;
+  }
+  $('rescale_msg').textContent = `running at p=${p}`;
+  // parallelism changed: rebuild the DAG (the server refreshed the
+  // stored graph) + chart rows
+  $('charts').dataset.built = '';
+  watch(watching.pid, watching.jid);
 }
 
 // ---- live job detail ------------------------------------------------------

@@ -340,17 +340,28 @@ class ApiServer:
             row = self._pipeline_row(req.params["id"])
             body = req.json()
             stop = body.get("stop")
+            if "parallelism" in body:
+                p = int(body["parallelism"])
+                if not 1 <= p <= 1024:
+                    raise HttpError(
+                        400, "parallelism must be between 1 and 1024")
+            rescaled = []
             for job in self._job_rows(row["id"]):
                 jid = job["id"]
                 if (stop in ("checkpoint", "graceful", "immediate")
                         and jid in self.controller.jobs):
                     await self.controller.stop_job(
                         jid, checkpoint=(stop == "checkpoint"))
-                if "parallelism" in body and jid in self.controller.jobs:
+                live = (jid in self.controller.jobs
+                        and not self.controller.jobs[jid].fsm.state.terminal)
+                if "parallelism" in body and live:
+                    # terminal jobs stay registered for status queries but
+                    # cannot transition — rescaling one was a 500
                     overrides = {
                         n.operator_id: int(body["parallelism"])
                         for n in self.controller.jobs[jid].program.nodes()}
                     await self.controller.rescale_job(jid, overrides)
+                    rescaled.append(jid)
             # metadata updates apply once, jobs or not
             with self.db:
                 if stop in ("checkpoint", "graceful", "immediate"):
@@ -361,7 +372,21 @@ class ApiServer:
                     self.db.execute(
                         "UPDATE pipelines SET parallelism = ? WHERE id = ?",
                         (int(body["parallelism"]), row["id"]))
-            return self._pipeline_json(self._pipeline_row(row["id"]))
+                    if rescaled:
+                        # keep the stored graph honest: the console's DAG
+                        # renders per-node parallelism from this column
+                        jid = rescaled[-1]
+                        self.db.execute(
+                            "UPDATE pipelines SET graph = ? WHERE id = ?",
+                            (json.dumps(_graph_json(
+                                self.controller.jobs[jid].program)),
+                             row["id"]))
+            out = self._pipeline_json(self._pipeline_row(row["id"]))
+            if "parallelism" in body:
+                # the console must distinguish "job rescaled live" from
+                # "no live job; only the stored default changed"
+                out["rescaled_jobs"] = rescaled
+            return out
 
         @r.delete("/v1/pipelines/{id}")
         async def delete_pipeline(req: Request):

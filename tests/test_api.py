@@ -353,6 +353,17 @@ def test_rest_rescale_running_pipeline(api_env):
                               json={"parallelism": 2})
             assert r.status_code == 200, r.text
             assert r.json()["parallelism"] == 2
+            # the console distinguishes a LIVE rescale from a stored-
+            # default update, and renders the refreshed graph
+            assert r.json()["rescaled_jobs"] == [job_id]
+            r = await c.get(f"/v1/pipelines/{pl['id']}")
+            assert {n["parallelism"] for n in r.json()["graph"]["nodes"]} \
+                == {2}
+
+            # out-of-range parallelism is a 400, not an unbounded restart
+            r = await c.patch(f"/v1/pipelines/{pl['id']}",
+                              json={"parallelism": 9999})
+            assert r.status_code == 400
 
             for _ in range(400):
                 r = await c.get("/v1/jobs")
@@ -361,6 +372,14 @@ def test_rest_rescale_running_pipeline(api_env):
                     break
                 await asyncio.sleep(0.1)
             assert job["state"] == "Finished", job
+
+            # rescaling a pipeline whose job is terminal must not 500
+            # (the FSM rejects transitions on terminal jobs): 200 with
+            # an empty rescaled_jobs, and only the stored default moves
+            r = await c.patch(f"/v1/pipelines/{pl['id']}",
+                              json={"parallelism": 3})
+            assert r.status_code == 200, r.text
+            assert r.json()["rescaled_jobs"] == []
 
     _run(loop, scenario())
 

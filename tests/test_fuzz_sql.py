@@ -921,7 +921,7 @@ def test_fuzz_session_max_size_clamp(seed):
 
 
 @pytest.mark.parametrize("seed", [61, 62, 63, 64, 65, 66])
-def test_fuzz_common_subplan_elimination(seed):
+def test_fuzz_common_subplan_elimination(seed, monkeypatch):
     """Random q5-SHAPED self-join-on-window-aggregate queries: the
     duplicated inner aggregate must merge into one chain (the pass's
     whole point) and the merged plan's rows must equal the unmerged
@@ -983,13 +983,11 @@ def test_fuzz_common_subplan_elimination(seed):
                              int(b.columns["num"][i])))
         return n_aggs, sorted(rows)
 
+    monkeypatch.delenv("ARROYO_CSE", raising=False)
     merged_aggs, merged = run()
     assert merged_aggs == 1, (seed, "inner aggregate did not merge")
-    os.environ["ARROYO_CSE"] = "0"
-    try:
-        dup_aggs, unmerged = run()
-    finally:
-        os.environ.pop("ARROYO_CSE", None)
+    monkeypatch.setenv("ARROYO_CSE", "0")
+    dup_aggs, unmerged = run()
     assert dup_aggs == 2, seed
     assert merged == unmerged, (seed, len(merged), len(unmerged))
     assert len(merged) > 0, seed

@@ -340,6 +340,7 @@ class ApiServer:
             row = self._pipeline_row(req.params["id"])
             body = req.json()
             stop = body.get("stop")
+            p = None
             if "parallelism" in body:
                 p = int(body["parallelism"])
                 if not 1 <= p <= 1024:
@@ -354,11 +355,11 @@ class ApiServer:
                         jid, checkpoint=(stop == "checkpoint"))
                 live = (jid in self.controller.jobs
                         and not self.controller.jobs[jid].fsm.state.terminal)
-                if "parallelism" in body and live:
+                if p is not None and live:
                     # terminal jobs stay registered for status queries but
                     # cannot transition — rescaling one was a 500
                     overrides = {
-                        n.operator_id: int(body["parallelism"])
+                        n.operator_id: p
                         for n in self.controller.jobs[jid].program.nodes()}
                     await self.controller.rescale_job(jid, overrides)
                     rescaled.append(jid)
@@ -368,10 +369,10 @@ class ApiServer:
                     self.db.execute(
                         "UPDATE pipelines SET stopped = 1 WHERE id = ?",
                         (row["id"],))
-                if "parallelism" in body:
+                if p is not None:
                     self.db.execute(
                         "UPDATE pipelines SET parallelism = ? WHERE id = ?",
-                        (int(body["parallelism"]), row["id"]))
+                        (p, row["id"]))
                     if rescaled:
                         # keep the stored graph honest: the console's DAG
                         # renders per-node parallelism from this column

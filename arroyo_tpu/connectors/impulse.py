@@ -25,6 +25,9 @@ class ImpulseConfig(BaseModel):
     event_time_interval_micros: Optional[int] = None  # synthetic event time step
     message_count: Optional[int] = None  # total events; None = unbounded
     batch_size: Optional[int] = None
+    # pin the event-time origin (nexmark's base_time_micros analog):
+    # deterministic window alignment for tests/benches; default wallclock
+    base_time_micros: Optional[int] = None
 
 
 class ImpulseSource(SourceOperator):
@@ -56,7 +59,10 @@ class ImpulseSource(SourceOperator):
         emitted_since_start = 0
         # event-time base must survive restarts so restored events land in
         # the same windows as the checkpointed state
-        base_event_time = saved_base if saved_base is not None else now_micros()
+        base_event_time = (saved_base if saved_base is not None
+                           else (self.cfg.base_time_micros
+                                 if self.cfg.base_time_micros is not None
+                                 else now_micros()))
 
         runner = getattr(ctx, "_runner", None)
         while total is None or self.counter < total:

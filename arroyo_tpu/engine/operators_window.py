@@ -787,6 +787,65 @@ class WindowJoinOperator(Operator):
         self.right.evict_before(evict_to)
 
 
+class WindowArgmaxOperator(Operator):
+    """Fused ``A JOIN (SELECT max(x), window FROM A GROUP BY window)``
+    (the optimizer's argmax rewrite, WindowArgmaxSpec): rows arrive
+    keyed by window, buffer per window until the watermark passes, then
+    emit exactly the rows achieving the window's max/min of
+    ``value_col`` — ties included, like the self-join — plus the pruned
+    side's synthesized columns.
+
+    Sound at any upstream parallelism: every global argmax row is also
+    a local argmax row in its upstream subtask (value <= local max <=
+    global max, with equality required end-to-end), so upstream may
+    pre-filter to local candidates and this window-keyed stage settles
+    the global answer."""
+
+    def __init__(self, name: str, value_col: str, minmax: str,
+                 synth_cols: Tuple[Tuple[str, str], ...],
+                 width_micros: int):
+        super().__init__(name)
+        self.value_col = value_col
+        self.minmax = minmax
+        self.synth_cols = synth_cols
+        self.width = max(int(width_micros), 1)
+
+    def tables(self) -> List[TableDescriptor]:
+        return [TableDescriptor("b", TableType.BATCH_BUFFER,
+                                "per-window candidate rows",
+                                retention_micros=self.width)]
+
+    async def on_start(self, ctx: Context) -> None:
+        self.buf = ctx.state.get_batch_buffer("b")
+
+    async def process_batch(self, batch: Batch, ctx: Context,
+                            side: int = 0) -> None:
+        self.buf.append(batch)
+        # one timer per distinct window end; aggregate rows stamp
+        # timestamp = window_end - 1 (operator _emit convention)
+        for e in np.unique(
+                np.asarray(batch.columns["window_end"],
+                           dtype=np.int64)).tolist():
+            ctx.timers.schedule(int(e), ("am", int(e)))
+
+    async def handle_timer(self, time: int, key: Any, payload: Any,
+                           ctx: Context) -> None:
+        end = key[1]
+        rows = self.buf.query_range(end - 1, end)  # ts == end - 1
+        self.buf.evict_before(end)
+        if rows is None or not len(rows):
+            return
+        vals = np.asarray(rows.columns[self.value_col])
+        best = vals.max() if self.minmax == "max" else vals.min()
+        sel = np.nonzero(vals == best)[0]
+        out = rows.select(sel)
+        cols = dict(out.columns)
+        for out_name, src in self.synth_cols:
+            cols[out_name] = cols[src]
+        out = Batch(out.timestamp, cols, out.key_hash, out.key_cols)
+        await ctx.collect(out)
+
+
 def _empty_like_side(tmpl: "_SideTemplate", other: Batch) -> Batch:
     """A 0-row batch shaped like one join side (for windows where that
     side saw no data)."""
@@ -1283,6 +1342,13 @@ def _build_window_join(op: LogicalOperator) -> Operator:
                               getattr(s, "join_type", JoinType.INNER),
                               getattr(s, "left_cols", ()),
                               getattr(s, "right_cols", ()))
+
+
+@register_builder(OpKind.WINDOW_ARGMAX)
+def _build_window_argmax(op: LogicalOperator) -> Operator:
+    s = op.spec
+    return WindowArgmaxOperator(op.name, s.value_col, s.minmax,
+                                s.synth_cols, s.width_micros)
 
 
 @register_builder(OpKind.JOIN_WITH_EXPIRATION)

@@ -156,6 +156,7 @@ class OpKind(Enum):
     NON_WINDOW_AGGREGATOR = "non_window_aggregator"
     UPDATING_KEY = "updating_key"
     UNION = "union"  # N-ary stream merge (the reference bails on unions)
+    WINDOW_ARGMAX = "window_argmax"  # fused self-join-on-window-max
 
 
 class JoinType(Enum):
@@ -245,6 +246,22 @@ class JoinWithExpirationSpec:
     # null-pad the missing side even before any batch has arrived from it
     left_cols: Tuple[Tuple[str, str], ...] = ()
     right_cols: Tuple[Tuple[str, str], ...] = ()
+
+
+@dataclass
+class WindowArgmaxSpec:
+    """Operator::WindowArgmax — the optimizer's fusion of
+    ``A JOIN (SELECT max(x), window FROM A GROUP BY window) ON x = mx``
+    (nexmark q5's hot-items shape): buffer A's rows per window, emit the
+    rows achieving the window's max (ties included, exactly as the
+    self-join emits them), and synthesize the pruned side's columns
+    (mx := x).  ``minmax`` is 'max' or 'min'; ``synth_cols`` maps each
+    pruned-side output column to the left column it copies."""
+
+    value_col: str
+    minmax: str
+    synth_cols: Tuple[Tuple[str, str], ...]  # (out_name, left_col)
+    width_micros: int  # buffer retention: one window span
 
 
 @dataclass
@@ -532,6 +549,56 @@ class Program:
                 break  # graph changed: recompute signatures
         return removed
 
+    def subplan_equal(self, a: str, b: str) -> bool:
+        """True when the subplans ending at ``a`` and ``b`` provably
+        compute the same stream: identical structural tokens and
+        identical (recursively equal) inputs.  Shared nodes short-
+        circuit, so chains diverging off a common CTE compare in O(tail).
+        Used by the argmax fusion to prove a self-join's two sides are
+        the same aggregate; false negatives only cost the optimization."""
+        if a == b:
+            return True
+        na, nb = self.node(a), self.node(b)
+        if (na.operator.hash_token() != nb.operator.hash_token()
+                or na.parallelism != nb.parallelism):
+            return False
+        if na.operator.kind == OpKind.CONNECTOR_SOURCE:
+            # two DISTINCT scans are "the same stream" only for
+            # deterministic replayable sources — kafka/sse scans are
+            # independent consumers whose reads diverge even at equal
+            # config (same policy as eliminate_common_subplans)
+            if getattr(na.operator.spec, "connector", None) \
+                    not in self._REPLAYABLE_SOURCES:
+                return False
+        ea_, eb_ = (na.operator.expr, nb.operator.expr)
+        if ea_ is not None and not ea_.sql and ea_.fn is not (
+                eb_.fn if eb_ is not None else None):
+            return False  # name-only expr tokens prove nothing about fns
+        key = lambda e: (e[2]["edge"].typ.value, e[2]["edge"].key_schema)
+        pa = sorted(self.graph.in_edges(a, data=True), key=key)
+        pb = sorted(self.graph.in_edges(b, data=True), key=key)
+        if len(pa) != len(pb) or [key(e) for e in pa] != [key(e) for e in pb]:
+            return False
+        return all(self.subplan_equal(sa, sb)
+                   for (sa, _, _), (sb, _, _) in zip(pa, pb))
+
+    def prune_dead(self) -> int:
+        """Remove operators whose output reaches no sink (subplans the
+        optimizer bypassed, e.g. the pruned max side of an argmax
+        fusion).  Returns the number of nodes removed."""
+        removed = 0
+        changed = True
+        while changed:
+            changed = False
+            for nid in list(self.graph.nodes):
+                if self.node(nid).operator.kind == OpKind.CONNECTOR_SINK:
+                    continue
+                if self.graph.out_degree(nid) == 0:
+                    self.graph.remove_node(nid)
+                    removed += 1
+                    changed = True
+        return removed
+
     # -- hashing (lib.rs:1140-1154) ---------------------------------------
 
     def get_hash(self) -> str:
@@ -749,6 +816,19 @@ class Stream:
         self.program.add_edge(self.tail, nid, EdgeType.SHUFFLE_JOIN_LEFT, key_schema=ks)
         self.program.add_edge(other.tail, nid, EdgeType.SHUFFLE_JOIN_RIGHT, key_schema=ks)
         return Stream(self.program, nid, self.keyed)
+
+    def window_argmax(self, value_col: str, minmax: str,
+                      synth_cols: Tuple[Tuple[str, str], ...],
+                      width_micros: int,
+                      name: str = "window_argmax",
+                      parallelism: Optional[int] = None) -> "Stream":
+        """Per-window argmax/argmin filter (see WindowArgmaxSpec).  The
+        stream must be keyed by the window column so every row of one
+        window lands on one subtask — the filter is then global."""
+        spec = WindowArgmaxSpec(value_col, minmax, tuple(synth_cols),
+                                width_micros)
+        op = LogicalOperator(OpKind.WINDOW_ARGMAX, name, spec=spec)
+        return self._chain(op, parallelism, EdgeType.SHUFFLE)
 
     def join_with_expiration(self, other: "Stream", left_expiration_micros: int,
                              right_expiration_micros: int,

@@ -280,6 +280,12 @@ class Planned:
     # the stream carries __op retraction rows (updating aggregates, outer
     # joins): downstream projections must pass the column through
     updating: bool = False
+    # set when this plan is `SELECT max/min(x), window FROM <windowed
+    # aggregate> GROUP BY window` (q5's MaxBids shape): the inner
+    # aggregate's node id, the internal agg output x maps to, max|min,
+    # the visible output column, and the inner window's width — the
+    # join planner fuses a self-join against this into WindowArgmax
+    max_of: Optional[Dict[str, Any]] = None
 
 
 class Planner:
@@ -330,7 +336,10 @@ class Planner:
         for op_cfg, used in self._pushdowns:
             if used:
                 op_cfg["projection"] = sorted(used)
-        # merge textually duplicated subplans (q5's double hop aggregate)
+        # drop subplans the optimizer bypassed (argmax fusion's pruned
+        # max side), then merge textually duplicated subplans (q5's
+        # double hop aggregate, q8's double source scan)
+        prog.prune_dead()
         prog.eliminate_common_subplans()
         return prog
 
@@ -539,8 +548,13 @@ class Planner:
             schema = planned.schema.clone()
             if tr.alias:
                 schema.aliases.add(tr.alias)
+            # aggregate provenance survives the alias wrap: the join
+            # planner's argmax fusion reads it off the subquery sides
             return Planned(planned.stream, schema,
-                           updating=planned.updating)
+                           agg_node=planned.agg_node,
+                           agg_map=planned.agg_map,
+                           updating=planned.updating,
+                           max_of=planned.max_of)
         if isinstance(tr, Join):
             return self._plan_join(tr, prog, scope)
         raise SqlPlanError(f"unsupported FROM clause {tr!r}")
@@ -1034,13 +1048,53 @@ class Planner:
         fusable = (agg_kind in (OpKind.SLIDING_WINDOW_AGGREGATOR,
                                 OpKind.TUMBLING_WINDOW_AGGREGATOR)
                    and having_rewritten is None)
+        # q5 MaxBids shape: a single MAX/MIN over one output of a binned
+        # window aggregate, re-grouped by that window — record enough
+        # provenance for the join planner's argmax fusion
+        max_of = None
+        if (window is None and grouped_by_window
+                # grouped by the window ONLY (its end/start key columns):
+                # extra keys (GROUP BY window, k) make this a per-key
+                # max, which the global per-window argmax rewrite would
+                # silently change
+                and all(c in ("window_end", "window_start")
+                        for c in key_cols)
+                and having_rewritten is None and len(aggs) == 1
+                and aggs[0].kind in (AggKind.MAX, AggKind.MIN)
+                and planned.agg_node is not None
+                and planned.agg_map):
+            fc = collector.aggs[0] if collector.aggs else None
+            arg = (fc.args[0] if fc is not None and fc.args else None)
+            out_name = next((name for name, e in post_items
+                             if isinstance(e, ColumnRef)
+                             and e.qualifier is None
+                             and e.name == aggs[0].output), None)
+            inner_out = None
+            if isinstance(arg, ColumnRef):
+                try:
+                    tag, phys = planned.schema.resolve(arg, record=False)
+                except SqlCompileError:
+                    tag, phys = None, None
+                if tag == "col":
+                    inner_out = planned.agg_map.get(phys)
+            if inner_out is not None and out_name is not None:
+                width = getattr(
+                    stream.program.node(planned.agg_node).operator.spec,
+                    "width_micros", 0)
+                max_of = {"inner_agg_node": planned.agg_node,
+                          "inner_out": inner_out,
+                          "kind": ("max" if aggs[0].kind == AggKind.MAX
+                                   else "min"),
+                          "out_col": out_name,
+                          "width_micros": int(width)}
         return Planned(
             stream, out_schema,
             agg_node=agg_tail if fusable else None,
             agg_map={name: e.name for name, e in post_items
                      if isinstance(e, ColumnRef) and e.qualifier is None
                      and e.name in agg_outputs} if fusable else None,
-            updating=post_updating)
+            updating=post_updating,
+            max_of=max_of)
 
     @staticmethod
     def _canon_token(e: Expr, schema) -> str:
@@ -1383,22 +1437,6 @@ class Planner:
                 lkeys.append(le)
                 rkeys.append(re_)
 
-        # numeric join keys normalize to float32 so that e.g. an int64 COUNT
-        # equi-joins against a float aggregate (both sides hash identically)
-        lpre = [(f"__jk{i}", self._normalize_key(compile_scalar(e, left.schema)))
-                for i, e in enumerate(lkeys)]
-        rpre = [(f"__jk{i}", self._normalize_key(compile_scalar(e, right.schema)))
-                for i, e in enumerate(rkeys)]
-        lcols = [c for c in left.schema.columns if not c.startswith("__")]
-        rcols = [c for c in right.schema.columns if not c.startswith("__")]
-        lstream = left.stream.map(_wrap_record(lpre, lcols),
-                                  name=f"join_lkey_{self._next_id()}")
-        rstream = right.stream.map(_wrap_record(rpre, rcols),
-                                   name=f"join_rkey_{self._next_id()}")
-        jcols = [f"__jk{i}" for i in range(len(lkeys))]
-        lstream = lstream.key_by(*jcols)
-        rstream = rstream.key_by(*jcols)
-
         kind = JoinType[j.kind.name]
         if left.updating or right.updating:
             # the join buffers treat every row as data — a __op DELETE
@@ -1410,18 +1448,41 @@ class Planner:
                 "joining an updating stream (non-windowed aggregate or "
                 "outer join) is not supported; window the aggregate "
                 "or restructure the query")
-        # visible side schemas (name, kind) so outer joins can null-pad a
-        # side that has produced no rows yet
-        lspec = tuple((c, left.schema.columns[c]) for c in lcols)
-        rspec = tuple((c, right.schema.columns[c]) for c in rcols)
-        if window_join:
-            out = lstream.window_join(rstream, InstantWindow(), kind,
-                                      lspec, rspec,
-                                      name=f"window_join_{self._next_id()}")
-        else:
-            out = lstream.join_with_expiration(
-                rstream, DEFAULT_JOIN_TTL, DEFAULT_JOIN_TTL, kind,
-                lspec, rspec, name=f"join_{self._next_id()}")
+        lcols = [c for c in left.schema.columns if not c.startswith("__")]
+        rcols = [c for c in right.schema.columns if not c.startswith("__")]
+        out = None
+        if window_join and kind == JoinType.INNER:
+            out = self._try_argmax_fusion(left, right, pairs, rcols)
+        if out is None:
+            # numeric join keys normalize to float32 so that e.g. an
+            # int64 COUNT equi-joins against a float aggregate (both
+            # sides hash identically)
+            lpre = [(f"__jk{i}",
+                     self._normalize_key(compile_scalar(e, left.schema)))
+                    for i, e in enumerate(lkeys)]
+            rpre = [(f"__jk{i}",
+                     self._normalize_key(compile_scalar(e, right.schema)))
+                    for i, e in enumerate(rkeys)]
+            lstream = left.stream.map(_wrap_record(lpre, lcols),
+                                      name=f"join_lkey_{self._next_id()}")
+            rstream = right.stream.map(_wrap_record(rpre, rcols),
+                                       name=f"join_rkey_{self._next_id()}")
+            jcols = [f"__jk{i}" for i in range(len(lkeys))]
+            lstream = lstream.key_by(*jcols)
+            rstream = rstream.key_by(*jcols)
+
+            # visible side schemas (name, kind) so outer joins can
+            # null-pad a side that has produced no rows yet
+            lspec = tuple((c, left.schema.columns[c]) for c in lcols)
+            rspec = tuple((c, right.schema.columns[c]) for c in rcols)
+            if window_join:
+                out = lstream.window_join(
+                    rstream, InstantWindow(), kind, lspec, rspec,
+                    name=f"window_join_{self._next_id()}")
+            else:
+                out = lstream.join_with_expiration(
+                    rstream, DEFAULT_JOIN_TTL, DEFAULT_JOIN_TTL, kind,
+                    lspec, rspec, name=f"join_{self._next_id()}")
 
         schema = Schema(aliases=left.schema.aliases | right.schema.aliases)
         for c in lcols:
@@ -1464,6 +1525,73 @@ class Planner:
         # are append-only: each window fires once, so no retractions)
         outer = kind in (JoinType.LEFT, JoinType.RIGHT, JoinType.FULL)
         return Planned(out, schema, updating=(outer and not window_join))
+
+    def _try_argmax_fusion(self, left: Planned, right: Planned,
+                           pairs: List[Tuple[Expr, Expr]],
+                           rcols: List[str]):
+        """Rewrite ``A JOIN (SELECT max(x), window FROM A GROUP BY
+        window) ON A.x = mx AND A.window = window`` into a single
+        per-window argmax filter over A (nexmark q5's hot-items shape).
+
+        The self-join materializes every (key, window) aggregate row,
+        re-aggregates the max, and hash-joins the two — all to keep the
+        rows achieving the max.  The fused plan keys A's output by
+        window and filters in one buffered pass; at upstream
+        parallelism > 1 this stage is still globally correct because
+        all rows of one window shuffle to one subtask.  DataFusion-based
+        planners (the reference) run the full self-join.
+
+        Returns the fused output Stream, or None when the shape doesn't
+        provably match (every bail is a missed optimization, never a
+        wrong plan)."""
+        import os
+
+        if os.environ.get("ARROYO_ARGMAX", "1") in ("0", "off", "false"):
+            return None
+        mo = right.max_of
+        if (mo is None or left.agg_node is None or not left.agg_map
+                or len(pairs) != 2):
+            return None
+        val_pairs = [(le, re_) for le, re_ in pairs
+                     if not (self._is_window_ref(le, left.schema)
+                             and self._is_window_ref(re_, right.schema))]
+        if len(val_pairs) != 1:
+            return None
+        le, re_ = val_pairs[0]
+        if not (isinstance(le, ColumnRef) and isinstance(re_, ColumnRef)):
+            return None
+        try:
+            lt, lcol = left.schema.resolve(le, record=False)
+            rt, rcol = right.schema.resolve(re_, record=False)
+        except SqlCompileError:
+            return None
+        if lt != "col" or rt != "col":
+            return None
+        # the joined value must be exactly the aggregate output the max
+        # side maximizes, over a provably identical aggregate subplan
+        if (left.agg_map.get(lcol) != mo["inner_out"]
+                or rcol != mo["out_col"]):
+            return None
+        prog = left.stream.program
+        if not prog.subplan_equal(left.agg_node, mo["inner_agg_node"]):
+            return None
+        # every pruned-side column must be synthesizable from a left row
+        # (out names mirror the join's collision renames, so downstream
+        # column resolution is identical either way)
+        synth = []
+        for c in rcols:
+            out_name = c if c not in left.schema.columns else f"r_{c}"
+            if c == mo["out_col"]:
+                synth.append((out_name, lcol))
+            elif (c in ("window_start", "window_end")
+                  and c in left.schema.columns):
+                synth.append((out_name, c))
+            else:
+                return None
+        return (left.stream.key_by("window_end")
+                .window_argmax(lcol, mo["kind"], tuple(synth),
+                               mo["width_micros"] or 1,
+                               name=f"window_argmax_{self._next_id()}"))
 
     def _split_on(self, on: Expr, ls: Schema, rs: Schema
                   ) -> List[Tuple[Expr, Expr]]:

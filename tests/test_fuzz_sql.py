@@ -983,6 +983,9 @@ def test_fuzz_common_subplan_elimination(seed, monkeypatch):
                              int(b.columns["num"][i])))
         return n_aggs, sorted(rows)
 
+    # pin the CSE-specific shape: the argmax fusion would otherwise
+    # rewrite these self-joins entirely (it has its own fuzz family)
+    monkeypatch.setenv("ARROYO_ARGMAX", "0")
     monkeypatch.delenv("ARROYO_CSE", raising=False)
     merged_aggs, merged = run()
     assert merged_aggs == 1, (seed, "inner aggregate did not merge")
@@ -991,3 +994,170 @@ def test_fuzz_common_subplan_elimination(seed, monkeypatch):
     assert dup_aggs == 2, seed
     assert merged == unmerged, (seed, len(merged), len(unmerged))
     assert len(merged) > 0, seed
+
+
+@pytest.mark.parametrize("seed", [71, 72, 73, 74, 75, 76])
+def test_fuzz_window_argmax_fusion(seed, monkeypatch):
+    """Random q5-shaped self-joins on a window aggregate: the argmax
+    fusion must replace the whole join subplan with a WindowArgmax
+    operator (no window_join, ONE aggregate) and emit exactly the rows
+    the unfused join emits — across inner agg kinds, outer max/min,
+    window shapes, parallelism, batch splits, and tie multiplicity."""
+    from arroyo_tpu.sql.planner import Planner
+
+    rng = np.random.default_rng(seed)
+    n = int(rng.integers(1000, 5000))
+    hop = bool(rng.integers(0, 2))
+    width_s = int(rng.choice([2, 3, 4]))
+    slide_s = (int(rng.choice([d for d in (1, 2) if width_s % d == 0]))
+               if hop else width_s)
+    nkeys = int(rng.integers(3, 30))
+    par = int(rng.integers(1, 4))
+    inner = rng.choice(["count(*)", "sum(v)", "max(v)"])
+    outer = rng.choice(["max", "min"])
+    nbatch = int(rng.integers(1, 6))
+    ts = np.sort(rng.integers(0, 9 * SEC, n)).astype(np.int64)
+    k = rng.integers(0, nkeys, n).astype(np.int64)
+    # small value range -> plenty of cross-key ties at the window max
+    v = rng.integers(1, 8, n).astype(np.int64)
+    bounds = np.linspace(0, n, nbatch + 1).astype(int)
+    win = (f"HOP(INTERVAL '{slide_s}' SECOND, INTERVAL '{width_s}' SECOND)"
+           if hop else f"TUMBLE(INTERVAL '{width_s}' SECOND)")
+    sql = f"""
+        WITH ev AS (SELECT k AS k, v AS v FROM events)
+        SELECT A.k AS k, A.num AS num, B.mx AS mx
+        FROM (
+          SELECT T1.k, {win} AS window, {inner} AS num
+          FROM ev T1 GROUP BY 1, 2
+        ) AS A
+        JOIN (
+          SELECT {outer}(num) AS mx, window FROM (
+            SELECT {inner} AS num, {win} AS window
+            FROM ev T2 GROUP BY T2.k, 2
+          ) AS B0 GROUP BY 2
+        ) AS B
+        ON A.num = B.mx AND A.window = B.window
+    """
+
+    def run():
+        provider = SchemaProvider()
+        provider.add_memory_table("events", {"k": "i", "v": "i"}, [
+            Batch(ts[a:b], {"k": k[a:b], "v": v[a:b]})
+            for a, b in zip(bounds[:-1], bounds[1:]) if b > a])
+        clear_sink("results")
+        prog = Planner(provider).plan(sql, query_parallelism=par)
+        shapes = {"join": sum(1 for nd in prog.graph.nodes
+                              if "window_join" in nd),
+                  "argmax": sum(1 for nd in prog.graph.nodes
+                                if "window_argmax" in nd),
+                  "aggs": sum(1 for nd in prog.graph.nodes
+                              if "window_aggregator" in nd
+                              and "non_window" not in nd)}
+        LocalRunner(prog).run()
+        rows = []
+        for b in sink_output("results"):
+            for i in range(len(next(iter(b.columns.values())))):
+                rows.append((int(b.columns["k"][i]),
+                             int(b.columns["num"][i]),
+                             int(b.columns["mx"][i])))
+        return shapes, sorted(rows)
+
+    monkeypatch.delenv("ARROYO_ARGMAX", raising=False)
+    fshape, fused = run()
+    assert fshape == {"join": 0, "argmax": 1, "aggs": 1}, (seed, fshape)
+    monkeypatch.setenv("ARROYO_ARGMAX", "0")
+    ushape, unfused = run()
+    assert ushape["join"] == 1 and ushape["argmax"] == 0, (seed, ushape)
+    assert fused == unfused, (seed, len(fused), len(unfused))
+    assert len(fused) > 0, seed
+    # the synthesized mx column really is the join's: mx == num everywhere
+    assert all(num == mx for _, num, mx in fused), seed
+
+
+@pytest.mark.parametrize("seed", [81, 82, 83])
+def test_fuzz_argmax_fusion_checkpoint_restore(seed, tmp_path):
+    """Crash/restore through the FUSED argmax plan: the WindowArgmax
+    buffer and its timers must round-trip state so the restored run
+    still emits exactly the unfused join's rows."""
+    import asyncio
+    import json as _json
+
+    from arroyo_tpu.engine.engine import Engine
+    from arroyo_tpu.sql.planner import Planner
+    from arroyo_tpu.types import StopMode
+
+    rng = np.random.default_rng(seed)
+    total = int(rng.integers(3000, 6000))
+    crash_after = float(rng.uniform(0.05, 0.2))
+    out_path = f"{tmp_path}/out.jsonl"
+    url = f"file://{tmp_path}/ckpt"
+    job = f"argmax-restore-{seed}"
+    sql = f"""
+    CREATE TABLE imp WITH (connector = 'impulse', event_rate = '30000',
+      message_count = '{total}', batch_size = '128',
+      event_time_interval_micros = '1000',
+      base_time_micros = '1700000000000000');
+    CREATE TABLE outj (k BIGINT, num BIGINT) WITH (
+      connector = 'single_file', path = '{out_path}', type = 'sink');
+    INSERT INTO outj
+    SELECT A.k AS k, A.num AS num
+    FROM (
+      SELECT counter % 7 AS k, TUMBLE(INTERVAL '1' SECOND) AS window,
+             count(*) AS num
+      FROM imp GROUP BY 1, 2
+    ) AS A
+    JOIN (
+      SELECT max(num) AS mx, window FROM (
+        SELECT count(*) AS num, counter % 7 AS k,
+               TUMBLE(INTERVAL '1' SECOND) AS window
+        FROM imp GROUP BY 2, 3
+      ) AS B0 GROUP BY 2
+    ) AS B ON A.num = B.mx AND A.window = B.window
+    """
+
+    def plan():
+        prog = Planner(SchemaProvider()).plan(sql)
+        assert any("window_argmax" in n for n in prog.graph.nodes)
+        return prog
+
+    async def run_with_crash():
+        eng = Engine.for_local(plan(), job, checkpoint_url=url)
+        running = eng.start()
+        join_t = asyncio.ensure_future(running.join())
+        await asyncio.sleep(crash_after)
+        if join_t.done():
+            return False
+        await running.checkpoint(1)
+        ok = await running.wait_for_checkpoint(1)
+        if not ok or join_t.done():
+            await asyncio.wait([join_t])
+            return False
+        await running.stop(StopMode.IMMEDIATE)
+        try:
+            await join_t
+        except RuntimeError:
+            pass
+        return True
+
+    async def run_restored():
+        eng = Engine.for_local(plan(), job, checkpoint_url=url,
+                               restore_epoch=1)
+        await eng.start().join()
+
+    if asyncio.run(run_with_crash()):
+        asyncio.run(run_restored())
+    got = sorted((r["k"], r["num"]) for r in
+                 (_json.loads(line) for line in open(out_path)))
+
+    # oracle: per tumbling second, the keys achieving the max count
+    counters = np.arange(total, dtype=np.int64)
+    ts = 1_700_000_000_000_000 + counters * 1000
+    k = counters % 7
+    wend = (ts // SEC + 1) * SEC
+    exp = []
+    for w in np.unique(wend):
+        sel = wend == w
+        ks, cnts = np.unique(k[sel], return_counts=True)
+        mx = cnts.max()
+        exp.extend((int(kk), int(mx)) for kk in ks[cnts == mx])
+    assert got == sorted(exp), (seed, len(got), len(exp))

@@ -1227,13 +1227,17 @@ def test_explain_rejects_mixed_statements():
         run_sql("SELECT k FROM events; EXPLAIN SELECT k FROM events", p)
 
 
-def test_common_subplan_elimination_q5_shape():
+def test_common_subplan_elimination_q5_shape(monkeypatch):
     """Textually duplicated subqueries (nexmark q5's AuctionBids vs
     CountBids — same hop aggregate behind different table aliases) merge
     into ONE aggregate chain; output is identical with the pass off.
     Reference comparison: DataFusion does not dedupe across join inputs,
     so the reference runs the chain twice (double state, double fires)."""
     import os
+
+    # pin the CSE-specific plan shape: the argmax fusion would rewrite
+    # this self-join wholesale (it has its own tests)
+    monkeypatch.setenv("ARROYO_ARGMAX", "0")
 
     sql = """
     CREATE TABLE nexmark WITH (
@@ -1350,3 +1354,81 @@ def test_replayable_source_scans_merge():
     kprog = plan_sql(ksql)
     ksrcs = [n for n in kprog.graph.nodes if "connector_source" in n]
     assert len(ksrcs) == 2, "kafka sources must not merge"
+
+
+def test_argmax_fusion_bails_on_non_matching_shapes():
+    """The argmax rewrite must prove the self-join's two sides identical;
+    near-misses (different window widths, different inner aggregates,
+    outer joins, HAVING) keep the full join plan."""
+    def plan(sql):
+        from arroyo_tpu.sql.planner import Planner
+
+        provider = SchemaProvider()
+        provider.add_memory_table("events", {"k": "i", "v": "i"}, [
+            Batch(np.array([0], dtype=np.int64),
+                  {"k": np.array([1], dtype=np.int64),
+                   "v": np.array([1], dtype=np.int64)})])
+        return Planner(provider).plan(sql)
+
+    def shape(prog):
+        return (sum(1 for n in prog.graph.nodes if "window_join" in n),
+                sum(1 for n in prog.graph.nodes if "window_argmax" in n))
+
+    tpl = """
+    WITH ev AS (SELECT k AS k, v AS v FROM events)
+    SELECT A.k AS k, A.num AS num
+    FROM (
+      SELECT T1.k, TUMBLE(INTERVAL '{wl}' SECOND) AS window,
+             {aggl} AS num FROM ev T1 GROUP BY 1, 2
+    ) AS A
+    {kind} JOIN (
+      SELECT max(num) AS mx, window FROM (
+        SELECT {aggr} AS num, TUMBLE(INTERVAL '{wr}' SECOND) AS window
+        FROM ev T2 GROUP BY T2.k, 2
+      ) AS B0 GROUP BY 2
+    ) AS B
+    ON A.num = B.mx AND A.window = B.window
+    """
+    # identical sides: fuses
+    assert shape(plan(tpl.format(wl=2, wr=2, aggl="count(*)",
+                                 aggr="count(*)", kind=""))) == (0, 1)
+    # different window widths: window refs differ -> full join
+    assert shape(plan(tpl.format(wl=2, wr=4, aggl="count(*)",
+                                 aggr="count(*)", kind="")))[1] == 0
+    # different inner aggregates: subplans differ -> full join
+    assert shape(plan(tpl.format(wl=2, wr=2, aggl="count(*)",
+                                 aggr="sum(v)", kind=""))) == (1, 0)
+    # outer join kind: never fused
+    assert shape(plan(tpl.format(wl=2, wr=2, aggl="count(*)",
+                                 aggr="count(*)", kind="LEFT"))) == (1, 0)
+
+
+def test_argmax_fusion_bails_on_per_key_max():
+    """GROUP BY window, k on the max side is a PER-KEY max — fusing it
+    to a global per-window argmax would silently change results
+    (code-review r4 finding, verified repro): must keep the full join."""
+    from arroyo_tpu.sql.planner import Planner
+
+    provider = SchemaProvider()
+    provider.add_memory_table("events", {"k": "i", "v": "i"}, [
+        Batch(np.array([0], dtype=np.int64),
+              {"k": np.array([1], dtype=np.int64),
+               "v": np.array([1], dtype=np.int64)})])
+    prog = Planner(provider).plan("""
+    WITH ev AS (SELECT k AS k, v AS v FROM events)
+    SELECT A.k AS k, A.num AS num
+    FROM (
+      SELECT T1.k, TUMBLE(INTERVAL '2' SECOND) AS window,
+             count(*) AS num FROM ev T1 GROUP BY 1, 2
+    ) AS A
+    JOIN (
+      SELECT max(num) AS mx, window FROM (
+        SELECT count(*) AS num, k AS k,
+               TUMBLE(INTERVAL '2' SECOND) AS window
+        FROM ev T2 GROUP BY 2, 3
+      ) AS B0 GROUP BY window, k
+    ) AS B
+    ON A.num = B.mx AND A.window = B.window
+    """)
+    assert not any("window_argmax" in n for n in prog.graph.nodes)
+    assert any("join" in n for n in prog.graph.nodes)

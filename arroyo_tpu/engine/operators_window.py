@@ -133,7 +133,8 @@ class BinAggOperator(Operator):
 
     def __init__(self, name: str, width_micros: int, slide_micros: int,
                  aggs: Tuple[AggSpec, ...], projection=None,
-                 top_n: Optional[Tuple[Tuple[str, ...], str, int]] = None):
+                 top_n: Optional[Tuple[Tuple[str, ...], str, int]] = None,
+                 argmax_local: Optional[Tuple[str, str]] = None):
         super().__init__(name)
         from ..parallel.mesh_window import make_bin_state
 
@@ -144,6 +145,10 @@ class BinAggOperator(Operator):
         # over ICI instead of a host shuffle); single-device KeyedBinState
         # otherwise
         self.state = make_bin_state(aggs, slide_micros, width_micros)
+        if argmax_local is not None and hasattr(self.state, "set_argmax_local"):
+            # emission pre-filters to local per-pane argmax candidates
+            # (sole consumer is a WindowArgmax stage — planner-proven)
+            self.state.set_argmax_local(*argmax_local)
         self.keyvals = _SlotKeyValues()
         self.projection = (CompiledExpr(projection.name, projection.fn)
                            if projection else None)
@@ -1299,14 +1304,16 @@ class NonWindowAggOperator(Operator):
 def _build_sliding(op: LogicalOperator) -> Operator:
     s = op.spec
     return BinAggOperator(op.name, s.width_micros, s.slide_micros, s.aggs,
-                          s.projection)
+                          s.projection,
+                          argmax_local=getattr(s, "argmax_local", None))
 
 
 @register_builder(OpKind.TUMBLING_WINDOW_AGGREGATOR)
 def _build_tumbling(op: LogicalOperator) -> Operator:
     s = op.spec
     return BinAggOperator(op.name, s.width_micros, s.width_micros, s.aggs,
-                          s.projection)
+                          s.projection,
+                          argmax_local=getattr(s, "argmax_local", None))
 
 
 @register_builder(OpKind.SLIDING_AGGREGATING_TOP_N)

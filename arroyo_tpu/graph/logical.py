@@ -198,6 +198,10 @@ class SlidingAggregatorSpec:
     slide_micros: int
     aggs: Tuple[AggSpec, ...] = ()
     projection: Optional[ColumnExpr] = None
+    # (agg output, 'max'|'min') when emission may pre-filter to local
+    # per-pane argmax candidates (set by the planner only when the sole
+    # consumer is a WindowArgmax stage, which settles the global answer)
+    argmax_local: Optional[Tuple[str, str]] = None
 
 
 @dataclass
@@ -205,6 +209,7 @@ class TumblingAggregatorSpec:
     width_micros: int
     aggs: Tuple[AggSpec, ...] = ()
     projection: Optional[ColumnExpr] = None
+    argmax_local: Optional[Tuple[str, str]] = None  # see SlidingAggregatorSpec
 
 
 @dataclass
@@ -262,6 +267,10 @@ class WindowArgmaxSpec:
     minmax: str
     synth_cols: Tuple[Tuple[str, str], ...]  # (out_name, left_col)
     width_micros: int  # buffer retention: one window span
+    # the upstream aggregate output (__aggN) the value column carries —
+    # lets the plan finalizer push a LOCAL candidate pre-filter into the
+    # aggregate's emission kernel when this operator is its only consumer
+    agg_out: str = ""
 
 
 @dataclass
@@ -821,12 +830,13 @@ class Stream:
                       synth_cols: Tuple[Tuple[str, str], ...],
                       width_micros: int,
                       name: str = "window_argmax",
-                      parallelism: Optional[int] = None) -> "Stream":
+                      parallelism: Optional[int] = None,
+                      agg_out: str = "") -> "Stream":
         """Per-window argmax/argmin filter (see WindowArgmaxSpec).  The
         stream must be keyed by the window column so every row of one
         window lands on one subtask — the filter is then global."""
         spec = WindowArgmaxSpec(value_col, minmax, tuple(synth_cols),
-                                width_micros)
+                                width_micros, agg_out)
         op = LogicalOperator(OpKind.WINDOW_ARGMAX, name, spec=spec)
         return self._chain(op, parallelism, EdgeType.SHUFFLE)
 

@@ -153,6 +153,47 @@ def _emit_kernel(kinds: Tuple[str, ...], C: int, B: int, W: int, k: int,
 
 
 @functools.lru_cache(maxsize=256)
+def _argmax_nnz_kernel(C: int, B: int, W: int, k: int, minmax: str):
+    """Phase 1 of argmax emission: pane counts + per-pane extremum stay
+    device-resident; only the candidate total crosses (4 bytes).  The
+    candidate mask is (cnt == pane extremum) & (cnt > 0) — every global
+    argmax row is a local candidate, so this is a sound pre-filter for
+    the downstream WindowArgmax stage."""
+
+    @jax.jit
+    def run(counts, ring, bin_ok):
+        cnt_g = counts[:, ring]  # [C, k, W]
+        cnt = jnp.sum(jnp.where(bin_ok[None], cnt_g, 0), axis=-1)  # [C, k]
+        if minmax == "max":
+            ext = jnp.max(cnt, axis=0)  # counts are >= 0: empty cells lose
+        else:
+            big = jnp.iinfo(cnt.dtype).max
+            ext = jnp.min(jnp.where(cnt > 0, cnt, big), axis=0)
+        sel = (cnt == ext[None, :]) & (cnt > 0)
+        return cnt, sel, jnp.sum(sel)
+
+    return run
+
+
+@functools.lru_cache(maxsize=256)
+def _argmax_gather_kernel(C: int, B: int, W: int, k: int, npad: int):
+    """Phase 2: gather ONLY the candidate cells' (key, pane, count)."""
+
+    @jax.jit
+    def run(cnt, sel):
+        flat = sel.reshape(-1)
+        idx = jnp.nonzero(flat, size=npad, fill_value=C * k)[0]
+        ok = idx < C * k
+        safe = jnp.where(ok, idx, 0)
+        idx2 = jnp.stack([(safe // k).astype(jnp.int32),
+                          (safe % k).astype(jnp.int32)])
+        cnt_c = jnp.where(ok, cnt.reshape(-1)[safe], 0)
+        return idx2, cnt_c
+
+    return run
+
+
+@functools.lru_cache(maxsize=256)
 def _emit_count_kernel(C: int, B: int, W: int, k: int):
     """Phase 1 of compacted emission: pane counts stay device-resident;
     only the live-cell total crosses (4 bytes instead of the [C, k]
@@ -474,6 +515,10 @@ class KeyedBinState:
         # observed live-cell fraction of the last fire's pane grid (None
         # until a fire happens); drives the compact-emission prediction
         self._fire_density: Optional[float] = None
+        # set via set_argmax_local: emission keeps only local per-pane
+        # argmax candidates (planner-proven sole consumer settles the
+        # global answer); only COUNT(*) values qualify (see planner)
+        self._argmax_local: Optional[str] = None  # 'max' | 'min'
         # per-ABSOLUTE-bin upper bound on any (key, bin) cell count (each
         # touched bin accrues the batch's largest pre-aggregated cell;
         # evicted bins drop out).  The max sliding-window sum over W bins
@@ -706,6 +751,48 @@ class KeyedBinState:
         sums = c[W:] - c[:-W]  # sums[i] covers bins [first_pane+i-W+1, ..]
         return int(sums.max()) if len(sums) else 0
 
+    def set_argmax_local(self, agg_out: str, minmax: str) -> None:
+        """Enable candidate-only emission for the given COUNT(*) agg
+        (the value IS the counts plane — enforced here, not just by the
+        planner: a non-count target would silently rank by row counts)."""
+        target = next((i for i, a in enumerate(self.aggs)
+                       if a.output == agg_out), None)
+        assert target is not None and target in self._dup_ch, (
+            f"argmax_local target {agg_out!r} is not a bare COUNT(*) "
+            f"aggregate of this state")
+        assert minmax in ("max", "min"), minmax
+        self._argmax_local = minmax
+
+    def _emit_argmax(self, ring: np.ndarray, bin_ok: np.ndarray, kpad: int
+                     ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                np.ndarray]:
+        """Candidate-only emission: (key_idx, pane_idx, counts, empty
+        channel block) for cells at their pane's count extremum — on a
+        tunneled TPU this is the ~1000x transfer cut (ties-per-pane
+        instead of every (key, pane) cell)."""
+        from ..obs.perf import timed_device
+
+        ring_j = jnp.asarray(ring)
+        ok_j = jnp.asarray(bin_ok)
+        nk = _argmax_nnz_kernel(self.C, self.B, self.W, kpad,
+                                self._argmax_local)
+        cnt_dev, sel_dev, nnz_dev = timed_device(
+            nk, self.counts, ring_j, ok_j)
+        nnz = int(nnz_dev)  # the only blocking scalar readback
+        if nnz == 0:
+            return (np.zeros(0, np.int64), np.zeros(0, np.int64),
+                    np.zeros(0, np.int64),
+                    np.zeros((len(self._xfer_ch), 0)))
+        npad = _bucket(nnz, floor=8)
+        gk = _argmax_gather_kernel(self.C, self.B, self.W, kpad, npad)
+        idx2_d, cnt_d = timed_device(gk, cnt_dev, sel_dev)
+        _prefetch_host(idx2_d, cnt_d)
+        idx2 = np.asarray(idx2_d)
+        return (idx2[0, :nnz].astype(np.int64),
+                idx2[1, :nnz].astype(np.int64),
+                np.asarray(cnt_d)[:nnz],
+                np.zeros((len(self._xfer_ch), nnz)))
+
     def _use_compact_emit(self, c_slice: int, k: int) -> bool:
         """Two-phase compacted emission: worth one extra (4-byte) scalar
         round-trip only when fires are SPARSE (keys active inside one
@@ -858,6 +945,12 @@ class KeyedBinState:
         use_ring = self._use_ring()
         if use_ring:
             outs, cnts = self._emit_ring(pane_ends, k)
+        elif self._argmax_local is not None and not self._xfer_ch:
+            # candidate-only emission: every output column derives from
+            # the counts plane (bare COUNT(*) aggs), so nothing else
+            # needs to ride the transfer; with f64 channels present the
+            # normal paths run and the downstream argmax stage filters
+            compact = self._emit_argmax(ring, bin_ok, kpad)
         elif self._use_compact_emit(c_slice, k):
             compact = self._emit_compact(ring, bin_ok, kpad)
         else:

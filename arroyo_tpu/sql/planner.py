@@ -341,7 +341,75 @@ class Planner:
         # double hop aggregate, q8's double source scan)
         prog.prune_dead()
         prog.eliminate_common_subplans()
+        self._push_argmax_local(prog)
         return prog
+
+    @staticmethod
+    def _push_argmax_local(prog: Program) -> None:
+        """Let the window aggregate's EMISSION pre-filter to local
+        per-pane argmax candidates when a WindowArgmax stage is its only
+        consumer: every global argmax row is also a local argmax row
+        (value <= local max <= global max with equality required), so
+        the filter is a sound superset and the argmax stage settles the
+        global answer.  On a tunneled TPU this collapses the dominant
+        pane readback from every (key, pane) cell to ~ties-per-pane.
+
+        Applies only when (a) the chain from aggregate to argmax is
+        single-consumer row-preserving projections/key_bys — a second
+        consumer or a filter would see pruned rows — and (b) the tracked
+        value is a bare COUNT(*): null-skipping aggregates hold device
+        identities for all-null panes, which a device-side max would
+        wrongly rank."""
+        for nid in list(prog.graph.nodes):
+            node = prog.node(nid)
+            if node.operator.kind != OpKind.WINDOW_ARGMAX:
+                continue
+            spec = node.operator.spec
+            if not spec.agg_out:
+                continue
+            preds = list(prog.graph.predecessors(nid))
+            ok = len(preds) == 1
+            cur = preds[0] if ok else None
+            while ok and prog.node(cur).operator.kind in (
+                    OpKind.EXPRESSION, OpKind.KEY_BY, OpKind.UDF):
+                op = prog.node(cur).operator
+                # row preservation must be proven, not assumed: host
+                # FILTERS also compile as RECORD-typed UDF nodes, so the
+                # only expression nodes accepted are the planner's own
+                # post-aggregate projections (pure column maps by
+                # construction) — anything else bails
+                if (op.kind != OpKind.KEY_BY
+                        and not op.name.startswith("agg_project_")):
+                    ok = False
+                    break
+                if (op.expr is not None
+                        and op.expr.return_type != ExprReturnType.RECORD):
+                    ok = False
+                    break
+                if prog.graph.out_degree(cur) != 1:
+                    ok = False
+                    break
+                preds = list(prog.graph.predecessors(cur))
+                if len(preds) != 1:
+                    ok = False
+                    break
+                cur = preds[0]
+            if not ok or cur is None:
+                continue
+            agg = prog.node(cur)
+            if agg.operator.kind not in (
+                    OpKind.SLIDING_WINDOW_AGGREGATOR,
+                    OpKind.TUMBLING_WINDOW_AGGREGATOR):
+                continue
+            if prog.graph.out_degree(cur) != 1:
+                continue
+            aspec = agg.operator.spec
+            target = next((a for a in aspec.aggs
+                           if a.output == spec.agg_out), None)
+            if (target is None or target.kind != AggKind.COUNT
+                    or target.column is not None):
+                continue
+            aspec.argmax_local = (spec.agg_out, spec.minmax)
 
     def _plan_insert(self, ins: Insert, prog: Program) -> None:
         sink_table = self.provider.get(ins.table)
@@ -1591,7 +1659,8 @@ class Planner:
         return (left.stream.key_by("window_end")
                 .window_argmax(lcol, mo["kind"], tuple(synth),
                                mo["width_micros"] or 1,
-                               name=f"window_argmax_{self._next_id()}"))
+                               name=f"window_argmax_{self._next_id()}",
+                               agg_out=mo["inner_out"]))
 
     def _split_on(self, on: Expr, ls: Schema, rs: Schema
                   ) -> List[Tuple[Expr, Expr]]:

@@ -841,8 +841,17 @@ class WindowArgmaxOperator(Operator):
         if rows is None or not len(rows):
             return
         vals = np.asarray(rows.columns[self.value_col])
-        best = vals.max() if self.minmax == "max" else vals.min()
-        sel = np.nonzero(vals == best)[0]
+        # SQL NULL values (NaN — e.g. SUM over an all-null pane) never
+        # equal the max in the join this operator replaces; a plain
+        # vals.max() would let one NaN poison the extremum and drop the
+        # whole window's rows
+        valid = (~np.isnan(vals) if vals.dtype.kind == "f"
+                 else np.ones(len(vals), dtype=bool))
+        if not valid.any():
+            return
+        vv = vals[valid]
+        best = vv.max() if self.minmax == "max" else vv.min()
+        sel = np.nonzero(valid & (vals == best))[0]
         out = rows.select(sel)
         cols = dict(out.columns)
         for out_name, src in self.synth_cols:

@@ -1018,8 +1018,12 @@ def test_fuzz_window_argmax_fusion(seed, monkeypatch):
     nbatch = int(rng.integers(1, 6))
     ts = np.sort(rng.integers(0, 9 * SEC, n)).astype(np.int64)
     k = rng.integers(0, nkeys, n).astype(np.int64)
-    # small value range -> plenty of cross-key ties at the window max
-    v = rng.integers(1, 8, n).astype(np.int64)
+    # small value range -> plenty of cross-key ties at the window max;
+    # a null fraction makes some (key, window) aggregates SQL NULL —
+    # NULL never equals the max, and must not poison the extremum
+    # (an all-NaN pane once dropped the whole window's rows)
+    v = rng.integers(1, 8, n).astype(np.float64)
+    v[rng.random(n) < 0.15] = np.nan
     bounds = np.linspace(0, n, nbatch + 1).astype(int)
     win = (f"HOP(INTERVAL '{slide_s}' SECOND, INTERVAL '{width_s}' SECOND)"
            if hop else f"TUMBLE(INTERVAL '{width_s}' SECOND)")
@@ -1041,7 +1045,7 @@ def test_fuzz_window_argmax_fusion(seed, monkeypatch):
 
     def run():
         provider = SchemaProvider()
-        provider.add_memory_table("events", {"k": "i", "v": "i"}, [
+        provider.add_memory_table("events", {"k": "i", "v": "f"}, [
             Batch(ts[a:b], {"k": k[a:b], "v": v[a:b]})
             for a, b in zip(bounds[:-1], bounds[1:]) if b > a])
         clear_sink("results")

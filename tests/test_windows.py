@@ -793,3 +793,61 @@ def test_group_by_window_flush_is_idempotent():
         assert len(ctx2.out) == 0, "restored guard must drop late windows"
 
     asyncio.run(drive())
+
+
+def test_window_argmax_skips_null_values():
+    """SQL NULL (NaN) values never equal the join's max — one all-null
+    aggregate row must not poison the window extremum and drop every
+    row (pre-fix: vals.max() returned NaN and nothing matched)."""
+    from arroyo_tpu.engine.operators_window import WindowArgmaxOperator
+    from arroyo_tpu.state.store import StateStore
+    from arroyo_tpu.types import TaskInfo
+
+    class Ctx:
+        def __init__(self, store):
+            self.state = store
+            self.last_watermark = None
+            self.out = []
+            self.timers = self
+
+        def schedule(self, t, key):
+            self._timer = (t, key)
+
+        async def collect(self, batch):
+            self.out.append(batch)
+
+        async def broadcast(self, msg):
+            pass
+
+    op = WindowArgmaxOperator("am", "num", "max",
+                              (("mx", "num"),), 1_000_000)
+    ctx = Ctx(StateStore.new_in_memory(TaskInfo("j", "o", "am", 0, 1)))
+
+    async def drive():
+        await op.on_start(ctx)
+        wend = 1_000_000
+        b = Batch(np.full(3, wend - 1, np.int64),
+                  {"window_end": np.full(3, wend, np.int64),
+                   "k": np.array([1, 2, 3], np.int64),
+                   "num": np.array([5.0, np.nan, 7.0])},
+                  np.array([9, 9, 9], np.uint64), ("window_end",))
+        await op.process_batch(b, ctx)
+        await op.handle_timer(wend, ("am", wend), None, ctx)
+        assert len(ctx.out) == 1
+        out = ctx.out[0]
+        assert out.columns["k"].tolist() == [3]  # the non-null max row
+        assert out.columns["num"].tolist() == [7.0]
+        assert out.columns["mx"].tolist() == [7.0]
+
+        # an ALL-null window emits nothing (no row can equal the max)
+        wend2 = 2_000_000
+        b2 = Batch(np.full(2, wend2 - 1, np.int64),
+                   {"window_end": np.full(2, wend2, np.int64),
+                    "k": np.array([1, 2], np.int64),
+                    "num": np.array([np.nan, np.nan])},
+                   np.array([9, 9], np.uint64), ("window_end",))
+        await op.process_batch(b2, ctx)
+        await op.handle_timer(wend2, ("am", wend2), None, ctx)
+        assert len(ctx.out) == 1  # nothing new
+
+    asyncio.run(drive())

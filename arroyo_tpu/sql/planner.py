@@ -206,6 +206,59 @@ def _apply_validity(v, m):
     return jnp.where(jnp.asarray(m), arr, jnp.nan)
 
 
+# per-process nonce space for null join keys: a random 30-bit salt in
+# the high bits (distinct processes land in distinct 2^33-row regions)
+# plus a monotone row counter
+_jk_nonce_next = [(__import__("secrets").randbits(30) << 33) | (1 << 62)]
+
+
+def _null_key_nonce_fn(base_fn: Callable, jk_cols: List[str]) -> Callable:
+    """Wrap a join-key map so null-keyed rows get a UNIQUE nonce (valid
+    rows get 0): SQL NULL keys must never equal anything, including each
+    other.  Uniqueness spans batches (a per-process counter in the high
+    bits) and processes (a random salt); restored buffers keep their old
+    nonces, which a fresh salt cannot collide with in practice — the
+    same 64-bit-hash-uniqueness assumption the join itself rests on."""
+
+    def fn(cols: Dict[str, Any]) -> Dict[str, Any]:
+        out = base_fn(cols)
+        n = len(np.asarray(cols["__timestamp"]))
+        nullmask = np.zeros(n, dtype=bool)
+        for c in jk_cols:
+            v = np.asarray(out[c])
+            out[c] = v  # keep the host copy: downstream must not convert again
+            if v.dtype.kind == "f":
+                nullmask |= np.isnan(v)
+            elif v.dtype == object:
+                nullmask |= np.fromiter(
+                    (x is None or (isinstance(x, float) and np.isnan(x))
+                     for x in v), dtype=bool, count=n)
+        nonce = np.zeros(n, dtype=np.int64)
+        if nullmask.any():
+            idx = nullmask.nonzero()[0]
+            base = _jk_nonce_next[0]
+            _jk_nonce_next[0] = base + len(idx)
+            nonce[idx] = base + np.arange(len(idx), dtype=np.int64)
+        out["__jknonce"] = nonce
+        return out
+
+    return fn
+
+
+def _zero_nonce_fn(base_fn: Callable) -> Callable:
+    """Join-key map variant for keys that can never be NULL (all-window
+    joins): a constant-zero nonce, jit-traceable, so the projection
+    stays on the padded/jitted map path."""
+
+    def fn(cols: Dict[str, Any]) -> Dict[str, Any]:
+        out = base_fn(cols)
+        out["__jknonce"] = np.zeros(len(cols["__timestamp"]),
+                                    dtype=np.int64)
+        return out
+
+    return fn
+
+
 def _wrap_record(compiled: List[Tuple[str, Compiled]], passthrough: List[str]
                  ) -> Callable:
     """Build a cols->cols projection fn from compiled items."""
@@ -1531,11 +1584,35 @@ class Planner:
             rpre = [(f"__jk{i}",
                      self._normalize_key(compile_scalar(e, right.schema)))
                     for i, e in enumerate(rkeys)]
-            lstream = left.stream.map(_wrap_record(lpre, lcols),
-                                      name=f"join_lkey_{self._next_id()}")
-            rstream = right.stream.map(_wrap_record(rpre, rcols),
-                                       name=f"join_rkey_{self._next_id()}")
-            jcols = [f"__jk{i}" for i in range(len(lkeys))]
+            # SQL NULL join keys never match — not even each other.  The
+            # key maps append a nonce column that is 0 for valid rows and
+            # UNIQUE per null-keyed row, so null rows hash uniquely:
+            # they pair with nothing, yet still flow through the buffers
+            # and emit null-padded on outer kinds — one mechanism for
+            # every join type.  (The nullable-key maps run as host UDFs:
+            # the nonce counter is Python state a jit trace could not
+            # carry.  All-window joins can't have NULL keys, so they stay
+            # on the jitted map path with a constant-zero nonce.)
+            jks = [f"__jk{i}" for i in range(len(lkeys))]
+            all_window = all(
+                self._is_window_ref(le, left.schema)
+                and self._is_window_ref(re_, right.schema)
+                for le, re_ in pairs)
+            if all_window:
+                lstream = left.stream.map(
+                    _zero_nonce_fn(_wrap_record(lpre, lcols)),
+                    name=f"join_lkey_{self._next_id()}")
+                rstream = right.stream.map(
+                    _zero_nonce_fn(_wrap_record(rpre, rcols)),
+                    name=f"join_rkey_{self._next_id()}")
+            else:
+                lstream = left.stream.udf(
+                    _null_key_nonce_fn(_wrap_record(lpre, lcols), jks),
+                    name=f"join_lkey_{self._next_id()}")
+                rstream = right.stream.udf(
+                    _null_key_nonce_fn(_wrap_record(rpre, rcols), jks),
+                    name=f"join_rkey_{self._next_id()}")
+            jcols = jks + ["__jknonce"]
             lstream = lstream.key_by(*jcols)
             rstream = rstream.key_by(*jcols)
 

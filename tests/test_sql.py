@@ -1432,3 +1432,48 @@ def test_argmax_fusion_bails_on_per_key_max():
     """)
     assert not any("window_argmax" in n for n in prog.graph.nodes)
     assert any("join" in n for n in prog.graph.nodes)
+
+
+def test_null_join_keys_never_match():
+    """SQL NULL join keys match nothing — not even each other (the
+    reference's hash join skips null keys).  Null-keyed rows still
+    emit null-padded on their outer side.  Pre-fix, two NaN keys
+    hashed equal and joined."""
+    from collections import Counter
+
+    from arroyo_tpu.sql.planner import Planner
+    from arroyo_tpu.types import UPDATE_OP_COLUMN, UpdateOp
+
+    def run(kind):
+        provider = SchemaProvider()
+        ts = np.array([0, 1000, 2000], dtype=np.int64)
+        provider.add_memory_table("l", {"a": "f", "x": "i"}, [
+            Batch(ts, {"a": np.array([1.0, np.nan, 3.0]),
+                       "x": np.array([10, 11, 12], np.int64)})])
+        provider.add_memory_table("r", {"a": "f", "y": "i"}, [
+            Batch(ts, {"a": np.array([np.nan, 3.0, 4.0]),
+                       "y": np.array([20, 21, 22], np.int64)})])
+        clear_sink("results")
+        LocalRunner(Planner(provider).plan(
+            f"SELECT l.x AS x, r.y AS y FROM l {kind} JOIN r "
+            "ON l.a = r.a")).run()
+        net = Counter()
+        for b in sink_output("results"):
+            n = len(next(iter(b.columns.values())))
+            ops = (np.asarray(b.columns[UPDATE_OP_COLUMN])
+                   if UPDATE_OP_COLUMN in b.columns
+                   else np.zeros(n, np.int8))
+            for i in range(n):
+                fmt = lambda v: (None if v is None
+                                 or (isinstance(v, float) and np.isnan(v))
+                                 else int(v))
+                row = (fmt(b.columns["x"][i]), fmt(b.columns["y"][i]))
+                net[row] += (-1 if ops[i] == UpdateOp.DELETE.value else 1)
+        return sorted((r for r, c in net.items() for _ in range(c)),
+                      key=repr)
+
+    assert run("") == [(12, 21)]
+    assert run("LEFT") == [(10, None), (11, None), (12, 21)]
+    assert run("RIGHT") == [(12, 21), (None, 20), (None, 22)]
+    assert run("FULL") == [(10, None), (11, None), (12, 21),
+                           (None, 20), (None, 22)]

@@ -138,6 +138,12 @@ def nan_validity(v, m):
     if isinstance(v, np.ndarray) and v.dtype == object:
         nn = np.array([x is not None and x == x for x in v], dtype=bool)
         return nn if m is None else (m & nn)
+    if isinstance(v, np.ndarray) and v.dtype.kind == "f":
+        # numpy fast path: host callers (join-key nonces, the
+        # COUNT(DISTINCT) sort) must not bounce through the default
+        # device — each readback is ~70 ms on a tunneled TPU
+        nn = ~np.isnan(v)
+        return nn if m is None else (m & nn)
     if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
         nn = ~jnp.isnan(v)
         return nn if m is None else (m & nn)

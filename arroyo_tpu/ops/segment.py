@@ -158,10 +158,22 @@ def segment_aggregate(
                 out.append(a.fn(gv) if len(gv) else np.nan)
             distinct_results[a.output] = np.asarray(out)
         elif a.kind == AggKind.COUNT_DISTINCT:
+            from ..formats import nan_validity
+
             v = agg_inputs[a.column][order]
-            pair_sort = np.lexsort((v, kh))
-            kv, vv = kh[pair_sort], v[pair_sort]
-            is_new = np.ones(n, dtype=bool)
+            # SQL excludes NULLs from COUNT(DISTINCT) — and NaN != NaN
+            # would otherwise make every null row its own "distinct"
+            # value
+            ok = nan_validity(v, None)
+            if ok is not None and not np.asarray(ok).all():
+                keep = np.asarray(ok)
+                vv0, kv0 = v[keep], kh[keep]
+            else:
+                vv0, kv0 = v, kh
+            m = len(vv0)
+            pair_sort = np.lexsort((vv0, kv0))
+            kv, vv = kv0[pair_sort], vv0[pair_sort]
+            is_new = np.ones(m, dtype=bool)
             is_new[1:] = (kv[1:] != kv[:-1]) | (vv[1:] != vv[:-1])
             per_key = np.zeros(n_seg, dtype=np.int64)
             np.add.at(per_key, np.searchsorted(uniq, kv[is_new]), 1)

@@ -222,17 +222,19 @@ def _null_key_nonce_fn(base_fn: Callable, jk_cols: List[str]) -> Callable:
 
     def fn(cols: Dict[str, Any]) -> Dict[str, Any]:
         out = base_fn(cols)
+        from ..formats import nan_validity
+
         n = len(np.asarray(cols["__timestamp"]))
         nullmask = np.zeros(n, dtype=bool)
         for c in jk_cols:
             v = np.asarray(out[c])
             out[c] = v  # keep the host copy: downstream must not convert again
-            if v.dtype.kind == "f":
-                nullmask |= np.isnan(v)
-            elif v.dtype == object:
-                nullmask |= np.fromiter(
-                    (x is None or (isinstance(x, float) and np.isnan(x))
-                     for x in v), dtype=bool, count=n)
+            # route through THE null definition (formats.nan_validity) so
+            # the nonce cannot drift from IS NULL semantics (e.g. object
+            # cells holding np.float32 NaN)
+            ok = nan_validity(v, None)
+            if ok is not None:
+                nullmask |= ~np.asarray(ok)
         nonce = np.zeros(n, dtype=np.int64)
         if nullmask.any():
             idx = nullmask.nonzero()[0]
@@ -1337,12 +1339,19 @@ class Planner:
                 compile_scalar(ColumnRef(sub_cols[0]), sub.schema))
             lcols = [c for c in planned.schema.columns
                      if not c.startswith("__")]
-            lstream = planned.stream.map(
-                _wrap_record([("__sk", lkey)], lcols),
-                name=f"semi_lkey_{self._next_id()}").key_by("__sk")
-            rstream = sub.stream.map(
-                _wrap_record([("__sk", rkey)], []),
-                name=f"semi_rkey_{self._next_id()}").key_by("__sk")
+            # NULL semantics match the join path: `NULL IN (...)` is
+            # never TRUE, so null keys on either side get unique nonces
+            # and can never pair
+            lstream = planned.stream.udf(
+                _null_key_nonce_fn(_wrap_record([("__sk", lkey)], lcols),
+                                   ["__sk"]),
+                name=f"semi_lkey_{self._next_id()}").key_by("__sk",
+                                                            "__jknonce")
+            rstream = sub.stream.udf(
+                _null_key_nonce_fn(_wrap_record([("__sk", rkey)], []),
+                                   ["__sk"]),
+                name=f"semi_rkey_{self._next_id()}").key_by("__sk",
+                                                            "__jknonce")
             out = lstream.join_with_expiration(
                 rstream, DEFAULT_JOIN_TTL, DEFAULT_JOIN_TTL, JoinType.SEMI,
                 name=f"semi_join_{self._next_id()}")

@@ -1477,3 +1477,45 @@ def test_null_join_keys_never_match():
     assert run("RIGHT") == [(12, 21), (None, 20), (None, 22)]
     assert run("FULL") == [(10, None), (11, None), (12, 21),
                            (None, 20), (None, 22)]
+
+
+def test_count_distinct_excludes_nulls():
+    """COUNT(DISTINCT x) must not count NULLs — and NaN != NaN made
+    every null row its own 'distinct' value (returned 5, not 3)."""
+    from arroyo_tpu.sql.planner import Planner
+
+    provider = SchemaProvider()
+    ts = np.arange(6, dtype=np.int64) * 1000
+    provider.add_memory_table("t", {"k": "i", "v": "f"}, [
+        Batch(ts, {"k": np.zeros(6, np.int64),
+                   "v": np.array([1.0, 2.0, np.nan, 2.0, np.nan, 3.0])})])
+    clear_sink("results")
+    LocalRunner(Planner(provider).plan("""
+    SELECT k, TUMBLE(INTERVAL '1' SECOND) AS window,
+           count(DISTINCT v) AS d, count(v) AS c, count(*) AS s
+    FROM t GROUP BY 1, 2""")).run()
+    b = Batch.concat(sink_output("results"))
+    assert int(b.columns["d"][0]) == 3
+    assert int(b.columns["c"][0]) == 4
+    assert int(b.columns["s"][0]) == 6
+
+
+def test_in_subquery_null_never_matches():
+    """`x IN (SELECT ...)` is never TRUE for NULL x, and a NULL in the
+    subquery matches nothing (same NaN-hash defect class as the join
+    fix; semi joins route through the same nonce mechanism)."""
+    from arroyo_tpu.sql.planner import Planner
+
+    provider = SchemaProvider()
+    ts = np.arange(3, dtype=np.int64) * 1000
+    provider.add_memory_table("l", {"a": "f", "x": "i"}, [
+        Batch(ts, {"a": np.array([1.0, np.nan, 3.0]),
+                   "x": np.array([10, 11, 12], np.int64)})])
+    provider.add_memory_table("r", {"b": "f"}, [
+        Batch(ts, {"b": np.array([np.nan, 3.0, 4.0])})])
+    clear_sink("results")
+    LocalRunner(Planner(provider).plan(
+        "SELECT x FROM l WHERE a IN (SELECT b FROM r)")).run()
+    got = sorted(int(v) for b in sink_output("results")
+                 for v in b.columns["x"])
+    assert got == [12], got  # NaN 'in' {NaN, ...} must NOT match

@@ -1207,8 +1207,9 @@ class NonWindowAggOperator(Operator):
                 new = agg_cols[a.output][i]
                 # an all-null segment contributes nothing to the running
                 # aggregate (NaN marks SQL NULL from segment_aggregate)
-                new_null = (isinstance(new, (float, np.floating))
-                            and np.isnan(new))
+                new_null = (new is None
+                            or (isinstance(new, (float, np.floating))
+                                and np.isnan(new)))
                 if a.kind == AggKind.AVG:
                     # mergeable avg: store (sum, non-null count) internally
                     nv = int(valid_counts[a.output][i])
@@ -1224,8 +1225,9 @@ class NonWindowAggOperator(Operator):
                     merged[a.output] = new
                 else:
                     old = prev[a.output]
-                    old_null = (isinstance(old, (float, np.floating))
-                                and np.isnan(old))
+                    old_null = (old is None
+                                or (isinstance(old, (float, np.floating))
+                                    and np.isnan(old)))
                     if new_null:
                         merged[a.output] = old
                     elif old_null:

@@ -133,30 +133,51 @@ def segment_aggregate(
     # exclusion of non-mergeable aggregates, operators.rs:165-167)
     distinct_results: Dict[str, np.ndarray] = {}
     device_aggs = []
+
+    def _host_segments(column: np.ndarray):
+        """(values-in-order, per-row validity, per-segment row groups) —
+        the shared scaffolding for every host-reduced aggregate (UDAFs,
+        string MIN/MAX)."""
+        from ..formats import nan_validity
+
+        v = column[order]
+        ok = nan_validity(v, None)
+        ok_rows = (np.ones(len(v), dtype=bool) if ok is None
+                   else np.asarray(ok))
+        return v, ok_rows, np.split(np.arange(n), seg_start[1:])
+
     for a in aggs:
         if a.kind == AggKind.UDAF:
             # user aggregate: per-segment host call over non-null values
             # (non-mergeable — only reachable via buffered window paths,
             # like the reference's wasm UDFs, operators/mod.rs:347-494)
-            from ..formats import nan_validity
-
-            v = agg_inputs[a.column][order]
-            if a.fn is np.median and np.asarray(v).dtype.kind in "if":
+            if (a.fn is np.median
+                    and np.asarray(agg_inputs[a.column]).dtype.kind in "if"):
                 # vectorized across ALL segments: one in-segment sort,
                 # then middle-element picks — NaNs sort last inside each
                 # segment, so the non-null count bounds the true middle
                 distinct_results[a.output] = _segmented_median(
-                    np.asarray(v, dtype=np.float64), kh, uniq, seg_start)
+                    np.asarray(agg_inputs[a.column][order],
+                               dtype=np.float64), kh, uniq, seg_start)
                 continue
-            ok = nan_validity(v, None)
-            ok_rows = (np.ones(len(v), dtype=bool) if ok is None
-                       else np.asarray(ok))
-            groups = np.split(np.arange(n), seg_start[1:])
+            v, ok_rows, groups = _host_segments(agg_inputs[a.column])
             out = []
             for g in groups:
                 gv = v[g[ok_rows[g]]]
                 out.append(a.fn(gv) if len(gv) else np.nan)
             distinct_results[a.output] = np.asarray(out)
+        elif (a.kind in (AggKind.MIN, AggKind.MAX)
+              and np.asarray(agg_inputs[a.column]).dtype == object):
+            # string MIN/MAX (lexicographic, NULLs skipped): object
+            # columns can't ride the f64 device channels — per-segment
+            # host reduce, like the reference's accumulator for Utf8
+            v, ok_rows, groups = _host_segments(agg_inputs[a.column])
+            pick = min if a.kind == AggKind.MIN else max
+            outv = []
+            for g in groups:
+                gv = v[g[ok_rows[g]]]
+                outv.append(pick(gv) if len(gv) else None)
+            distinct_results[a.output] = np.asarray(outv, dtype=object)
         elif a.kind == AggKind.COUNT_DISTINCT:
             from ..formats import nan_validity
 

@@ -409,8 +409,33 @@ class ExprCompiler:
         import operator as pyop
 
         ops = {"+": pyop.add, "-": pyop.sub, "*": pyop.mul,
-               "%": pyop.mod, "=": pyop.eq, "<>": pyop.ne, "<": pyop.lt,
+               "=": pyop.eq, "<>": pyop.ne, "<": pyop.lt,
                "<=": pyop.le, ">": pyop.gt, ">=": pyop.ge}
+
+        def _is_int(v):
+            if isinstance(v, (bool, np.bool_)):
+                return False
+            if isinstance(v, (int, np.integer)):
+                return True
+            if hasattr(v, "dtype"):
+                return np.issubdtype(np.asarray(v).dtype, np.integer) \
+                    if isinstance(v, np.ndarray) \
+                    else jnp.issubdtype(v.dtype, jnp.integer)
+            return False
+
+        def _trunc_divmod(lv, rv):
+            """(quotient, remainder, zero_mask) with SQL TRUNCATION
+            semantics (-7/2 = -3, -7%2 = -1 — python floor-divides) and
+            a divisor==0 mask for NULL results.  Pure arithmetic only,
+            so numpy inputs stay on host and tracers stay traced."""
+            zero = rv == 0
+            if isinstance(zero, bool):  # python scalar divisor
+                zero = np.bool_(zero)
+            sr = rv + zero  # divisor 0 -> 1 (never used: row masked NULL)
+            q0 = lv // sr
+            rem = lv - q0 * sr
+            q = q0 + ((rem != 0) & ((lv < 0) ^ (sr < 0)))
+            return q, lv - q * sr, zero
 
         if op == "||":
             self.needs_host = True
@@ -430,18 +455,37 @@ class ExprCompiler:
                 lv, lm = left(env)
                 rv, rm = right(env)
                 m = _mask_and(lm, rm)
-                # SQL integer division stays integral
-                l_int = np.issubdtype(np.asarray(lv).dtype, np.integer) \
-                    if not hasattr(lv, "dtype") or isinstance(lv, np.ndarray) \
-                    else jnp.issubdtype(lv.dtype, jnp.integer)
-                r_int = np.issubdtype(np.asarray(rv).dtype, np.integer) \
-                    if not hasattr(rv, "dtype") or isinstance(rv, np.ndarray) \
-                    else jnp.issubdtype(rv.dtype, jnp.integer)
-                if l_int and r_int:
-                    return lv // jnp.maximum(rv, 1) if hasattr(rv, "dtype") \
-                        else lv // rv, m
+                # SQL integer division stays integral, TRUNCATES toward
+                # zero, and yields NULL on a zero divisor (the previous
+                # jnp.maximum(rv, 1) guard silently clamped EVERY
+                # divisor below 1 — 10/0 returned 10 and 10/-2 returned
+                # 10)
+                if _is_int(lv) and _is_int(rv):
+                    q, _, zero = _trunc_divmod(lv, rv)
+                    return q, _mask_and(m, ~zero)
                 return lv / rv, m
             return div
+
+        if op == "%":
+            def mod(env):
+                lv, lm = left(env)
+                rv, rm = right(env)
+                m = _mask_and(lm, rm)
+                if _is_int(lv) and _is_int(rv):
+                    # SQL % carries the DIVIDEND's sign (-7 % 2 = -1;
+                    # python floors to 1) and is NULL on a zero divisor
+                    _, rem, zero = _trunc_divmod(lv, rv)
+                    return rem, _mask_and(m, ~zero)
+                # float %: IEEE fmod matches SQL (np.mod floors);
+                # fmod(x, 0) is NaN, i.e. SQL NULL, natively
+
+                def is_jax(v):
+                    return (hasattr(v, "dtype")
+                            and not isinstance(v, (np.ndarray, np.generic)))
+
+                f = jnp.fmod if (is_jax(lv) or is_jax(rv)) else np.fmod
+                return f(lv, rv), m
+            return mod
 
         fn = ops[op]
 

@@ -1001,6 +1001,7 @@ class Planner:
         aggs: List[AggSpec] = []
         post_fixups: Dict[str, Tuple[str, str]] = {}  # out -> (sum_col, cnt_col)
         int_outputs: List[str] = []
+        str_outputs: List[str] = []
         needs_generic = isinstance(window, SessionWindow)
         for j, fc in enumerate(collector.aggs):
             out = f"__agg{j}"
@@ -1045,6 +1046,21 @@ class Planner:
             c = compile_scalar(arg, schema)
             col = f"__ain{j}"
             kind = AggKind[fc.name.upper()]
+            if self._infer_kind(arg, schema) == "s":
+                # string aggregates: MIN/MAX are well-defined
+                # (lexicographic, like the reference's DataFusion) but
+                # not bin-mergeable as f64 — route to the buffered path,
+                # where segment_aggregate host-reduces object columns.
+                # SUM/AVG over strings are type errors at plan time.
+                if kind not in (AggKind.MIN, AggKind.MAX):
+                    raise SqlPlanError(
+                        f"{fc.name}() is not defined for string "
+                        "arguments")
+                needs_generic = True
+                pre_compiled.append((col, c))
+                aggs.append(AggSpec(kind, col, out))
+                str_outputs.append(out)
+                continue
             fill = {"sum": 0.0, "avg": 0.0, "min": float("inf"),
                     "max": float("-inf")}[fc.name]
             pre_compiled.append((col, self._mask_fill(c, fill)))
@@ -1104,7 +1120,8 @@ class Planner:
             mid_schema.columns[col] = key_kinds.get(col, "n")
         for j, a in enumerate(aggs):
             mid_schema.columns[a.output] = (
-                "i" if a.output in int_outputs else "f")
+                "i" if a.output in int_outputs
+                else "s" if a.output in str_outputs else "f")
         windowed_out = window is not None or grouped_by_window
         if windowed_out:
             mid_schema.columns["window_start"] = "t"

@@ -1519,3 +1519,80 @@ def test_in_subquery_null_never_matches():
     got = sorted(int(v) for b in sink_output("results")
                  for v in b.columns["x"])
     assert got == [12], got  # NaN 'in' {NaN, ...} must NOT match
+
+
+def test_sql_division_modulo_semantics():
+    """SQL integer division TRUNCATES toward zero, % carries the
+    dividend's sign, and both are NULL on a zero divisor.  Pre-fix,
+    the jnp.maximum(rv, 1) guard silently clamped EVERY divisor below
+    one: 10/0 returned 10 and 10/-2 returned 10."""
+    from arroyo_tpu.sql.planner import Planner
+
+    provider = SchemaProvider()
+    ts = np.arange(6, dtype=np.int64) * 1000
+    provider.add_memory_table("t", {"a": "i", "b": "i"}, [
+        Batch(ts, {"a": np.array([10, 10, -7, -7, 10, 7], np.int64),
+                   "b": np.array([4, 0, 2, -2, -2, 2], np.int64)})])
+    clear_sink("results")
+    LocalRunner(Planner(provider).plan(
+        "SELECT a / b AS q, a % b AS r FROM t")).run()
+    rows = []
+    for batch in sink_output("results"):
+        for i in range(len(batch.columns["q"])):
+            fmt = lambda v: (None if isinstance(v, float) and np.isnan(v)
+                             else int(v))
+            rows.append((fmt(batch.columns["q"][i]),
+                         fmt(batch.columns["r"][i])))
+    assert rows == [(2, 2), (None, None), (-3, -1), (3, -1), (-5, 0),
+                    (3, 1)], rows
+
+
+def test_string_min_max_aggregates():
+    """MIN/MAX over strings (lexicographic, NULLs skipped) run on the
+    buffered window path's host reduce; SUM/AVG over strings are plan-
+    time type errors.  Pre-fix, MIN(string) crashed the worker task
+    mid-stream with a float-coercion error."""
+    from arroyo_tpu.sql.planner import Planner
+
+    provider = SchemaProvider()
+    ts = np.arange(4, dtype=np.int64) * 1000
+    provider.add_memory_table("t", {"s": "s", "v": "i"}, [
+        Batch(ts, {"s": np.array(["b", "a", None, "c"], dtype=object),
+                   "v": np.array([4, 0, 2, 1], np.int64)})])
+    clear_sink("results")
+    LocalRunner(Planner(provider).plan("""
+    SELECT TUMBLE(INTERVAL '1' SECOND) AS window,
+           min(s) AS lo, max(s) AS hi, count(*) AS c
+    FROM t GROUP BY 1""")).run()
+    b = Batch.concat(sink_output("results"))
+    assert b.columns["lo"][0] == "a"
+    assert b.columns["hi"][0] == "c"
+    assert int(b.columns["c"][0]) == 4
+    from arroyo_tpu.sql import SqlPlanError
+
+    with pytest.raises(SqlPlanError, match="not defined for string"):
+        Planner(provider).plan(
+            "SELECT TUMBLE(INTERVAL '1' SECOND) AS w, sum(s) AS x "
+            "FROM t GROUP BY 1")
+
+
+def test_string_min_max_non_windowed():
+    """Non-windowed GROUP BY string MIN/MAX merges refinements across
+    batches, including an all-NULL first segment (pre-fix: min('b',
+    None) raised TypeError mid-stream)."""
+    from arroyo_tpu.sql.planner import Planner
+
+    provider = SchemaProvider()
+    provider.add_memory_table("t", {"k": "i", "s": "s"}, [
+        Batch(np.array([0], np.int64),
+              {"k": np.array([1], np.int64),
+               "s": np.array([None], dtype=object)}),
+        Batch(np.array([1000], np.int64),
+              {"k": np.array([1], np.int64),
+               "s": np.array(["b"], dtype=object)})])
+    clear_sink("results")
+    LocalRunner(Planner(provider).plan(
+        "SELECT k, min(s) AS lo FROM t GROUP BY k")).run()
+    vals = [b.columns["lo"][i] for b in sink_output("results")
+            for i in range(len(b.columns["lo"]))]
+    assert vals[-1] == "b", vals  # final refinement carries the value

@@ -272,6 +272,16 @@ class ExprCompiler:
                         v, m2 = _coerce_object_col(v)
                         m = m2 if m is None else (
                             m if m2 is None else (m & m2))
+                    elif (_s and isinstance(v, np.ndarray)
+                            and v.dtype == object):
+                        # string NULLs (None cells) must carry validity:
+                        # without a mask, None == None compared TRUE and
+                        # `WHERE s = s` kept NULL rows (SQL: NULL = NULL
+                        # is NULL, never true).  All-valid columns skip
+                        # the mask so plain projections stay zero-copy.
+                        nn = np.asarray(nan_validity(v, None))
+                        if not nn.all():
+                            m = nn if m is None else (m & nn)
                     if _p is not None:
                         pm = env[_p[0]] == _p[1]
                         m = pm if m is None else (m & pm)
@@ -536,11 +546,30 @@ class ExprCompiler:
 
         if t in ("int", "integer", "bigint", "smallint", "tinyint"):
             def toint(env):
+                # float NaN is the in-band NULL; an int64 cast cannot
+                # carry it, so it moves into the validity mask (it used
+                # to cast to 0 silently).  A float source ALWAYS yields
+                # a masked (nullable) int on both host and jit paths —
+                # the engine-wide nullable-int-as-f64 convention — so
+                # the two modalities cannot disagree on output dtype.
+                # Null detection routes through nan_validity, THE single
+                # null definition.
                 v, m = inner(env)
                 if isinstance(v, np.ndarray) and v.dtype == object:
-                    return np.asarray([int(float(x)) for x in v],
-                                      dtype=np.int64), m
-                return jnp.asarray(v).astype(jnp.int64), m
+                    nn = np.asarray(nan_validity(v, None))
+                    vals = np.asarray(
+                        [int(float(x)) if ok else 0
+                         for x, ok in zip(v, nn)], dtype=np.int64)
+                    return vals, (nn if m is None else (m & nn))
+                is_np = isinstance(v, np.ndarray) or not hasattr(v, "dtype")
+                arr = np.asarray(v) if is_np else v
+                xp = np if is_np else jnp
+                if (arr.dtype.kind == "f" if is_np
+                        else jnp.issubdtype(arr.dtype, jnp.floating)):
+                    nn = nan_validity(arr, None)
+                    arr = xp.where(xp.asarray(nn), arr, 0.0)
+                    m = nn if m is None else (m & nn)
+                return arr.astype(xp.int64), m
             return toint
         if t in ("float", "double", "real", "decimal", "numeric"):
             def tofloat(env):

@@ -1596,3 +1596,35 @@ def test_string_min_max_non_windowed():
     vals = [b.columns["lo"][i] for b in sink_output("results")
             for i in range(len(b.columns["lo"]))]
     assert vals[-1] == "b", vals  # final refinement carries the value
+
+
+def test_string_null_semantics_in_expressions():
+    """String NULLs carry validity through expressions: NULL = NULL is
+    never TRUE (WHERE s = s filters NULL rows), NULL LIKE and
+    upper(NULL) are NULL, and CAST of a NULL float is NULL, not 0."""
+    from arroyo_tpu.sql.planner import Planner
+
+    provider = SchemaProvider()
+    ts = np.arange(3, dtype=np.int64) * 1000
+    provider.add_memory_table("t", {"v": "f", "s": "s"}, [
+        Batch(ts, {"v": np.array([1.5, np.nan, -2.5]),
+                   "s": np.array(["abc", None, "xbc"], dtype=object)})])
+
+    def run(sql):
+        clear_sink("results")
+        LocalRunner(Planner(provider).plan(sql)).run()
+        out = []
+        for b in sink_output("results"):
+            for i in range(len(next(iter(b.columns.values())))):
+                x = next(iter(b.columns.values()))[i]
+                out.append(None if x is None
+                           or (isinstance(x, float) and np.isnan(x))
+                           else x)
+        return out
+
+    assert len(run("SELECT v FROM t WHERE s = s")) == 2  # NULL row drops
+    assert run("SELECT s LIKE 'a%' AS a FROM t") == [True, None, False]
+    assert run("SELECT upper(s) AS u FROM t") == ["ABC", None, "XBC"]
+    assert len(run("SELECT s FROM t WHERE s IS NULL")) == 1
+    got = run("SELECT CAST(v AS BIGINT) AS a FROM t")
+    assert [None if g is None else int(g) for g in got] == [1, None, -2]

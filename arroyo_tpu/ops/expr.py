@@ -189,6 +189,12 @@ def eval_predicate(expr: CompiledExpr, batch: Batch) -> np.ndarray:
     mask = np.asarray(out)
     assert mask.dtype == np.bool_ or np.issubdtype(mask.dtype, np.bool_), (
         f"predicate {expr.name} must return bool")
+    if mask.ndim == 0:
+        # constant predicate (e.g. a now()-only comparison): broadcast
+        # to the batch — Batch.select(scalar_bool) would otherwise
+        # numpy-index every column into a dimension-lifted (1, n) shape
+        # that crashes the next operator's padding
+        return np.full(len(batch), bool(mask))
     return mask[:n]
 
 

@@ -554,6 +554,19 @@ class Parser:
 
     def parse_function(self, name: str) -> FunctionCall:
         self.expect_op("(")
+        if name.lower() == "extract" and self.peek().kind != "string":
+            # standard SQL EXTRACT(field FROM expr): the field is a bare
+            # keyword, normalized to the two-arg call form
+            # extract('field', expr) the compiler already handles (a
+            # leading string literal means the two-arg form — fall
+            # through to generic arg parsing)
+            field = self.expect_ident()
+            self.expect_kw("from")
+            operand = self.parse_expr()
+            self.expect_op(")")
+            return FunctionCall("extract",
+                                [Literal(field.lower(), "string"),
+                                 operand], False, None)
         distinct = self.eat_kw("distinct")
         args: List[Expr] = []
         if not self.at_op(")"):

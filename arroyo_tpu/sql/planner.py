@@ -779,6 +779,11 @@ class Planner:
     def _host_filter(pred_fn):
         def fn(cols):
             mask = np.asarray(pred_fn(cols)).astype(bool)
+            if mask.ndim == 0:
+                # constant predicate (e.g. a now()-only comparison):
+                # indexing columns with a scalar bool would dimension-
+                # lift every column to (1, n) and crash downstream
+                mask = np.full(len(cols["__timestamp"]), bool(mask))
             return {k: np.asarray(v)[mask] for k, v in cols.items()}
 
         return fn

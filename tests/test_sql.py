@@ -1628,3 +1628,35 @@ def test_string_null_semantics_in_expressions():
     assert len(run("SELECT s FROM t WHERE s IS NULL")) == 1
     got = run("SELECT CAST(v AS BIGINT) AS a FROM t")
     assert [None if g is None else int(g) for g in got] == [1, None, -2]
+
+
+def test_extract_from_form_and_constant_predicates():
+    """Standard SQL EXTRACT(field FROM expr) parses (normalizing to the
+    two-arg form), and constant WHERE predicates (now()-only
+    comparisons) broadcast their scalar mask instead of dimension-
+    lifting every column to (1, n) and crashing the next operator."""
+    from arroyo_tpu.sql.planner import Planner
+
+    provider = SchemaProvider()
+    base = 1_700_000_000_000_000
+    ts = np.array([base, base + 2_500_000], dtype=np.int64)
+    provider.add_memory_table("t", {"k": "i"}, [
+        Batch(ts, {"k": np.array([1, 2], np.int64)})])
+
+    clear_sink("results")
+    LocalRunner(Planner(provider).plan("""
+    SELECT extract(minute FROM window_end) AS m, count(*) AS c
+    FROM t GROUP BY TUMBLE(INTERVAL '1' MINUTE)""")).run()
+    b = Batch.concat(sink_output("results"))
+    assert len(b) >= 1 and int(b.columns["c"].sum()) == 2
+
+    for sql, exp in [
+        ("SELECT k FROM t WHERE date_trunc('minute', now()) > "
+         "now() - INTERVAL '1' HOUR", 2),
+        ("SELECT k FROM t WHERE now() < now() - INTERVAL '1' HOUR", 0),
+    ]:
+        clear_sink("results")
+        LocalRunner(Planner(provider).plan(sql)).run()
+        got = sum(len(bb.columns.get("k", []))
+                  for bb in sink_output("results"))
+        assert got == exp, (sql, got)

@@ -1,0 +1,15 @@
+#!/bin/bash
+cd /root/repo
+for i in $(seq 1 120); do
+  if timeout 90 python -c "import jax; assert jax.default_backend() == 'tpu'" 2>/dev/null; then
+    echo "tunnel alive at attempt $i, $(date)" >> /tmp/tunnel_watch.log
+    timeout 3000 python bench.py > /root/repo/BENCH_TPU_FUSED_r04.json 2>/tmp/bench_fused_tpu.err
+    rc=$?
+    echo "bench rc=$rc at $(date)" >> /tmp/tunnel_watch.log
+    if [ $rc -ne 0 ]; then rm -f /root/repo/BENCH_TPU_FUSED_r04.json; continue; fi
+    exit 0
+  fi
+  echo "attempt2 $i down $(date)" >> /tmp/tunnel_watch.log
+  sleep 400
+done
+exit 1

@@ -193,7 +193,10 @@ def eval_predicate(expr: CompiledExpr, batch: Batch) -> np.ndarray:
         # constant predicate (e.g. a now()-only comparison): broadcast
         # to the batch — Batch.select(scalar_bool) would otherwise
         # numpy-index every column into a dimension-lifted (1, n) shape
-        # that crashes the next operator's padding
+        # that crashes the next operator's padding.  (Mirrored in
+        # planner._host_filter for the host path — the two sites cannot
+        # share code because this one receives post-trace output while
+        # that one runs eagerly inside the UDF.)
         return np.full(len(batch), bool(mask))
     return mask[:n]
 

@@ -783,6 +783,8 @@ class Planner:
                 # constant predicate (e.g. a now()-only comparison):
                 # indexing columns with a scalar bool would dimension-
                 # lift every column to (1, n) and crash downstream
+                # (mirrored in ops/expr.eval_predicate for the jitted
+                # path; see the note there on why the sites are split)
                 mask = np.full(len(cols["__timestamp"]), bool(mask))
             return {k: np.asarray(v)[mask] for k, v in cols.items()}
 

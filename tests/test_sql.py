@@ -1660,3 +1660,26 @@ def test_extract_from_form_and_constant_predicates():
         got = sum(len(bb.columns.get("k", []))
                   for bb in sink_output("results"))
         assert got == exp, (sql, got)
+
+
+def test_json_sink_int64_and_null_fidelity(tmp_path):
+    """BIGINTs above 2^53 survive the JSON sink exactly (a float round-
+    trip would corrupt them) and NULL strings serialize as JSON null."""
+    import json as _json
+
+    from arroyo_tpu.sql.planner import Planner
+
+    provider = SchemaProvider()
+    big = 2 ** 62 + 12345
+    ts = np.array([0, 1000], dtype=np.int64)
+    provider.add_memory_table("t", {"k": "i", "s": "s"}, [
+        Batch(ts, {"k": np.array([big, 7], np.int64),
+                   "s": np.array(["x", None], dtype=object)})])
+    out = str(tmp_path / "out.jsonl")
+    LocalRunner(Planner(provider).plan(f"""
+    CREATE TABLE sinkt (k BIGINT, s TEXT) WITH (
+      connector = 'single_file', path = '{out}', type = 'sink');
+    INSERT INTO sinkt SELECT k, s FROM t""")).run()
+    rows = [_json.loads(line) for line in open(out)]
+    assert rows[0]["k"] == big
+    assert rows[1]["s"] is None

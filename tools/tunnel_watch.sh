@@ -1,15 +1,27 @@
 #!/bin/bash
+# Probe the axon tunnel every ~7 min for the rest of the round; on
+# recovery run the full bench ONCE and land the artifact in the repo
+# root so the driver's end-of-round auto-commit captures it.  The
+# artifact is written to a temp path and moved into place only on
+# success, so a killed or failed run can never leave a partial JSON
+# that reads as a genuine capture; bench failures back off like probe
+# failures instead of burning the attempt budget.
 cd /root/repo
 for i in $(seq 1 120); do
   if timeout 90 python -c "import jax; assert jax.default_backend() == 'tpu'" 2>/dev/null; then
     echo "tunnel alive at attempt $i, $(date)" >> /tmp/tunnel_watch.log
-    timeout 3000 python bench.py > /root/repo/BENCH_TPU_FUSED_r04.json 2>/tmp/bench_fused_tpu.err
+    tmp=$(mktemp /tmp/bench_fused.XXXXXX)
+    timeout 3000 python bench.py > "$tmp" 2>/tmp/bench_fused_tpu.err
     rc=$?
     echo "bench rc=$rc at $(date)" >> /tmp/tunnel_watch.log
-    if [ $rc -ne 0 ]; then rm -f /root/repo/BENCH_TPU_FUSED_r04.json; continue; fi
-    exit 0
+    if [ $rc -eq 0 ] && python -c "import json,sys; json.load(open(sys.argv[1]))" "$tmp" 2>/dev/null; then
+      mv "$tmp" /root/repo/BENCH_TPU_FUSED_r04.json
+      exit 0
+    fi
+    rm -f "$tmp"
+  else
+    echo "attempt $i down $(date)" >> /tmp/tunnel_watch.log
   fi
-  echo "attempt2 $i down $(date)" >> /tmp/tunnel_watch.log
   sleep 400
 done
 exit 1

@@ -122,6 +122,27 @@ class NexmarkGenerator:
     def set_rate(self, rate: float, n_generators: int) -> None:
         self.inter_event_delay = max(int(1_000_000.0 / rate * n_generators), 1)
 
+    # -- RNG stream snapshot (exactly-once resume) -------------------------
+    # The per-family streams advance as generation runs, so a resumed
+    # generator must land every stream in the exact position the
+    # delivered prefix left it — otherwise post-restore events differ
+    # from the uninterrupted run.  Snapshotting the PCG64 states gives
+    # O(1) restore (the alternative, replay-burning the prefix, is kept
+    # as the fallback for checkpoints written before states were saved).
+
+    def snapshot_rng_state(self) -> Dict[str, Any]:
+        states = {fam: rng.bit_generator.state
+                  for fam, rng in self._rngs.items()}
+        states["__base"] = self.rng.bit_generator.state
+        return states
+
+    def restore_rng_state(self, states: Dict[str, Any]) -> None:
+        for fam, rng in self._rngs.items():
+            if fam in states:
+                rng.bit_generator.state = states[fam]
+        if "__base" in states:
+            self.rng.bit_generator.state = states["__base"]
+
     @property
     def has_next(self) -> bool:
         return self.events_so_far < self.max_events
@@ -396,8 +417,10 @@ class NexmarkSource(SourceOperator):
         state = ctx.state.get_global_keyed_state("s")
         saved = state.get(ctx.task_info.task_index)
         par = ctx.task_info.parallelism
+        rng_states = None
         if saved is not None:
-            base_time, split, count = saved
+            base_time, split, count = saved[:3]
+            rng_states = saved[3] if len(saved) > 3 else None
         else:
             base_time = (self.cfg.base_time_micros
                          if self.cfg.base_time_micros is not None
@@ -408,9 +431,29 @@ class NexmarkSource(SourceOperator):
         gen = NexmarkGenerator(self.cfg, base_time, split[0], split[1], split[2],
                                seed=ctx.task_info.task_index)
         gen.set_rate(self.cfg.event_rate, par)
-        gen.events_so_far = count
 
         batch_size = self.cfg.batch_size or config().target_batch_size
+        if count and rng_states is not None:
+            # O(1) resume: land every RNG stream in the exact position
+            # the delivered prefix left it (see snapshot_rng_state)
+            gen.restore_rng_state(rng_states)
+            gen.events_so_far = count
+        elif count:
+            # Pre-snapshot checkpoint: replay-burn to the position.
+            # Draws are blocked per call site within each generated
+            # batch, so the burn must regenerate with the SAME batch
+            # size the original delivery used — then every stream lands
+            # exactly where the uninterrupted run would have it.  Cost:
+            # one vectorized pass over the already-delivered prefix.
+            while gen.events_so_far < count and gen.has_next:
+                gen.next_batch(min(batch_size, count - gen.events_so_far))
+            if gen.events_so_far != count:
+                raise RuntimeError(
+                    f"nexmark resume burn landed at {gen.events_so_far}, "
+                    f"checkpoint says {count}: the table's num_events/"
+                    "batch_size config changed since the checkpoint was "
+                    "written — the resumed stream would not be the "
+                    "delivered stream")
         runner = getattr(ctx, "_runner", None)
         wall_base = _time.monotonic() - (gen.inter_event_delay * count) / 1e6
         from ..obs import perf
@@ -429,7 +472,10 @@ class NexmarkSource(SourceOperator):
 
         def gen_next():
             b, nums = gen.next_batch(batch_size)
-            return b, nums, gen.events_so_far
+            # RNG states are captured WITH the count at generation time,
+            # so a barrier between emit and prefetch checkpoints a
+            # consistent (count, stream-position) pair
+            return b, nums, gen.events_so_far, gen.snapshot_rng_state()
 
         # emission log for the latency bench: (cummax event time, wall) per
         # batch — latency is then measured against when the watermark-

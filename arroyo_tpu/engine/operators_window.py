@@ -808,23 +808,111 @@ class WindowArgmaxOperator(Operator):
 
     def __init__(self, name: str, value_col: str, minmax: str,
                  synth_cols: Tuple[Tuple[str, str], ...],
-                 width_micros: int):
+                 width_micros: int, raw: bool = False,
+                 late_ttl_micros: int = 0):
         super().__init__(name)
         self.value_col = value_col
         self.minmax = minmax
         self.synth_cols = synth_cols
         self.width = max(int(width_micros), 1)
+        self.raw = raw
+        # raw mode must bound the final-extrema table: with no TTL the
+        # table would grow one entry per window forever (the SQL planner
+        # always passes the join TTL it replaced; direct Stream API users
+        # who omit it get one window span — the tightest bound that
+        # still catches in-flight stragglers)
+        self.late_ttl = (max(int(late_ttl_micros), self.width)
+                         if raw else max(int(late_ttl_micros), 0))
+        # raw mode: per-window running extremum for the admission
+        # pre-filter.  Memory only — on restore the buffer holds exactly
+        # the rows that survived the filter, so an empty dict merely
+        # means the first post-restore batch per window is admitted
+        # unfiltered (correctness never depends on it)
+        self._running: Dict[int, float] = {}
+        self._released_wm: Optional[int] = None
 
     def tables(self) -> List[TableDescriptor]:
-        return [TableDescriptor("b", TableType.BATCH_BUFFER,
-                                "per-window candidate rows",
-                                retention_micros=self.width)]
+        tables = [TableDescriptor("b", TableType.BATCH_BUFFER,
+                                  "per-window candidate rows",
+                                  retention_micros=self.width)]
+        if self.raw:
+            # released windows' FINAL extrema, retained for the TTL of
+            # the join this fusion replaced: a genuinely-late row still
+            # matches exactly as it would have against the TTL'd max row
+            tables.append(TableDescriptor(
+                "f", TableType.TIME_KEY_MAP,
+                "released-window final extrema",
+                retention_micros=self.late_ttl))
+        return tables
 
     async def on_start(self, ctx: Context) -> None:
         self.buf = ctx.state.get_batch_buffer("b")
+        self.final = (ctx.state.get_time_key_map("f") if self.raw
+                      else None)
+        if ctx.last_watermark is not None:
+            # windows at or below the checkpoint watermark fired before
+            # the crash; re-arming the guard keeps a late replayed row
+            # from re-emitting a whole partial duplicate window (late
+            # rows instead match the persisted final extrema)
+            self._released_wm = ctx.last_watermark
+
+    async def _admit(self, batch: Batch, ctx: Context) -> Optional[Batch]:
+        """Raw mode admission: SQL-NULL values drop (they never equal an
+        extremum); rows of already-released windows match the window's
+        retained FINAL extremum and emit immediately (the TTL'd join
+        this operator replaces would still hold the max row — a late
+        tying probe emits there too, and expires the same way once the
+        TTL evicts it); live rows strictly dominated by the window's
+        running extremum drop (the extremum only tightens, so a
+        dominated row can never tie the final answer; ties at the
+        current extremum must stay).  Returns the batch to buffer."""
+        ends = np.asarray(batch.columns["window_end"], dtype=np.int64)
+        vals = np.asarray(batch.columns[self.value_col])
+        keep = (~np.isnan(vals) if vals.dtype.kind == "f"
+                else np.ones(len(vals), dtype=bool))
+        if self._released_wm is not None:
+            late = keep & (ends <= self._released_wm)
+            if late.any():
+                keep &= ~late
+                hit = np.zeros(len(ends), dtype=bool)
+                for e in np.unique(ends[late]).tolist():
+                    best = self.final.get(e, "x")
+                    if best is not None:
+                        hit |= late & (ends == e) & (vals == best)
+                if hit.any():
+                    await self._emit(batch.select(np.nonzero(hit)[0]), ctx)
+        sign = 1.0 if self.minmax == "max" else -1.0
+        for e in np.unique(ends[keep]).tolist():
+            m = keep & (ends == e)
+            best = self._running.get(e)
+            if best is not None:
+                m_new = m & (sign * vals >= best)
+                keep &= ~m | m_new
+                m = m_new
+            if m.any():
+                local = (sign * vals[m]).max()
+                self._running[e] = (local if best is None
+                                    else max(best, local))
+        if keep.all():
+            return batch
+        if not keep.any():
+            return None
+        return batch.select(np.nonzero(keep)[0])
+
+    async def _emit(self, rows: Batch, ctx: Context) -> None:
+        cols = dict(rows.columns)
+        for out_name, src in self.synth_cols:
+            cols[out_name] = cols[src]
+        await ctx.collect(Batch(rows.timestamp, cols, rows.key_hash,
+                                rows.key_cols))
 
     async def process_batch(self, batch: Batch, ctx: Context,
                             side: int = 0) -> None:
+        if self.raw:
+            admitted = await self._admit(batch, ctx)
+            if admitted is None:
+                return
+            batch = admitted
         self.buf.append(batch)
         # one timer per distinct window end; aggregate rows stamp
         # timestamp = window_end - 1 (operator _emit convention)
@@ -838,6 +926,9 @@ class WindowArgmaxOperator(Operator):
         end = key[1]
         rows = self.buf.query_range(end - 1, end)  # ts == end - 1
         self.buf.evict_before(end)
+        self._running.pop(end, None)
+        self._released_wm = (end if self._released_wm is None
+                             else max(self._released_wm, end))
         if rows is None or not len(rows):
             return
         vals = np.asarray(rows.columns[self.value_col])
@@ -851,13 +942,12 @@ class WindowArgmaxOperator(Operator):
             return
         vv = vals[valid]
         best = vv.max() if self.minmax == "max" else vv.min()
+        if self.final is not None:
+            self.final.insert(end, "x", best)
+            if self.late_ttl:
+                self.final.evict_before(end - self.late_ttl)
         sel = np.nonzero(valid & (vals == best))[0]
-        out = rows.select(sel)
-        cols = dict(out.columns)
-        for out_name, src in self.synth_cols:
-            cols[out_name] = cols[src]
-        out = Batch(out.timestamp, cols, out.key_hash, out.key_cols)
-        await ctx.collect(out)
+        await self._emit(rows.select(sel), ctx)
 
 
 def _empty_like_side(tmpl: "_SideTemplate", other: Batch) -> Batch:
@@ -1366,7 +1456,10 @@ def _build_window_join(op: LogicalOperator) -> Operator:
 def _build_window_argmax(op: LogicalOperator) -> Operator:
     s = op.spec
     return WindowArgmaxOperator(op.name, s.value_col, s.minmax,
-                                s.synth_cols, s.width_micros)
+                                s.synth_cols, s.width_micros,
+                                raw=getattr(s, "raw", False),
+                                late_ttl_micros=getattr(
+                                    s, "late_ttl_micros", 0))
 
 
 @register_builder(OpKind.JOIN_WITH_EXPIRATION)

@@ -271,6 +271,18 @@ class WindowArgmaxSpec:
     # lets the plan finalizer push a LOCAL candidate pre-filter into the
     # aggregate's emission kernel when this operator is its only consumer
     agg_out: str = ""
+    # raw-stream mode (q7's shape: bids JOIN per-window max ON price=mx
+    # with a window-range WHERE): inputs are raw rows rather than
+    # aggregate outputs, so the operator (a) pre-filters each batch to
+    # rows >= the window's running extremum before buffering (the max
+    # only grows, so dominated rows can never be final candidates) and
+    # (b) matches genuinely-late rows against the released window's
+    # FINAL extremum, retained for late_ttl_micros — exactly how the
+    # TTL'd join this fusion replaces would still hold the max row and
+    # emit a late tying probe (and, like that join, drops the row once
+    # the TTL passes)
+    raw: bool = False
+    late_ttl_micros: int = 0
 
 
 @dataclass
@@ -463,7 +475,10 @@ class Program:
     # intended reading of "the same table".  Exact merged==unmerged
     # parity therefore holds when the base is pinned (what the tests
     # assert) and is *approached from the consistent side* when not.
-    _REPLAYABLE_SOURCES = frozenset({"nexmark", "impulse"})
+    # memory tables are fixed batch lists (the test workhorse): two scans
+    # of the same table object replay identically, so they merge/compare
+    # like the deterministic generators do
+    _REPLAYABLE_SOURCES = frozenset({"nexmark", "impulse", "memory"})
 
     def eliminate_common_subplans(self) -> int:
         """Merge operators that compute the same thing over the same
@@ -831,12 +846,13 @@ class Stream:
                       width_micros: int,
                       name: str = "window_argmax",
                       parallelism: Optional[int] = None,
-                      agg_out: str = "") -> "Stream":
+                      agg_out: str = "", raw: bool = False,
+                      late_ttl_micros: int = 0) -> "Stream":
         """Per-window argmax/argmin filter (see WindowArgmaxSpec).  The
         stream must be keyed by the window column so every row of one
         window lands on one subtask — the filter is then global."""
         spec = WindowArgmaxSpec(value_col, minmax, tuple(synth_cols),
-                                width_micros, agg_out)
+                                width_micros, agg_out, raw, late_ttl_micros)
         op = LogicalOperator(OpKind.WINDOW_ARGMAX, name, spec=spec)
         return self._chain(op, parallelism, EdgeType.SHUFFLE)
 

@@ -86,12 +86,22 @@ class Schema:
     # guarantees: field loads skip the presence mask (and projections skip
     # NULL materialization — the hot-path case for nexmark struct fields)
     presence_guaranteed: Set[str] = field(default_factory=set)
+    # event-time provenance: physical columns whose every NON-NULL value
+    # provably equals the stream's __timestamp (declared by the source —
+    # event_time_field, or connector-known fields like nexmark's
+    # bid.datetime — and propagated through pass-through projections and
+    # filters; joins and aggregates drop it, since their output rows get
+    # fresh timestamps).  The optimizer's raw-stream argmax fusion uses
+    # this to prove a post-join window-range WHERE pins each row to its
+    # own event-time window (planner._try_raw_argmax_fusion).
+    event_time_cols: Set[str] = field(default_factory=set)
 
     def clone(self) -> "Schema":
         return Schema(dict(self.columns), dict(self.structs),
                       set(self.aliases), self.window, set(self.window_names),
                       self.event_time_col, self.source_used,
-                      dict(self.qualified), set(self.presence_guaranteed))
+                      dict(self.qualified), set(self.presence_guaranteed),
+                      set(self.event_time_cols))
 
     def is_string(self, col: str) -> bool:
         return self.columns.get(col) == "s"

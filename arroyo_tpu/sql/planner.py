@@ -504,7 +504,8 @@ class Planner:
         if rewritten is not None:
             upstream, remaining_where = rewritten
         else:
-            upstream = self._plan_table_ref(sel.from_, prog, scope)
+            upstream = self._plan_table_ref(sel.from_, prog, scope,
+                                            where=sel.where)
             remaining_where = sel.where
 
         # WHERE: IN (SELECT ...) conjuncts become semi-joins, the rest a
@@ -604,7 +605,12 @@ class Planner:
                     "append-only")
             merged = planned.stream.union(
                 other.stream, name=f"union_{self._next_id()}")
-            planned = Planned(merged, planned.schema.clone(),
+            mschema = planned.schema.clone()
+            # provenance holds for the union only where EVERY branch
+            # proves it (a lone non-event-time branch would let the raw
+            # argmax fusion mis-window that branch's rows)
+            mschema.event_time_cols &= other.schema.event_time_cols
+            planned = Planned(merged, mschema,
                               updating=planned.updating or other.updating)
         return planned
 
@@ -649,7 +655,8 @@ class Planner:
         return prog
 
     def _plan_table_ref(self, tr: TableRef, prog: Program,
-                        scope: Dict[str, Planned]) -> Planned:
+                        scope: Dict[str, Planned],
+                        where: Optional[Expr] = None) -> Planned:
         if isinstance(tr, NamedTable):
             key = tr.name.lower()
             if key in scope:
@@ -679,7 +686,7 @@ class Planner:
                            updating=planned.updating,
                            max_of=planned.max_of)
         if isinstance(tr, Join):
-            return self._plan_join(tr, prog, scope)
+            return self._plan_join(tr, prog, scope, where=where)
         raise SqlPlanError(f"unsupported FROM clause {tr!r}")
 
     # connectors whose sources honor a 'projection' config hint (the
@@ -727,7 +734,13 @@ class Planner:
                 out["__timestamp"] = np.asarray(cols[_et], dtype=np.int64)
                 return out
 
-            stream = stream.udf(set_ts, name=f"{td.name}_event_time")
+            # structural token: two scans of the same table plan this
+            # udf twice with distinct closures — the token keeps
+            # subplan_equal/CSE comparing them by meaning, not identity
+            stream = stream.udf(set_ts, name=f"{td.name}_event_time",
+                                sql=f"set_ts:{td.name}:{et}")
+            # after set_ts the column IS the stream timestamp
+            schema.event_time_cols.add(et)
 
         # watermark generator
         if td.watermark_field:
@@ -862,6 +875,17 @@ class Planner:
                 is_identity = False
             if not is_identity:
                 identity = False
+            # event-time provenance survives pass-through column refs
+            # (incl. struct-field loads, whose non-null values are the
+            # raw physical column): a plain ColumnRef copies values, so
+            # non-NULL output == __timestamp still holds
+            if isinstance(expr, ColumnRef):
+                try:
+                    tag, phys = schema.resolve(expr, record=False)
+                except SqlCompileError:
+                    tag, phys = None, None
+                if tag == "col" and phys in schema.event_time_cols:
+                    new_schema.event_time_cols.add(name)
 
         # SELECT * over a windowed input expands window_start/window_end as
         # plain columns — keep the schema's windowness so downstream
@@ -1228,12 +1252,47 @@ class Planner:
                 width = getattr(
                     stream.program.node(planned.agg_node).operator.spec,
                     "width_micros", 0)
-                max_of = {"inner_agg_node": planned.agg_node,
+                max_of = {"raw": False,
+                          "inner_agg_node": planned.agg_node,
                           "inner_out": inner_out,
                           "kind": ("max" if aggs[0].kind == AggKind.MAX
                                    else "min"),
                           "out_col": out_name,
                           "width_micros": int(width)}
+        # q7 MaxPrice shape: a single numeric MAX/MIN of one input column
+        # over a TUMBLING window of the RAW stream, grouped by the window
+        # only (global per-window extremum) — the join planner's
+        # raw-stream argmax fusion needs the input subplan, the input
+        # column, and the window width.  Tumbling only: a sliding
+        # window would put each row in width/slide windows, which the
+        # one-window-per-row rewrite cannot represent.
+        if (max_of is None and isinstance(window, TumblingWindow)
+                and not key_cols and not grouped_by_window
+                and having_rewritten is None and len(aggs) == 1
+                and aggs[0].kind in (AggKind.MAX, AggKind.MIN)
+                and not str_outputs):
+            fc = collector.aggs[0] if collector.aggs else None
+            arg = (fc.args[0] if fc is not None and fc.args else None)
+            out_name = next((name for name, e in post_items
+                             if isinstance(e, ColumnRef)
+                             and e.qualifier is None
+                             and e.name == aggs[0].output), None)
+            input_col = None
+            if isinstance(arg, ColumnRef):
+                try:
+                    tag, phys = schema.resolve(arg, record=False)
+                except SqlCompileError:
+                    tag, phys = None, None
+                if tag == "col":
+                    input_col = phys
+            if input_col is not None and out_name is not None:
+                max_of = {"raw": True,
+                          "input_node": planned.stream.tail,
+                          "input_col": input_col,
+                          "kind": ("max" if aggs[0].kind == AggKind.MAX
+                                   else "min"),
+                          "out_col": out_name,
+                          "width_micros": int(window.width_micros)}
         return Planned(
             stream, out_schema,
             agg_node=agg_tail if fusable else None,
@@ -1569,8 +1628,9 @@ class Planner:
     # -- joins -------------------------------------------------------------
 
     def _plan_join(self, j: Join, prog: Program,
-                   scope: Dict[str, Planned]) -> Planned:
-        left = self._plan_table_ref(j.left, prog, scope)
+                   scope: Dict[str, Planned],
+                   where: Optional[Expr] = None) -> Planned:
+        left = self._plan_table_ref(j.left, prog, scope, where=where)
         right = self._plan_table_ref(j.right, prog, scope)
 
         if j.on is None:
@@ -1607,6 +1667,9 @@ class Planner:
         out = None
         if window_join and kind == JoinType.INNER:
             out = self._try_argmax_fusion(left, right, pairs, rcols)
+        if out is None and not window_join and kind == JoinType.INNER:
+            out = self._try_raw_argmax_fusion(left, right, pairs, rcols,
+                                              where)
         if out is None:
             # numeric join keys normalize to float32 so that e.g. an
             # int64 COUNT equi-joins against a float aggregate (both
@@ -1727,8 +1790,8 @@ class Planner:
         if os.environ.get("ARROYO_ARGMAX", "1") in ("0", "off", "false"):
             return None
         mo = right.max_of
-        if (mo is None or left.agg_node is None or not left.agg_map
-                or len(pairs) != 2):
+        if (mo is None or mo.get("raw") or left.agg_node is None
+                or not left.agg_map or len(pairs) != 2):
             return None
         val_pairs = [(le, re_) for le, re_ in pairs
                      if not (self._is_window_ref(le, left.schema)
@@ -1771,6 +1834,161 @@ class Planner:
                                mo["width_micros"] or 1,
                                name=f"window_argmax_{self._next_id()}",
                                agg_out=mo["inner_out"]))
+
+    _FLIP = {">=": "<=", "<=": ">=", ">": "<", "<": ">"}
+
+    def _try_raw_argmax_fusion(self, left: Planned, right: Planned,
+                               pairs: List[Tuple[Expr, Expr]],
+                               rcols: List[str],
+                               where: Optional[Expr]):
+        """Rewrite ``A JOIN (SELECT max(x), TUMBLE(w) AS window FROM A
+        GROUP BY 2) M ON A.x = M.mx WHERE A.et >= M.window_start AND
+        A.et < M.window_end`` into a per-window argmax over the RAW
+        stream A (nexmark q7's highest-bid shape).
+
+        Soundness chain: (1) the max side aggregates the provably same
+        subplan A over tumbling windows of A's __timestamp; (2) ``et``
+        carries event-time provenance (Schema.event_time_cols: non-NULL
+        values equal __timestamp), so both WHERE conjuncts being true
+        pins the joined M row's window to the A row's OWN window
+        ([start, end) membership — a non-strict upper bound would admit
+        the boundary of the previous window and must bail); (3) the
+        WHERE stays in the plan as a post-filter over the fused output,
+        which re-drops NULL-``et`` rows exactly as the join would have.
+        The fused plan emits each window's max-achieving rows (ties
+        included) with the pruned side's columns synthesized, replacing
+        a TTL'd stream-stream join whose state held every raw row.
+        DataFusion-based planners (the reference) run the full join
+        (optimizations.rs has no analogous rewrite).
+
+        Every bail returns None — a missed optimization, never a wrong
+        plan."""
+        import os
+
+        if os.environ.get("ARROYO_ARGMAX", "1") in ("0", "off", "false"):
+            return None
+        mo = right.max_of
+        if mo is None or not mo.get("raw") or where is None:
+            return None
+        if len(pairs) != 1 or left.updating:
+            return None
+        le, re_ = pairs[0]
+        if not (isinstance(le, ColumnRef) and isinstance(re_, ColumnRef)):
+            return None
+        try:
+            lt, lcol = left.schema.resolve(le, record=False)
+            rt, rcol = right.schema.resolve(re_, record=False)
+        except SqlCompileError:
+            return None
+        if lt != "col" or rt != "col":
+            return None
+        # the joined value must be the raw column the max side maximizes,
+        # over a provably identical input subplan (CTE references share
+        # nodes, so the common case short-circuits on identity)
+        if rcol != mo["out_col"] or lcol != mo["input_col"]:
+            return None
+        prog = left.stream.program
+        if not prog.subplan_equal(left.stream.tail, mo["input_node"]):
+            return None
+        # the rewrite introduces canonical window columns on A's stream
+        if ("window_start" in left.schema.columns
+                or "window_end" in left.schema.columns
+                or left.schema.window):
+            return None
+        # string extrema would need object-dtype handling in the
+        # running-extremum pre-filter — not worth the path
+        if left.schema.columns.get(lcol) == "s":
+            return None
+        width = int(mo["width_micros"])
+        if width <= 0:
+            return None
+        # WHERE must contain both window-membership bounds
+        lower_ok = upper_ok = False
+        for c in _conjuncts(where):
+            if not isinstance(c, BinaryOp) \
+                    or c.op not in (">=", ">", "<", "<="):
+                continue
+            for a, b, op in ((c.left, c.right, c.op),
+                             (c.right, c.left, self._FLIP[c.op])):
+                et = self._event_time_side(a, left, right)
+                bound = self._window_bound_side(b, left, right)
+                if et is None or bound is None:
+                    continue
+                if bound == "window_start" and op in (">=", ">"):
+                    lower_ok = True
+                elif bound == "window_end" and op == "<":
+                    upper_ok = True
+        if not (lower_ok and upper_ok):
+            return None
+        # every pruned-side column must be synthesizable from a fused row
+        synth = []
+        for c in rcols:
+            out_name = c if c not in left.schema.columns else f"r_{c}"
+            if c == mo["out_col"]:
+                synth.append((out_name, lcol))
+            elif c in ("window_start", "window_end"):
+                # produced under these exact names by _win_assign below;
+                # out_name == c always (the collision case bailed above)
+                pass
+            else:
+                return None
+
+        def _win_assign(cols, _w=width):
+            ts = np.asarray(cols["__timestamp"], dtype=np.int64)
+            we = (ts // _w + 1) * _w
+            out = dict(cols)
+            out["window_start"] = we - _w
+            out["window_end"] = we
+            # aggregate-row timestamp convention (operator _emit): the
+            # argmax stage buffers by ts == end - 1 and its timers fire
+            # when the watermark passes the window end
+            out["__timestamp"] = we - 1
+            return out
+
+        stream = left.stream.udf(_win_assign,
+                                 name=f"win_assign_{self._next_id()}")
+        return (stream.key_by("window_end")
+                .window_argmax(lcol, mo["kind"], tuple(synth), width,
+                               name=f"window_argmax_{self._next_id()}",
+                               raw=True,
+                               late_ttl_micros=DEFAULT_JOIN_TTL))
+
+    def _event_time_side(self, e: Expr, left: Planned,
+                         right: Planned) -> Optional[str]:
+        """Resolve ``e`` as a LEFT column with event-time provenance, or
+        None.  A ref that also resolves on the right is ambiguous — the
+        joined schema might bind it elsewhere — and bails."""
+        if not isinstance(e, ColumnRef):
+            return None
+        try:
+            tag, phys = left.schema.resolve(e, record=False)
+        except SqlCompileError:
+            return None
+        if tag != "col" or phys not in left.schema.event_time_cols:
+            return None
+        try:
+            right.schema.resolve(e, record=False)
+            return None
+        except SqlCompileError:
+            return phys
+
+    def _window_bound_side(self, e: Expr, left: Planned,
+                           right: Planned) -> Optional[str]:
+        """Resolve ``e`` as the right (max) side's window_start or
+        window_end, or None; ambiguous refs bail as above."""
+        if not isinstance(e, ColumnRef) or not right.schema.window:
+            return None
+        try:
+            tag, phys = right.schema.resolve(e, record=False)
+        except SqlCompileError:
+            return None
+        if tag != "col" or phys not in ("window_start", "window_end"):
+            return None
+        try:
+            left.schema.resolve(e, record=False)
+            return None
+        except SqlCompileError:
+            return phys
 
     def _split_on(self, on: Expr, ls: Schema, rs: Schema
                   ) -> List[Tuple[Expr, Expr]]:

@@ -92,6 +92,15 @@ def nexmark_table(config: Dict[str, Any]) -> TableDef:
                 "extra": "bid_extra",
             }, "event_type", 2),
         },
+        # the generator stamps each event's datetime field with the event
+        # timestamp itself (nexmark.py: cols["bid_datetime"] =
+        # where(is_bid, ts, 0), masked NULL when the struct is absent) —
+        # declare the provenance so the optimizer can prove
+        # window-range predicates on these columns pin rows to their own
+        # event-time window (reference semantics: nexmark/mod.rs
+        # datetime == wallclock event time)
+        event_time_cols={"auction_datetime", "bid_datetime",
+                         "__timestamp"},
     )
     rate = float(config.get("event_rate", 100_000.0))
     return TableDef("nexmark", "nexmark", config, schema,
@@ -138,12 +147,14 @@ class SchemaProvider:
 
     def add_memory_table(self, name: str, columns: Dict[str, str],
                          batches: List[Any],
-                         lateness_micros: int = 0) -> TableDef:
+                         lateness_micros: int = 0,
+                         event_time_field: Optional[str] = None) -> TableDef:
         """Testing hook: register an in-memory table with explicit batches
         (plays the role of the reference's single_file test tables)."""
         td = TableDef(name.lower(), "memory", {"batches": batches},
                       Schema(columns=dict(columns)),
-                      default_lateness_micros=lateness_micros)
+                      default_lateness_micros=lateness_micros,
+                      event_time_field=event_time_field)
         self.tables[td.name] = td
         return td
 

@@ -1434,6 +1434,227 @@ def test_argmax_fusion_bails_on_per_key_max():
     assert any("join" in n for n in prog.graph.nodes)
 
 
+# -- raw-stream argmax fusion (q7's shape; event-time provenance) -----------
+
+
+RAW_ARGMAX_TPL = """
+WITH bids as (SELECT bid.auction as auction, bid.price as price,
+                     bid.bidder as bidder, bid.datetime as datetime
+    FROM nexmark where bid is not null)
+SELECT B.auction as auction, B.price as price, B.bidder as bidder
+FROM bids B
+JOIN (
+  SELECT max({val}) AS maxprice, {win} as window
+  FROM bids GROUP BY 2{extra_group}
+) AS M
+ON B.{joincol} = M.maxprice
+WHERE {lower} AND {upper}
+"""
+
+
+def _plan_raw_argmax(val="price", win="TUMBLE(INTERVAL '10' SECOND)",
+                     joincol="price",
+                     lower="B.datetime >= M.window_start",
+                     upper="B.datetime < M.window_end", extra_group=""):
+    sql = ("CREATE TABLE nexmark WITH (connector = 'nexmark', "
+           "event_rate = '1000', num_events = '100', "
+           "rate_limited = 'false');"
+           + RAW_ARGMAX_TPL.format(val=val, win=win, joincol=joincol,
+                                   lower=lower, upper=upper,
+                                   extra_group=extra_group))
+    return plan_sql(sql)
+
+
+def _shape(prog):
+    return (sum(1 for n in prog.graph.nodes if "join" in n),
+            sum(1 for n in prog.graph.nodes if "window_argmax" in n))
+
+
+def test_raw_argmax_fusion_q7_plans_without_join():
+    """q7's raw-stream self-join on a tumbling window max fuses to one
+    WindowArgmax operator: the whole max-side aggregate chain and the
+    TTL join disappear (planner._try_raw_argmax_fusion; the reference
+    runs the full join — optimizations.rs has no analogous rewrite)."""
+    assert _shape(_plan_raw_argmax()) == (0, 1)
+    # flipped conjunct orientation proves the same bounds
+    assert _shape(_plan_raw_argmax(
+        lower="M.window_start <= B.datetime",
+        upper="M.window_end > B.datetime")) == (0, 1)
+    # strict lower bound still pins rows to their own window
+    assert _shape(_plan_raw_argmax(
+        lower="B.datetime > M.window_start")) == (0, 1)
+
+
+def test_raw_argmax_fusion_negative_shapes():
+    """Every unprovable variant must keep the full join plan (a missed
+    optimization, never a wrong plan)."""
+    # sliding window: each row is in width/slide windows
+    assert _shape(_plan_raw_argmax(
+        win="HOP(INTERVAL '2' SECOND, INTERVAL '10' SECOND)"))[1] == 0
+    # non-strict upper bound admits the previous window's boundary row
+    assert _shape(_plan_raw_argmax(
+        upper="B.datetime <= M.window_end"))[1] == 0
+    # missing a bound: the join is not pinned to one window
+    assert _shape(_plan_raw_argmax(upper="B.price > 0"))[1] == 0
+    assert _shape(_plan_raw_argmax(lower="B.price > 0"))[1] == 0
+    # WHERE column without event-time provenance (price != __timestamp)
+    assert _shape(_plan_raw_argmax(
+        lower="B.price >= M.window_start",
+        upper="B.price < M.window_end"))[1] == 0
+    # join column differs from the maximized column
+    assert _shape(_plan_raw_argmax(joincol="bidder"))[1] == 0
+    # per-key max on the right side is not a global window extremum
+    assert _shape(_plan_raw_argmax(extra_group=", auction"))[1] == 0
+
+
+def test_raw_argmax_fusion_memory_table_oracle():
+    """Fused raw argmax over a memory table WITH event_time_field: exact
+    row-set equality against the unfused TTL-join plan and a numpy
+    oracle, including max ties (all tying rows emit, as the join emits
+    them).  The same table WITHOUT event_time_field has no provenance
+    and must keep the join plan."""
+    import collections
+    import os
+
+    rng = np.random.default_rng(11)
+    n = 4000
+    ts = np.sort(rng.integers(0, 25 * SEC, n)).astype(np.int64)
+    au = rng.integers(0, 40, n)
+    pr = rng.integers(1, 60, n)  # small range -> many exact ties
+
+    def provider(et):
+        p = SchemaProvider()
+        p.add_memory_table(
+            "rawbids", {"auction": "i", "price": "i", "datetime": "t"},
+            [Batch(ts, {"auction": au.copy(), "price": pr.copy(),
+                        "datetime": ts.copy()})],
+            event_time_field=et)
+        return p
+
+    sql = """
+    SELECT B.auction as auction, B.price as price
+    FROM rawbids B
+    JOIN (
+      SELECT max(price) AS mx, TUMBLE(INTERVAL '10' SECOND) as window
+      FROM rawbids GROUP BY 2
+    ) AS M
+    ON B.price = M.mx
+    WHERE B.datetime >= M.window_start AND B.datetime < M.window_end
+    """
+    prog = plan_sql(sql, provider("datetime"))
+    assert _shape(prog) == (0, 1)
+    assert _shape(plan_sql(sql, provider(None)))[1] == 0
+
+    def rows(fused):
+        os.environ["ARROYO_ARGMAX"] = "1" if fused else "0"
+        try:
+            out = run_sql(sql, provider("datetime"))
+        finally:
+            os.environ.pop("ARROYO_ARGMAX", None)
+        return sorted(zip(out.columns["auction"].tolist(),
+                          out.columns["price"].tolist()))
+
+    W = 10 * SEC
+    mx = collections.defaultdict(int)
+    for t, p_ in zip(ts.tolist(), pr.tolist()):
+        mx[t // W] = max(mx[t // W], p_)
+    exp = sorted((int(a), int(p_))
+                 for t, a, p_ in zip(ts.tolist(), au.tolist(), pr.tolist())
+                 if p_ == mx[t // W])
+    got = rows(True)
+    assert got == rows(False) == exp
+    assert len(exp) > len(mx), "tie coverage: more rows than windows"
+
+
+def test_raw_argmax_union_branch_drops_provenance():
+    """UNION ALL keeps event-time provenance only where EVERY branch
+    proves it: a branch aliasing a non-event-time column onto the et
+    name would be mis-windowed by the fusion (code-review r5 finding,
+    verified repro) — the plan must keep the join."""
+    ts = np.array([1 * SEC, 12 * SEC], dtype=np.int64)
+    other = np.array([15 * SEC, 3 * SEC], dtype=np.int64)
+    v = np.array([7, 7], dtype=np.int64)
+
+    def provider():
+        p = SchemaProvider()
+        p.add_memory_table(
+            "t1", {"et": "t", "other": "t", "v": "i"},
+            [Batch(ts, {"et": ts.copy(), "other": other.copy(),
+                        "v": v.copy()})],
+            event_time_field="et")
+        return p
+
+    sql = """
+    WITH u AS (SELECT et AS et, v AS v FROM t1
+               UNION ALL SELECT other AS et, v AS v FROM t1)
+    SELECT B.v AS v, B.et AS et
+    FROM u B
+    JOIN (
+      SELECT max(v) AS mx, TUMBLE(INTERVAL '10' SECOND) AS window
+      FROM u GROUP BY 2
+    ) AS M
+    ON B.v = M.mx
+    WHERE B.et >= M.window_start AND B.et < M.window_end
+    """
+    prog = plan_sql(sql, provider())
+    assert not any("window_argmax" in n for n in prog.graph.nodes)
+    out = run_sql(sql, provider())
+    got = sorted(zip(out.columns["v"].tolist(),
+                     (np.asarray(out.columns["et"]) // SEC).tolist()))
+    # all four rows match: each branch-2 row's et lands in a window
+    # whose max (7) it equals
+    assert got == [(7, 1), (7, 3), (7, 12), (7, 15)]
+
+
+def test_raw_argmax_late_rows_match_final_extremum():
+    """A genuinely-late row (beyond the watermark) for a released window
+    must behave exactly as in the TTL join the fusion replaces: the max
+    row is still in TTL state, so a late tying probe emits and a late
+    non-tying probe doesn't (code-review r5 finding, verified repro)."""
+    import os
+
+    b1 = Batch(np.array([1 * SEC, 12 * SEC], dtype=np.int64),
+               {"a": np.array([1, 2], dtype=np.int64),
+                "v": np.array([9.0, 3.0]),
+                "et": np.array([1 * SEC, 12 * SEC], dtype=np.int64)})
+    # late rows for window [0, 10s): one ties the final max 9.0, one not
+    b2 = Batch(np.array([5 * SEC, 6 * SEC, 13 * SEC], dtype=np.int64),
+               {"a": np.array([3, 5, 4], dtype=np.int64),
+                "v": np.array([9.0, 8.0, 3.0]),
+                "et": np.array([5 * SEC, 6 * SEC, 13 * SEC],
+                               dtype=np.int64)})
+
+    def provider():
+        p = SchemaProvider()
+        p.add_memory_table("lb", {"a": "i", "v": "f", "et": "t"},
+                           [b1, b2], event_time_field="et")
+        return p
+
+    sql = """
+    SELECT B.a AS a, B.v AS v
+    FROM lb B
+    JOIN (
+      SELECT max(v) AS mx, TUMBLE(INTERVAL '10' SECOND) AS window
+      FROM lb GROUP BY 2
+    ) AS M
+    ON B.v = M.mx
+    WHERE B.et >= M.window_start AND B.et < M.window_end
+    """
+
+    def rows(fused):
+        os.environ["ARROYO_ARGMAX"] = "1" if fused else "0"
+        try:
+            out = run_sql(sql, provider())
+        finally:
+            os.environ.pop("ARROYO_ARGMAX", None)
+        return sorted(zip(out.columns["a"].tolist(),
+                          out.columns["v"].tolist()))
+
+    fused, unfused = rows(True), rows(False)
+    assert fused == unfused
+    assert (3, 9.0) in fused and (5, 8.0) not in fused
+
+
 def test_null_join_keys_never_match():
     """SQL NULL join keys match nothing — not even each other (the
     reference's hash join skips null keys).  Null-keyed rows still

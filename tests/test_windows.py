@@ -851,3 +851,69 @@ def test_window_argmax_skips_null_values():
         assert len(ctx.out) == 1  # nothing new
 
     asyncio.run(drive())
+
+
+def test_window_argmax_raw_restore_late_rows():
+    """Raw mode across a (simulated) restore: the released-window guard
+    re-arms from the checkpoint watermark and late rows match the
+    PERSISTED final extrema — a late tying row emits exactly as the
+    TTL'd join it replaces would, a non-tying or unknown-window late
+    row drops, and the released window never re-fires wholesale."""
+    from arroyo_tpu.engine.operators_window import WindowArgmaxOperator
+    from arroyo_tpu.state.store import StateStore
+    from arroyo_tpu.types import TaskInfo
+
+    class Ctx:
+        def __init__(self, store, last_watermark=None):
+            self.state = store
+            self.last_watermark = last_watermark
+            self.out = []
+            self.timers = self
+
+        def schedule(self, t, key):
+            pass
+
+        async def collect(self, batch):
+            self.out.append(batch)
+
+    W = 1_000_000
+    store = StateStore.new_in_memory(TaskInfo("j", "o", "am", 0, 1))
+
+    def make_op():
+        return WindowArgmaxOperator("am", "v", "max", (("mx", "v"),), W,
+                                    raw=True, late_ttl_micros=3600 * W)
+
+    def rows(wend, vals, keys):
+        n = len(vals)
+        return Batch(np.full(n, wend - 1, np.int64),
+                     {"window_end": np.full(n, wend, np.int64),
+                      "window_start": np.full(n, wend - W, np.int64),
+                      "k": np.asarray(keys, np.int64),
+                      "v": np.asarray(vals, float)},
+                     np.full(n, 9, np.uint64), ("window_end",))
+
+    async def drive():
+        op1 = make_op()
+        ctx1 = Ctx(store)
+        await op1.on_start(ctx1)
+        await op1.process_batch(rows(W, [9.0, 3.0], [1, 2]), ctx1)
+        await op1.handle_timer(W, ("am", W), None, ctx1)
+        assert len(ctx1.out) == 1
+        assert ctx1.out[0].columns["k"].tolist() == [1]
+
+        # "restore": fresh operator over the same state, checkpoint
+        # watermark at the released window end
+        op2 = make_op()
+        ctx2 = Ctx(store, last_watermark=W)
+        await op2.on_start(ctx2)
+        # late batch: a tie (emits via the persisted final), a dominated
+        # value (drops), and an unknown released window (drops)
+        await op2.process_batch(rows(W, [9.0, 8.0], [3, 5]), ctx2)
+        assert len(ctx2.out) == 1
+        out = ctx2.out[0]
+        assert out.columns["k"].tolist() == [3]
+        assert out.columns["mx"].tolist() == [9.0]
+        await op2.process_batch(rows(W // 2, [4.0], [7]), ctx2)
+        assert len(ctx2.out) == 1  # nothing new, window never existed
+
+    asyncio.run(drive())

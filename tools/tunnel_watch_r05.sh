@@ -1,0 +1,48 @@
+#!/bin/bash
+# Round-5 tunnel watcher.  Probe the axon tunnel every ~7 min; whenever
+# it is alive, run the full bench and land the artifact at the repo
+# root (BENCH_TPU_FULL_r05.json) so the driver's end-of-round
+# auto-commit captures it.  Unlike the r04 watcher this one does NOT
+# exit after the first success: a later capture carries a later git
+# sha (more optimizer work), so we re-capture at most once every
+# RECAP_SECS while the tunnel stays up, keeping the newest artifact.
+# Every capture also snapshots to a timestamped file in /tmp for
+# forensics.  A "hold" file (/tmp/bench_hold) pauses capture while the
+# builder needs the single CPU core for clean same-box measurements.
+cd /root/repo
+RECAP_SECS=${RECAP_SECS:-4800}
+last_ok=0
+for i in $(seq 1 400); do
+  if [ -f /tmp/bench_hold ]; then
+    echo "attempt $i held $(date)" >> /tmp/tunnel_watch.log
+    sleep 300
+    continue
+  fi
+  now=$(date +%s)
+  if [ $((now - last_ok)) -lt "$RECAP_SECS" ]; then
+    sleep 300
+    continue
+  fi
+  if timeout 90 python -c "import jax; assert jax.default_backend() == 'tpu'" 2>/dev/null; then
+    echo "tunnel alive at attempt $i, $(date)" >> /tmp/tunnel_watch.log
+    tmp=$(mktemp /tmp/bench_r05.XXXXXX)
+    timeout 3600 python bench.py > "$tmp" 2>/tmp/bench_r05_tpu.err
+    rc=$?
+    echo "bench rc=$rc at $(date)" >> /tmp/tunnel_watch.log
+    if [ $rc -eq 0 ] && python -c "
+import json,sys
+d=json.load(open(sys.argv[1]))
+assert d.get('backend')=='tpu', 'not a tpu capture'
+" "$tmp" 2>>/tmp/tunnel_watch.log; then
+      cp "$tmp" "/tmp/bench_tpu_$(date +%s).json"
+      mv "$tmp" /root/repo/BENCH_TPU_FULL_r05.json
+      last_ok=$(date +%s)
+      echo "captured BENCH_TPU_FULL_r05.json at $(date)" >> /tmp/tunnel_watch.log
+    else
+      rm -f "$tmp"
+    fi
+  else
+    echo "attempt $i down $(date)" >> /tmp/tunnel_watch.log
+  fi
+  sleep 400
+done

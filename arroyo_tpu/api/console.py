@@ -235,13 +235,16 @@ function renderDag(g, overlay) {
     const p = pos[n.operator_id];
     const k = opKey(n.operator_id);
     out += `<g transform="translate(${p.x},${p.y})">
-      <rect class="nodebox" width="${W}" height="${H}" rx="6"/>
+      <rect class="nodebox" id="ov_box_${k}" width="${W}" height="${H}"
+        rx="6"/>
       <text x="10" y="21">${esc(n.operator_id).slice(0, 28)}</text>
       <text x="10" y="40" fill="#7a8794">${esc(n.description)
         .slice(0, 26)} ×${n.parallelism}</text>`;
     if (overlay) out += `
       <text id="ov_rate_${k}" x="${W - 8}" y="16" text-anchor="end"
         fill="#4aa3ff"></text>
+      <text id="ov_lag_${k}" x="${W - 8}" y="34" text-anchor="end"
+        fill="#7a8794"></text>
       <polyline id="ov_sp_${k}" points="" fill="none" stroke="#4aa3ff"
         stroke-width="1" opacity="0.7"/>
       <rect x="0" y="${H - 4}" width="${W}" height="4" rx="2"
@@ -253,17 +256,39 @@ function renderDag(g, overlay) {
   return out + '</svg>';
 }
 
-function updateDagOverlay(rows) {
+function fmtLag(s) {
+  if (s == null) return '';
+  if (s >= 60) return 'lag ' + (s / 60).toFixed(1) + 'm';
+  if (s >= 1) return 'lag ' + s.toFixed(1) + 's';
+  return 'lag ' + (s * 1000).toFixed(0) + 'ms';
+}
+
+function updateDagOverlay(rows, rollups) {
+  // rollups: controller-aggregated per-operator {event_time_lag,
+  // watermark_lag, backpressure} — colors each node by the worse of its
+  // backpressure and lag so the hot operator is visible at a glance
   const W = 210, H = 54;
+  rollups = rollups || {};
   for (const r_ of rows) {
     const k = opKey(r_.op);
     const rateEl = $('ov_rate_' + k);
     if (!rateEl) continue;
     rateEl.textContent = fmtRate(r_.rate);
+    const ru = rollups[r_.op] || {};
+    const bpv = ru.backpressure != null ? ru.backpressure : r_.bp;
+    const lag = ru.event_time_lag != null ? ru.event_time_lag
+                                          : ru.watermark_lag;
+    $('ov_lag_' + k).textContent = fmtLag(lag);
     const bp = $('ov_bp_' + k);
-    bp.setAttribute('width', (r_.bp * W).toFixed(0));
-    bp.setAttribute('fill', r_.bp > 0.7 ? '#c62828'
-                           : r_.bp > 0.3 ? '#f9a825' : '#2e7d32');
+    bp.setAttribute('width', (bpv * W).toFixed(0));
+    bp.setAttribute('fill', bpv > 0.7 ? '#c62828'
+                           : bpv > 0.3 ? '#f9a825' : '#2e7d32');
+    // node border: hot when backpressured OR lagging (10s warn, 60s hot)
+    const hot = bpv > 0.7 || (lag != null && lag > 60);
+    const warn = bpv > 0.3 || (lag != null && lag > 10);
+    const box = $('ov_box_' + k);
+    if (box) box.setAttribute(
+      'stroke', hot ? '#c62828' : warn ? '#f9a825' : '#2a323c');
     const rates = r_.rates.slice(-40);
     const max = Math.max(1, ...rates);
     const pts = rates.map((v, i) =>
@@ -393,6 +418,12 @@ function fmtRate(v) {
 async function pollJob() {
   if (!watching) return;
   const {pid, jid} = watching;
+  // rollups fetch starts concurrently: it's independent of the metric
+  // groups and awaiting it serially would add a full round-trip to
+  // every poll tick before the sparklines refresh
+  const rollupsP = fetch(
+    `/v1/pipelines/${pid}/jobs/${jid}/operator_rollups`)
+    .catch(() => null);
   const r = await fetch(
     `/v1/pipelines/${pid}/jobs/${jid}/operator_metric_groups`);
   if (!r.ok) return;
@@ -435,7 +466,15 @@ async function pollJob() {
     bar.style.width = (r_.bp * 100).toFixed(0) + '%';
     bar.className = r_.bp > 0.7 ? 'hot' : '';
   });
-  updateDagOverlay(rows);
+  // controller-side rollups (heartbeat-aggregated): lag + backpressure
+  // per operator for the DAG coloring — fetched concurrently above
+  let rollups = {};
+  try {
+    const ro = await rollupsP;
+    if (ro && ro.ok) for (const g of (await ro.json()).data || [])
+      rollups[g.operator_id] = g;
+  } catch (e) { /* rollups are best-effort */ }
+  updateDagOverlay(rows, rollups);
 
   const ck = await fetch(
     `/v1/pipelines/${pid}/jobs/${jid}/checkpoints`);

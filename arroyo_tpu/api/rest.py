@@ -539,6 +539,28 @@ class ApiServer:
             return {"data": sorted(groups.values(),
                                    key=lambda g: g["operator_id"])}
 
+        @r.get("/v1/pipelines/{pid}/jobs/{jid}/operator_rollups")
+        async def operator_rollups(req: Request):
+            """Controller-aggregated per-operator job rollups (records/s,
+            event-time/watermark lag, batch latency, backpressure, kernel
+            seconds) built from worker heartbeat snapshots — works across
+            worker processes, unlike the in-process registry scrape."""
+            jid = req.params["jid"]
+            data = self.controller.job_rollup(jid)
+            source = "heartbeat"
+            if not data:
+                # no heartbeat snapshot (job just started, or an embedded/
+                # in-process run the controller never scheduled): fall back
+                # to the local registry so the console never renders blank
+                from ..obs.metrics import job_operator_summary
+
+                ops = self.controller.rollup_from_summary(
+                    job_operator_summary(jid))
+                if not ops and jid not in self.controller.jobs:
+                    raise HttpError(404, "no such job")
+                data, source = ops, "local_registry"
+            return {"data": data, "source": source}
+
         @r.get("/v1/pipelines/{pid}/jobs/{jid}/metrics_history")
         async def metrics_history(req: Request):
             """Persistent per-operator history (the API's sampler writes

@@ -449,11 +449,14 @@ class NexmarkSource(SourceOperator):
                 gen.next_batch(min(batch_size, count - gen.events_so_far))
             if gen.events_so_far != count:
                 raise RuntimeError(
-                    f"nexmark resume burn landed at {gen.events_so_far}, "
-                    f"checkpoint says {count}: the table's num_events/"
-                    "batch_size config changed since the checkpoint was "
-                    "written — the resumed stream would not be the "
-                    "delivered stream")
+                    f"nexmark resume burn landed at {gen.events_so_far} "
+                    f"events but the checkpoint recorded {count}; the "
+                    "resumed stream would not be the delivered stream. "
+                    "Possible causes: the table's num_events/batch_size/"
+                    "event_rate config changed since the checkpoint was "
+                    "written (config drift), or the checkpoint predates "
+                    "RNG-state snapshots and its count is not reachable "
+                    "with the current batch size")
         runner = getattr(ctx, "_runner", None)
         wall_base = _time.monotonic() - (gen.inter_event_delay * count) / 1e6
         from ..obs import perf
@@ -487,7 +490,7 @@ class NexmarkSource(SourceOperator):
 
         fut = loop.run_in_executor(None, gen_next) if gen.has_next else None
         while fut is not None:
-            batch, nums, count_after = await fut
+            batch, nums, count_after, rng_snap = await fut
             fut = (loop.run_in_executor(None, gen_next)
                    if gen.has_next else None)
             await ctx.collect(batch)
@@ -495,8 +498,11 @@ class NexmarkSource(SourceOperator):
                 mx = int(np.max(batch.timestamp))
                 if not emit_log or mx > emit_log[-1][0]:
                     emit_log.append((mx, _time.monotonic()))
+            # the 4-tuple (incl. the RNG snapshot captured WITH the count)
+            # is what makes the O(1) restore path live: a barrier now
+            # checkpoints a consistent (count, stream-position) pair
             state.insert(ctx.task_info.task_index,
-                         (base_time, split, count_after))
+                         (base_time, split, count_after, rng_snap))
             if runner is not None:
                 cm = await runner.poll_source_control()
                 if cm is not None and cm.kind == "stop":

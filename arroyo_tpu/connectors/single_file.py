@@ -120,15 +120,19 @@ class SingleFileSink(Operator):
 
     async def on_start(self, ctx: Context) -> None:
         os.makedirs(os.path.dirname(os.path.abspath(self.cfg.path)), exist_ok=True)
+        # line-buffered: an IMMEDIATE-stopped run never runs on_close, and
+        # a block-buffered file object flushing its residue at finalization
+        # — at its stale pre-truncate offset — would punch a zero-filled
+        # hole into the file the restored run is appending to
         if ctx.state.restore_epoch is not None:
             offset = ctx.state.get_global_keyed_state("o").get("offset") or 0
             with open(self.cfg.path, "ab") as f:
                 pass  # ensure exists
             with open(self.cfg.path, "r+b") as f:
                 f.truncate(offset)
-            self._file = open(self.cfg.path, "a")
+            self._file = open(self.cfg.path, "a", buffering=1)
         else:
-            self._file = open(self.cfg.path, "w")
+            self._file = open(self.cfg.path, "w", buffering=1)
 
     async def pre_checkpoint(self, barrier, ctx: Context) -> None:
         self._file.flush()
@@ -138,9 +142,12 @@ class SingleFileSink(Operator):
     async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
         names = list(batch.columns)
         cols = [batch.columns[n] for n in names]
-        for i in range(len(batch)):
-            row = {n: c[i] for n, c in zip(names, cols)}
-            self._file.write(json.dumps(row, default=_json_default) + "\n")
+        # one write per batch: line buffering then flushes once here, so
+        # no residue outlives the batch without paying a syscall per row
+        self._file.write("".join(
+            json.dumps({n: c[i] for n, c in zip(names, cols)},
+                       default=_json_default) + "\n"
+            for i in range(len(batch))))
 
     async def handle_watermark(self, watermark: int, ctx: Context) -> None:
         self._file.flush()

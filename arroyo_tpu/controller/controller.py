@@ -42,6 +42,13 @@ class WorkerInfo:
     last_heartbeat: float = field(default_factory=time.monotonic)
     client: Optional[RpcClient] = None
     finished: bool = False
+    # flight-recorder rollup scraped from the heartbeat payload:
+    # {operator_id: {metric_key: value}}, plus the previous sample so
+    # job-level rate math has a delta to work with
+    metric_snapshot: Optional[Dict[str, Dict[str, float]]] = None
+    snapshot_time: float = 0.0
+    prev_snapshot: Optional[Dict[str, Dict[str, float]]] = None
+    prev_time: float = 0.0
 
 
 @dataclass
@@ -90,6 +97,9 @@ class Job:
 
 
 class ControllerServer:
+    # class-level so test doubles built via __new__ still have it
+    _metrics_decode_warned = False
+
     def __init__(self, scheduler: Optional[Scheduler] = None,
                  host: str = "127.0.0.1",
                  db_path: Optional[str] = None):
@@ -562,8 +572,157 @@ class ControllerServer:
     async def _heartbeat(self, req: Dict) -> Dict:
         job = self.jobs.get(req["job_id"])
         if job and req["worker_id"] in job.workers:
-            job.workers[req["worker_id"]].last_heartbeat = time.monotonic()
+            w = job.workers[req["worker_id"]]
+            w.last_heartbeat = time.monotonic()
+            metrics = req.get("metrics")
+            if isinstance(metrics, (bytes, bytearray)) and metrics:
+                # msgpack over the wire (see rpc.proto HeartbeatReq)
+                try:
+                    from ..rpc.transport import _deser_msgpack
+
+                    metrics = _deser_msgpack(bytes(metrics))
+                except Exception:
+                    # keep accepting heartbeats, but a persistent decode
+                    # failure (worker/controller version skew) would
+                    # silently blank every job rollup — say so once
+                    if not self._metrics_decode_warned:
+                        self._metrics_decode_warned = True
+                        logger.warning(
+                            "undecodable heartbeat metrics payload from "
+                            "worker %s; job rollups will be empty",
+                            req["worker_id"], exc_info=True)
+                    metrics = None
+            if metrics:
+                w.prev_snapshot, w.prev_time = (w.metric_snapshot,
+                                                w.snapshot_time)
+                w.metric_snapshot, w.snapshot_time = (metrics,
+                                                      time.monotonic())
         return {}
+
+    # -- job-level metric aggregation -------------------------------------
+
+    @staticmethod
+    def _rollup_op(agg: Dict[str, Any], cur: Dict[str, float],
+                   prev: Optional[Dict[str, float]], dt: float) -> None:
+        """Fold one worker's per-operator summary into the job rollup.
+        Counters/sums add across workers; rates come from the worker's own
+        two newest heartbeat samples."""
+
+        def get(src, key):
+            # prometheus_client exposes counters with a _total suffix
+            return src.get(key, src.get(key + "_total", 0.0)) if src else 0.0
+
+        for key in ("messages_recv", "messages_sent", "bytes_recv",
+                    "bytes_sent", "kernel_seconds", "backpressure_seconds"):
+            agg[key] = agg.get(key, 0.0) + get(cur, key)
+        for key in ("tx_queue_size", "tx_queue_rem"):
+            agg[key] = agg.get(key, 0.0) + cur.get(key, 0.0)
+        # per-subtask queue pairs → worst-subtask backpressure (same
+        # rationale as the lag families below: the summed gauges dilute
+        # one saturated subtask among idle siblings)
+        for k in cur:
+            if k.startswith("tx_queue_size@"):
+                size = cur[k]
+                rem = cur.get("tx_queue_rem@" + k.rsplit("@", 1)[1], 0.0)
+                if size > 0:
+                    agg["_bp_worst"] = max(agg.get("_bp_worst", 0.0),
+                                           1.0 - rem / size)
+        if prev is not None and dt > 0:
+            agg["records_per_sec"] = agg.get("records_per_sec", 0.0) + max(
+                get(cur, "messages_sent") - get(prev, "messages_sent"),
+                0.0) / dt
+        # lag/latency: average over the newest heartbeat window (delta of
+        # the histogram _sum/_count pair); the lifetime average only on
+        # the very first sample.  A window with no new samples contributes
+        # nothing — falling back to the lifetime average there would pin
+        # a startup backlog's lag on the rollup forever after the
+        # operator goes idle.
+        for short, fam in (("event_time_lag", "event_time_lag_seconds"),
+                           ("watermark_lag", "watermark_lag_seconds"),
+                           ("batch_latency", "batch_processing_seconds"),
+                           ("queue_wait", "queue_wait_seconds"),
+                           ("checkpoint_duration",
+                            "checkpoint_duration_seconds")):
+            # worst across subtasks AND workers: a single lagging subtask
+            # is the signal, averaging it away would hide it.  Workers
+            # ship per-subtask pairs (`fam_sum@idx`) for the lag families
+            # so co-located subtasks don't get averaged together; the
+            # worker-summed flat pair is the fallback (checkpoint
+            # histograms, legacy payloads, tests)
+            sub_pairs = [(k, fam + "_count@" + k.rsplit("@", 1)[1])
+                         for k in cur if k.startswith(fam + "_sum@")]
+            for sk, ck in sub_pairs or [(fam + "_sum", fam + "_count")]:
+                s, c = cur.get(sk, 0.0), cur.get(ck, 0.0)
+                if prev is not None:
+                    s -= prev.get(sk, 0.0)
+                    c -= prev.get(ck, 0.0)
+                if c > 0:
+                    agg[short] = max(agg.get(short, 0.0), s / c)
+
+    @staticmethod
+    def _finalize_rollup(agg: Dict[str, Any],
+                         age_secs: Optional[float]) -> None:
+        qsize = agg.get("tx_queue_size", 0.0)
+        # aggregate ratio as the floor (flat/legacy payloads), worst
+        # subtask on top when the per-subtask pairs were shipped
+        agg["backpressure"] = max(
+            1.0 - agg.get("tx_queue_rem", 0.0) / qsize
+            if qsize > 0 else 0.0,
+            agg.pop("_bp_worst", 0.0))
+        agg["age_secs"] = age_secs
+
+    @classmethod
+    def rollup_from_summary(
+            cls, summary: Dict[str, Dict[str, float]]) -> List[Dict[str, Any]]:
+        """Job-rollup-shaped aggregation of one in-process registry
+        summary — the REST fallback for embedded/LocalRunner jobs the
+        controller never scheduled, kept here so the fold + finalize
+        logic has a single home."""
+        ops = []
+        for op, cur in sorted(summary.items()):
+            # one in-process registry == one contributing worker
+            agg: Dict[str, Any] = {"operator_id": op, "workers": 1}
+            cls._rollup_op(agg, cur, None, 0.0)
+            cls._finalize_rollup(agg, 0.0)  # live scrape: zero age
+            ops.append(agg)
+        return ops
+
+    def job_rollup(self, job_id: str) -> List[Dict[str, Any]]:
+        """Controller-aggregated per-operator rollup for one job, built
+        from worker heartbeat snapshots (records/s, lag, backpressure per
+        operator — what the console's DAG overlay and the REST metrics
+        routes serve)."""
+        job = self.jobs.get(job_id)
+        if job is None:
+            return []
+        ops: Dict[str, Dict[str, Any]] = {}
+        now = time.monotonic()
+        stale_after = config().heartbeat_timeout_secs
+        oldest: Optional[float] = None
+        for w in job.workers.values():
+            if not w.metric_snapshot:
+                continue
+            # finished or heartbeat-dead workers no longer describe the
+            # running job: max()-ing their last (possibly backpressured)
+            # snapshot in would pin the rollup hot until recovery
+            if w.finished or now - w.last_heartbeat > stale_after:
+                continue
+            oldest = (w.snapshot_time if oldest is None
+                      else min(oldest, w.snapshot_time))
+            dt = w.snapshot_time - w.prev_time
+            for op, cur in w.metric_snapshot.items():
+                agg = ops.setdefault(op, {"operator_id": op, "workers": 0})
+                agg["workers"] += 1
+                self._rollup_op(
+                    agg, cur,
+                    (w.prev_snapshot or {}).get(op) if w.prev_snapshot
+                    else None, dt)
+        for agg in ops.values():
+            # age of the OLDEST contributing snapshot — the newest would
+            # hide one worker's staleness behind a livelier sibling's
+            self._finalize_rollup(
+                agg, round(now - oldest, 1) if oldest else None)
+        return sorted(ops.values(), key=lambda g: g["operator_id"])
 
     async def _task_started(self, req: Dict) -> Dict:
         return {}

@@ -147,6 +147,7 @@ class WatermarkOperator(Operator):
         self.max_ts: Optional[int] = None
         self.last_emitted: Optional[int] = None
         self.last_data_wall: float = _time.monotonic()
+        self._last_trace_wall: float = 0.0
         self._idle_task: Optional[asyncio.Task] = None
         # watermark expressions produce int64 micros -> host eval only
         self._expr_fn = spec.expression.fn if spec.expression else None
@@ -176,6 +177,24 @@ class WatermarkOperator(Operator):
             wm = self.max_ts - self.spec.max_lateness_micros
             if self.last_emitted is None or wm > self.last_emitted:
                 self.last_emitted = wm
+                # flight-recorder tap: the assigner's emitted watermark is
+                # the origin every downstream lag measurement follows.
+                # Throttled to 10/s per operator: monotonic sources emit a
+                # new watermark on nearly every batch, and unthrottled
+                # instants would wrap the bounded span ring in seconds,
+                # evicting the rare checkpoint/barrier spans it exists
+                # to keep
+                wall = _time.monotonic()
+                if wall - self._last_trace_wall >= 0.1:
+                    self._last_trace_wall = wall
+                    from ..obs import tracing
+                    from ..types import now_micros
+
+                    tracing.instant(
+                        "watermark.emit", "watermark",
+                        tid=tracing.ctx_tid(ctx),
+                        args={"watermark": int(wm),
+                              "lag_s": round((now_micros() - wm) / 1e6, 4)})
                 await ctx.broadcast(Message.wm(Watermark.event_time(wm)))
 
     async def handle_watermark(self, watermark: int, ctx: Context) -> None:

@@ -197,25 +197,36 @@ class BinAggOperator(Operator):
         # safe to offload: this operator's messages are processed
         # serially, so state is never touched concurrently
         if self._offload_transfers():
-            await asyncio.get_event_loop().run_in_executor(
-                None, self.state.update, batch.key_hash, batch.timestamp,
-                batch.columns)
+            from ..obs import perf
+
+            await perf.run_offloaded(
+                asyncio.get_event_loop(), self.state.update,
+                batch.key_hash, batch.timestamp, batch.columns)
         else:
             self.state.update(batch.key_hash, batch.timestamp, batch.columns)
 
     async def handle_watermark(self, watermark: int, ctx: Context) -> None:
+        from ..obs import tracing
         from ..types import MAX_TIMESTAMP
 
         final = watermark >= int(MAX_TIMESTAMP) - 1
-        # pane emission device_get is the biggest device->host transfer in
-        # the pipeline (same offload rationale as update)
-        if self._offload_transfers():
-            fired = await asyncio.get_event_loop().run_in_executor(
-                None, lambda: self.state.fire_panes(watermark, final=final))
-        else:
-            fired = self.state.fire_panes(watermark, final=final)
-        if fired is not None:
-            await self._emit(fired, ctx)
+        # flight-recorder tap: pane firing is where windowed pipelines
+        # spend their watermark-driven time
+        with tracing.span("window.fire", "window",
+                          tid=tracing.ctx_tid(ctx),
+                          args={"watermark": int(watermark)}):
+            # pane emission device_get is the biggest device->host transfer
+            # in the pipeline (same offload rationale as update)
+            if self._offload_transfers():
+                from ..obs import perf
+
+                fired = await perf.run_offloaded(
+                    asyncio.get_event_loop(),
+                    lambda: self.state.fire_panes(watermark, final=final))
+            else:
+                fired = self.state.fire_panes(watermark, final=final)
+            if fired is not None:
+                await self._emit(fired, ctx)
         await ctx.broadcast(Message.wm(Watermark.event_time(watermark)))
 
     async def _emit(self, fired, ctx: Context) -> None:
@@ -605,8 +616,13 @@ class SessionWindowOperator(Operator):
         await ctx.collect(out)
 
     async def handle_watermark(self, watermark: int, ctx: Context) -> None:
-        self._collect_expired(watermark, ctx)
-        await self._flush_fires(ctx)
+        from ..obs import tracing
+
+        with tracing.span("window.session_fire", "window",
+                          tid=tracing.ctx_tid(ctx),
+                          args={"watermark": int(watermark)}):
+            self._collect_expired(watermark, ctx)
+            await self._flush_fires(ctx)
         # evict data older than every live session start
         live_starts = [s for _, sessions in self.windows.items()
                        for (s, _) in sessions]
@@ -856,6 +872,16 @@ class WindowArgmaxOperator(Operator):
             # rows instead match the persisted final extrema)
             self._released_wm = ctx.last_watermark
 
+    def ctx_watermark(self, ctx: Context) -> Optional[int]:
+        """Release threshold: the operator's current input watermark,
+        floored by the last timer-fired window end (covers restore, where
+        both are checkpointed together)."""
+        wm = ctx.last_watermark
+        if self._released_wm is not None:
+            wm = (self._released_wm if wm is None
+                  else max(wm, self._released_wm))
+        return wm
+
     async def _admit(self, batch: Batch, ctx: Context) -> Optional[Batch]:
         """Raw mode admission: SQL-NULL values drop (they never equal an
         extremum); rows of already-released windows match the window's
@@ -870,8 +896,18 @@ class WindowArgmaxOperator(Operator):
         vals = np.asarray(batch.columns[self.value_col])
         keep = (~np.isnan(vals) if vals.dtype.kind == "f"
                 else np.ones(len(vals), dtype=bool))
-        if self._released_wm is not None:
-            late = keep & (ends <= self._released_wm)
+        # lateness keys off the operator's CURRENT input watermark: any
+        # row with window_end <= watermark is late, whether or not that
+        # window ever fired.  Keying off the last-fired window end let a
+        # late row for an EMPTY middle window (no on-time rows, so no
+        # timer, so _released_wm never advanced past it) re-open the
+        # window and emit as its max — the unfused TTL-join plan (and the
+        # reference, whose aggregate drops late rows) emits nothing
+        # there.  _released_wm stays as a lower bound for timer-released
+        # windows at equal watermark.
+        released = self.ctx_watermark(ctx)
+        if released is not None:
+            late = keep & (ends <= released)
             if late.any():
                 keep &= ~late
                 hit = np.zeros(len(ends), dtype=bool)
@@ -1353,7 +1389,12 @@ class NonWindowAggOperator(Operator):
 
     async def handle_watermark(self, watermark: int, ctx: Context) -> None:
         if self.flush_key is not None:
-            await self._flush_ready(watermark, ctx)
+            from ..obs import tracing
+
+            with tracing.span("window.flush_ready", "window",
+                              tid=tracing.ctx_tid(ctx),
+                              args={"watermark": int(watermark)}):
+                await self._flush_ready(watermark, ctx)
         await ctx.broadcast(Message.wm(Watermark.event_time(watermark)))
 
     async def _flush_ready(self, watermark: int, ctx: Context) -> None:

@@ -17,9 +17,13 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time as _time
 import traceback
 from typing import Any, Dict, List, Optional, Tuple
 
+import numpy as np
+
+from ..obs import perf, tracing
 from ..state.store import StateStore
 from ..types import (
     CheckpointBarrier,
@@ -85,10 +89,16 @@ class TaskRunner:
         self.pumps: List[_Pump] = []
         self.finished = asyncio.Event()
         self.failed: Optional[BaseException] = None
+        self._align_start: Dict[int, float] = {}  # epoch -> trace us
 
     # ------------------------------------------------------------------
 
     async def start(self) -> None:
+        # kernel-time attribution: every timed_device dispatch inside this
+        # coroutine's context accrues to this subtask's counter
+        token = perf.set_active_task(
+            perf.KernelAccumulator(self.task_info, self.ctx.metrics))
+        run_start = tracing.now_us()
         try:
             await self._run()
         except asyncio.CancelledError:
@@ -108,6 +118,11 @@ class TaskRunner:
             except Exception:
                 pass
         finally:
+            tracing.record_span(
+                "task.run", "task", run_start,
+                tracing.now_us() - run_start, tid=self.task_info.task_id,
+                args={"failed": self.failed is not None})
+            perf.reset_active_task(token)
             self.finished.set()
 
     async def _run(self) -> None:
@@ -188,14 +203,20 @@ class TaskRunner:
         # avoids two ensure_future + one cancel per message)
         get_merged: Optional[asyncio.Future] = None
         get_control: Optional[asyncio.Future] = None
+        metrics = self.ctx.metrics
         try:
             while ended < n_inputs:
                 if get_merged is None or get_merged.done():
                     get_merged = asyncio.ensure_future(self.merged.get())
                 if get_control is None or get_control.done():
                     get_control = asyncio.ensure_future(self.control_rx.get())
+                wait_t0 = _time.perf_counter()
                 done, _ = await asyncio.wait(
                     [get_merged, get_control], return_when=asyncio.FIRST_COMPLETED)
+                if metrics is not None:
+                    # time this loop sat waiting for input (starvation —
+                    # the upstream-is-slow half of backpressure analysis)
+                    metrics.queue_wait.observe(_time.perf_counter() - wait_t0)
                 if get_control in done:
                     cm = get_control.result()
                     if cm.kind == "commit":
@@ -210,9 +231,28 @@ class TaskRunner:
                 idx, side, msg = get_merged.result()
 
                 if msg.kind == MessageKind.RECORD:
-                    if self.ctx.metrics is not None:
-                        self.ctx.metrics.messages_recv.inc(len(msg.batch))
-                    await self.operator.process_batch(msg.batch, self.ctx, side)
+                    if metrics is not None:
+                        metrics.messages_recv.inc(len(msg.batch))
+                        if len(msg.batch):
+                            # event-time lag at this operator: processing
+                            # wall clock vs the freshest event in the batch.
+                            # Sentinels are excluded by testing the
+                            # timestamp itself (unset/MIN and final-flush
+                            # MAX), not by bounding the lag — a historical
+                            # replay's months-of-backlog lag is exactly the
+                            # signal the histogram exists to carry
+                            ts = int(np.max(msg.batch.timestamp))
+                            if 0 < ts < int(MAX_TIMESTAMP) - 1:
+                                metrics.event_time_lag.observe(
+                                    max((now_micros() - ts) / 1e6, 0.0))
+                        t0 = _time.perf_counter()
+                        await self.operator.process_batch(
+                            msg.batch, self.ctx, side)
+                        metrics.batch_latency.observe(
+                            _time.perf_counter() - t0)
+                    else:
+                        await self.operator.process_batch(
+                            msg.batch, self.ctx, side)
                 elif msg.kind == MessageKind.WATERMARK:
                     advanced = self.ctx.observe_watermark(idx, msg.watermark)
                     if advanced is not None:
@@ -223,6 +263,7 @@ class TaskRunner:
                 elif msg.kind == MessageKind.BARRIER:
                     b = msg.barrier
                     pending_barriers[b.epoch] = b
+                    self._align_start.setdefault(b.epoch, tracing.now_us())
                     await self._report_event(b, CheckpointEventType.STARTED_ALIGNMENT)
                     if self.ctx.counter.observe(idx, b.epoch):
                         del pending_barriers[b.epoch]
@@ -298,6 +339,14 @@ class TaskRunner:
                 self.task_info.task_id, timeout)
 
     async def _advance_watermark(self, wm: int) -> None:
+        if (self.ctx.metrics is not None
+                and 0 < wm < int(MAX_TIMESTAMP) - 1):
+            # watermark lag at this operator: wall clock vs its (newly
+            # advanced) input watermark; the MIN/unset and final-flush
+            # MAX sentinels are not real event times, but an arbitrarily
+            # large replay lag is
+            self.ctx.metrics.watermark_lag.observe(
+                max((now_micros() - wm) / 1e6, 0.0))
         # fire expired event-time timers first (macro lib.rs:738-753)
         for time, key, payload in self.ctx.timers.fire(wm):
             await self.operator.handle_timer(time, key, payload, self.ctx)
@@ -306,11 +355,26 @@ class TaskRunner:
     # -- checkpoint (macro lib.rs:706-736) -------------------------------
 
     async def run_checkpoint(self, barrier: CheckpointBarrier) -> None:
+        tid = self.task_info.task_id
+        align_start = self._align_start.pop(barrier.epoch, None)
+        if align_start is not None:
+            tracing.record_span("barrier.align", "checkpoint", align_start,
+                                tracing.now_us() - align_start, tid=tid,
+                                args={"epoch": barrier.epoch})
         await self._report_event(barrier, CheckpointEventType.STARTED_CHECKPOINTING)
-        await self.operator.pre_checkpoint(barrier, self.ctx)
+        with tracing.span("checkpoint.pre", "checkpoint", tid=tid,
+                          args={"epoch": barrier.epoch}):
+            await self.operator.pre_checkpoint(barrier, self.ctx)
         self.ctx.state.get_global_keyed_state("[").insert(
             "timers", self.ctx.timers.snapshot())
-        metadata = self.ctx.state.checkpoint(barrier.epoch, self.ctx.last_watermark)
+        with tracing.span("checkpoint.sync", "checkpoint", tid=tid,
+                          args={"epoch": barrier.epoch}):
+            metadata = self.ctx.state.checkpoint(barrier.epoch,
+                                                 self.ctx.last_watermark)
+        if self.ctx.metrics is not None:
+            self.ctx.metrics.checkpoint_duration.observe(max(
+                (metadata.finish_time - metadata.start_time) / 1e6, 0.0))
+            self.ctx.metrics.checkpoint_bytes.observe(metadata.bytes)
         await self._report_event(barrier, CheckpointEventType.FINISHED_SYNC)
         await self.ctx.report(ControlResp(
             kind="checkpoint_completed",

@@ -25,6 +25,7 @@ import asyncio
 import io
 import logging
 import struct
+import time as _time
 from dataclasses import dataclass
 from typing import Any, Awaitable, Callable, Dict, Optional, Tuple
 
@@ -42,7 +43,8 @@ from ..types import (
 
 logger = logging.getLogger(__name__)
 
-from ..obs.metrics import BYTES_RECV, BYTES_SENT  # noqa: E402
+from ..obs.metrics import (BYTES_RECV, BYTES_SENT, FLUSH_LATENCY,  # noqa: E402
+                           FRAME_BYTES)
 
 MAGIC = 0xA770_10CB
 KIND_DATA = 0
@@ -159,22 +161,31 @@ class NetworkManager:
         self._in_writers: list = []  # accepted connections, closed on close()
         self._pending: Dict[Quad, list] = {}  # frames ahead of registration
         # labeled prometheus children resolved once per quad, off hot path
-        self._byte_counters: Dict[Tuple[str, str, int], Any] = {}
+        self._metric_children: Dict[Tuple[str, str, int], Any] = {}
 
-    def _bytes_counter(self, name: str, op_id: str, idx: int):
-        """Wire-byte accounting with the reference's metric names and task
-        labels (arroyo-types/src/lib.rs:736-737)."""
+    def _labeled_child(self, factory, name: str, help_: str,
+                       op_id: str, idx: int):
+        """Labeled prometheus child per (metric, edge endpoint), with the
+        reference's task labels (arroyo-types/src/lib.rs:736-737)."""
         key = (name, op_id, idx)
-        child = self._byte_counters.get(key)
+        child = self._metric_children.get(key)
         if child is None:
-            from ..obs.metrics import _counter
-
-            child = _counter(name, "serialized bytes on the data "
-                             "plane").labels(
+            child = factory(name, help_).labels(
                 job_id=self.job_id, operator_id=op_id,
                 subtask_idx=str(idx), operator_name=op_id)
-            self._byte_counters[key] = child
+            self._metric_children[key] = child
         return child
+
+    def _bytes_counter(self, name: str, op_id: str, idx: int):
+        from ..obs.metrics import _counter
+
+        return self._labeled_child(
+            _counter, name, "serialized bytes on the data plane", op_id, idx)
+
+    def _frame_histogram(self, name: str, help_: str, op_id: str, idx: int):
+        from ..obs.metrics import _histogram
+
+        return self._labeled_child(_histogram, name, help_, op_id, idx)
 
     # -- receiving ---------------------------------------------------------
 
@@ -231,14 +242,24 @@ class NetworkManager:
         """An OutQueue-compatible async send fn for a remote edge."""
 
         sent_counter = self._bytes_counter(BYTES_SENT, quad[0], quad[1])
+        frame_bytes = self._frame_histogram(
+            FRAME_BYTES, "serialized payload bytes per data-plane frame",
+            quad[0], quad[1])
+        flush_latency = self._frame_histogram(
+            FLUSH_LATENCY, "writer lock wait + socket drain seconds per "
+            "frame", quad[0], quad[1])
 
         async def send(msg: Message) -> None:
             writer = self._out_writers[addr]
             kind, payload = encode_message(msg)
             sent_counter.inc(len(payload))
+            frame_bytes.observe(len(payload))
+            t0 = _time.perf_counter()
             async with self._out_locks[addr]:
                 _write_frame(writer, quad, kind, payload)
                 await writer.drain()
+            # lock wait + socket drain: the network half of backpressure
+            flush_latency.observe(_time.perf_counter() - t0)
 
         return send
 

@@ -7,5 +7,6 @@ The arroyo-server-common + arroyo-metrics analog
 
 from .logging_setup import init_logging  # noqa: F401
 from .metrics import (TaskMetrics, counter_for_task, gauge_for_task,  # noqa: F401
-                      render_metrics)
+                      histogram_for_task, render_metrics)
 from .admin import AdminServer  # noqa: F401
+from . import tracing  # noqa: F401

@@ -36,6 +36,21 @@ class AdminServer:
             return Response(body=render_metrics(),
                             content_type="text/plain; version=0.0.4")
 
+        # flight-recorder export: the span ring (task lifecycle, barrier
+        # alignment, checkpoint phases, window fires, kernel dispatch,
+        # data-plane flushes) as Chrome-trace JSON — open in
+        # ui.perfetto.dev.  ?cat=checkpoint filters to one category;
+        # ?reset=1 clears the ring after export.
+        @router.get("/trace")
+        async def trace(req: Request):
+            from . import tracing
+
+            out = tracing.chrome_trace(req.query.get("cat") or None)
+            if req.query.get("reset"):
+                tracing.reset()
+            return Response(body=json.dumps(out).encode(),
+                            content_type="application/json")
+
         @router.get("/details")
         async def details(req: Request):
             return {"service": f"arroyo-{self.service}",

@@ -13,7 +13,7 @@ import threading
 from typing import Dict, Optional
 
 from prometheus_client import (CollectorRegistry, Counter, Gauge,
-                               generate_latest)
+                               Histogram, generate_latest)
 
 MESSAGES_RECV = "arroyo_worker_messages_recv"
 MESSAGES_SENT = "arroyo_worker_messages_sent"
@@ -22,13 +22,49 @@ BYTES_SENT = "arroyo_worker_bytes_sent"
 TX_QUEUE_SIZE = "arroyo_worker_tx_queue_size"
 TX_QUEUE_REM = "arroyo_worker_tx_queue_rem"
 
+# flight-recorder instruments (this file is the single name registry —
+# the docs table in docs/operations.md mirrors it)
+EVENT_TIME_LAG = "arroyo_worker_event_time_lag_seconds"
+WATERMARK_LAG = "arroyo_worker_watermark_lag_seconds"
+BATCH_LATENCY = "arroyo_worker_batch_processing_seconds"
+QUEUE_WAIT = "arroyo_worker_queue_wait_seconds"
+BACKPRESSURE_TIME = "arroyo_worker_backpressure_seconds_total"
+KERNEL_TIME = "arroyo_worker_kernel_seconds_total"
+CHECKPOINT_DURATION = "arroyo_worker_checkpoint_duration_seconds"
+CHECKPOINT_BYTES = "arroyo_worker_checkpoint_bytes"
+FRAME_BYTES = "arroyo_worker_frame_bytes"
+FLUSH_LATENCY = "arroyo_worker_flush_seconds"
+
 LABELS = ("job_id", "operator_id", "subtask_idx", "operator_name")
+
+# lag can span ms (steady state) to minutes (recovery backlog)
+LAG_BUCKETS = (0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+               30.0, 60.0, 300.0, 1800.0)
+# per-batch host/device latencies: 100us up to multi-second stalls
+LATENCY_BUCKETS = (0.0001, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                   0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 10.0)
+BYTES_BUCKETS = (1e3, 1e4, 1e5, 1e6, 4e6, 1.6e7, 6.4e7, 2.56e8)
+
+_BUCKETS = {
+    EVENT_TIME_LAG: LAG_BUCKETS,
+    WATERMARK_LAG: LAG_BUCKETS,
+    BATCH_LATENCY: LATENCY_BUCKETS,
+    QUEUE_WAIT: LATENCY_BUCKETS,
+    # checkpoints span sub-second (tiny state) to minutes (large device
+    # tables over a remote tunnel) — the lag buckets' 1800s ceiling fits;
+    # the latency buckets would collapse everything past 10s into +Inf
+    CHECKPOINT_DURATION: LAG_BUCKETS,
+    CHECKPOINT_BYTES: BYTES_BUCKETS,
+    FRAME_BYTES: BYTES_BUCKETS,
+    FLUSH_LATENCY: LATENCY_BUCKETS,
+}
 
 # one registry per process (worker); the admin server renders it
 REGISTRY = CollectorRegistry()
 _lock = threading.Lock()
 _counters: Dict[str, Counter] = {}
 _gauges: Dict[str, Gauge] = {}
+_histograms: Dict[str, Histogram] = {}
 
 
 def _counter(name: str, help_: str) -> Counter:
@@ -44,6 +80,16 @@ def _gauge(name: str, help_: str) -> Gauge:
         if name not in _gauges:
             _gauges[name] = Gauge(name, help_, LABELS, registry=REGISTRY)
         return _gauges[name]
+
+
+def _histogram(name: str, help_: str) -> Histogram:
+    with _lock:
+        if name not in _histograms:
+            _histograms[name] = Histogram(
+                name, help_, LABELS,
+                buckets=_BUCKETS.get(name, Histogram.DEFAULT_BUCKETS),
+                registry=REGISTRY)
+        return _histograms[name]
 
 
 def counter_for_task(task_info, name: str, help_: str = "") -> Counter:
@@ -64,9 +110,20 @@ def gauge_for_task(task_info, name: str, help_: str = "") -> Gauge:
                               task_info.operator_id))
 
 
+def histogram_for_task(task_info, name: str, help_: str = "") -> Histogram:
+    """Labeled histogram child for one subtask (same label scheme as the
+    counters, so rate()/histogram_quantile() queries join on labels)."""
+    return _histogram(name, help_ or name).labels(
+        job_id=task_info.job_id, operator_id=task_info.operator_id,
+        subtask_idx=str(task_info.task_index),
+        operator_name=getattr(task_info, "operator_name",
+                              task_info.operator_id))
+
+
 class TaskMetrics:
-    """The six per-task instruments every subtask maintains
-    (arroyo-worker/src/metrics.rs)."""
+    """Per-task instruments every subtask maintains: the reference's six
+    flat counters/gauges (arroyo-worker/src/metrics.rs) plus the flight
+    recorder's lag/latency/backpressure histograms."""
 
     def __init__(self, task_info):
         self.messages_recv = counter_for_task(
@@ -81,6 +138,30 @@ class TaskMetrics:
             task_info, TX_QUEUE_SIZE, "outbound queue capacity")
         self.tx_queue_rem = gauge_for_task(
             task_info, TX_QUEUE_REM, "outbound queue remaining slots")
+        self.event_time_lag = histogram_for_task(
+            task_info, EVENT_TIME_LAG,
+            "processing-time minus max event time per received batch")
+        self.watermark_lag = histogram_for_task(
+            task_info, WATERMARK_LAG,
+            "processing-time minus the operator's input watermark")
+        self.batch_latency = histogram_for_task(
+            task_info, BATCH_LATENCY,
+            "wall time spent in process_batch per batch")
+        self.queue_wait = histogram_for_task(
+            task_info, QUEUE_WAIT,
+            "time the task loop waited for input per message")
+        self.backpressure_time = counter_for_task(
+            task_info, BACKPRESSURE_TIME,
+            "cumulative seconds blocked sending to full downstream queues")
+        self.kernel_time = counter_for_task(
+            task_info, KERNEL_TIME,
+            "cumulative seconds in device-kernel dispatch for this subtask")
+        self.checkpoint_duration = histogram_for_task(
+            task_info, CHECKPOINT_DURATION,
+            "subtask checkpoint duration (sync phase)")
+        self.checkpoint_bytes = histogram_for_task(
+            task_info, CHECKPOINT_BYTES,
+            "bytes written per subtask checkpoint")
 
 
 def render_metrics(registry: Optional[CollectorRegistry] = None) -> bytes:
@@ -122,3 +203,68 @@ def table_size_gauge(task_info, table_char: str) -> Gauge:
         operator_id=task_info.operator_id,
         task_id=str(task_info.task_index),
         table_char=table_char)
+
+
+CHECKPOINT_TABLE_SECONDS = "arroyo_worker_checkpoint_table_seconds"
+CHECKPOINT_TABLE_BYTES = "arroyo_worker_checkpoint_table_bytes"
+_table_ckpt_gauges: Dict[str, Gauge] = {}
+
+
+def checkpoint_table_gauge(task_info, table_char: str, which: str) -> Gauge:
+    """Per-table checkpoint cost gauges, refreshed at every barrier:
+    ``which`` is 'seconds' (serialize+write wall time) or 'bytes'
+    (compressed file size).  Same label scheme as table_size_gauge so
+    dashboards join the three per-table families."""
+    name = (CHECKPOINT_TABLE_SECONDS if which == "seconds"
+            else CHECKPOINT_TABLE_BYTES)
+    with _lock:
+        if name not in _table_ckpt_gauges:
+            _table_ckpt_gauges[name] = Gauge(
+                name, f"last checkpoint {which} for the table",
+                TABLE_LABELS, registry=REGISTRY)
+    return _table_ckpt_gauges[name].labels(
+        job_id=task_info.job_id,
+        operator_id=task_info.operator_id,
+        task_id=str(task_info.task_index),
+        table_char=table_char)
+
+
+# -- heartbeat-sized rollups -------------------------------------------------
+
+# summary keys are metric names with the arroyo_worker_ prefix stripped;
+# histograms contribute their _sum/_count pair (enough for avg + rate
+# math controller-side without shipping every bucket)
+_SUMMARY_SKIP_SUFFIXES = ("_bucket", "_created")
+
+# lag/latency histograms and the queue gauges ALSO ship per-subtask
+# values (`key@idx`): the controller's rollup takes the worst subtask,
+# and summing across co-located subtasks first would average a single
+# hot subtask away — the exact signal the rollup exists to carry
+_PER_SUBTASK_FAMS = ("event_time_lag_seconds", "watermark_lag_seconds",
+                     "batch_processing_seconds", "queue_wait_seconds",
+                     "tx_queue_size", "tx_queue_rem")
+
+
+def job_operator_summary(job_id: str) -> Dict[str, Dict[str, float]]:
+    """Compact per-operator rollup of this process's registry for one job
+    — what a worker attaches to its heartbeat so the controller can serve
+    job-level aggregation without scraping workers over HTTP."""
+    out: Dict[str, Dict[str, float]] = {}
+    prefix = "arroyo_worker_"
+    for fam in REGISTRY.collect():
+        if not fam.name.startswith(prefix.rstrip("_")):
+            continue
+        for s in fam.samples:
+            if s.name.endswith(_SUMMARY_SKIP_SUFFIXES):
+                continue
+            if s.labels.get("job_id") != job_id:
+                continue
+            op = s.labels.get("operator_id", "")
+            key = s.name[len(prefix):] if s.name.startswith(prefix) else s.name
+            g = out.setdefault(op, {})
+            g[key] = g.get(key, 0.0) + s.value
+            sub = s.labels.get("subtask_idx")
+            if sub is not None and key.startswith(_PER_SUBTASK_FAMS):
+                sk = f"{key}@{sub}"
+                g[sk] = g.get(sk, 0.0) + s.value
+    return out

@@ -1,21 +1,82 @@
-"""Lightweight performance accounting, enabled by ``ARROYO_TIMING=1``.
+"""Lightweight performance accounting.
 
-Answers the two questions BASELINE.md's protocol needs (and the reference
-answers with pyroscope + prometheus): how much of the wall-clock went to
-device kernels vs the host loop, and what the end-to-end latency
-distribution looks like.  Device time is measured by blocking on the
-kernel result at the call site, so enabling timing serializes dispatch —
-use for measurement runs, not production.
+Two tiers:
+
+* **Always-cheap per-operator accumulator** — every ``timed_device`` call
+  made while a task has installed a :class:`KernelAccumulator` (the
+  TaskRunner does this) adds its dispatch wall time to that operator's
+  ``arroyo_worker_kernel_seconds_total`` counter and, for spans above a
+  floor, to the flight-recorder trace ring.  Dispatch is *not* blocked
+  on, so the cost is two ``perf_counter_ns`` reads per kernel — safe in
+  production.
+* **Blocking measurement mode**, enabled by ``ARROYO_TIMING=1``: blocks
+  on the kernel result at the call site so the ``device_ns`` counter is
+  true device time.  Serializes dispatch — use for measurement runs
+  (bench.py's device_share), not production.
 """
 
 from __future__ import annotations
 
+import contextvars
 import os
 import time
-from typing import Any, Dict
+from contextvars import ContextVar
+from typing import Any, Dict, Optional
 
 _COUNTERS: Dict[str, int] = {}
 _NOTES: Dict[str, Any] = {}
+
+# spans shorter than this don't earn a trace-ring entry (the counter
+# still accumulates them); keeps micro-kernels from flooding the ring
+_TRACE_FLOOR_NS = 50_000
+
+
+class KernelAccumulator:
+    """Per-subtask kernel-time sink: a prometheus counter child plus
+    identity for trace spans.  Installed by the TaskRunner for the
+    duration of its coroutine (contextvars flow through awaits, so every
+    kernel the operator dispatches on the event loop lands here)."""
+
+    __slots__ = ("task_id", "operator_id", "counter")
+
+    def __init__(self, task_info, metrics=None):
+        self.task_id = task_info.task_id
+        self.operator_id = task_info.operator_id
+        self.counter = getattr(metrics, "kernel_time", None)
+
+    def add(self, ns: int) -> None:
+        if self.counter is not None:
+            self.counter.inc(ns / 1e9)
+        if ns >= _TRACE_FLOOR_NS:
+            from . import tracing
+
+            end = tracing.now_us()
+            tracing.record_span("kernel", "kernel", end - ns / 1e3,
+                                ns / 1e3, tid=self.task_id)
+
+
+_ACTIVE_TASK: ContextVar[Optional[KernelAccumulator]] = ContextVar(
+    "arroyo_active_kernel_acc", default=None)
+
+
+def set_active_task(acc: Optional[KernelAccumulator]):
+    """Install the accumulator for the current (coroutine) context;
+    returns a token for ``reset_active_task``."""
+    return _ACTIVE_TASK.set(acc)
+
+
+def reset_active_task(token) -> None:
+    _ACTIVE_TASK.reset(token)
+
+
+def run_offloaded(loop, fn, *args):
+    """``loop.run_in_executor`` with contextvars propagated: executor
+    threads don't inherit the caller's context, so kernels dispatched
+    from an offloaded transfer would otherwise bypass the active task's
+    accumulator and report zero kernel time exactly on the accelerator
+    backends where offload is enabled."""
+    ctx = contextvars.copy_context()
+    return loop.run_in_executor(None, lambda: ctx.run(fn, *args))
 
 
 def timing_enabled() -> bool:
@@ -40,15 +101,23 @@ def get_note(key: str, default: Any = None) -> Any:
 
 
 def timed_device(call, *args):
-    """Run a jitted kernel call; when timing is on, block until the result
-    is ready and account the wall time to the ``device_ns`` counter."""
-    if not timing_enabled():
+    """Run a jitted kernel call.  Always: attribute dispatch wall time to
+    the active task's kernel accumulator (cheap, non-blocking).  With
+    ``ARROYO_TIMING=1``: additionally block until the result is ready and
+    account true device time to the ``device_ns`` counter."""
+    blocking = timing_enabled()
+    acc = _ACTIVE_TASK.get()
+    if not blocking and acc is None:
         return call(*args)
-    import jax
-
     t0 = time.perf_counter_ns()
     out = call(*args)
-    jax.block_until_ready(out)
-    _COUNTERS["device_ns"] = (_COUNTERS.get("device_ns", 0)
-                              + time.perf_counter_ns() - t0)
+    if blocking:
+        import jax
+
+        jax.block_until_ready(out)
+    dt = time.perf_counter_ns() - t0
+    if blocking:
+        _COUNTERS["device_ns"] = _COUNTERS.get("device_ns", 0) + dt
+    if acc is not None:
+        acc.add(dt)
     return out

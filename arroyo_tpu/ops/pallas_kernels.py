@@ -38,6 +38,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+try:
+    _enable_x64 = jax.enable_x64  # jax >= 0.5 top-level export
+except AttributeError:
+    from jax.experimental import enable_x64 as _enable_x64
+
 try:  # pallas ships with jax, but guard for exotic builds
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu  # noqa: F401
@@ -163,7 +168,7 @@ def scatter_add_channels(slots: np.ndarray, bins: np.ndarray,
     run = _scatter_multi(2 * k, B, C_act, n // CHUNK, _interpret())
     # every operand is 32-bit; trace under x32 — Mosaic's TPU lowering
     # rejects the 64-bit index types that global x64 mode introduces
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         out = run(jnp.asarray(slots, jnp.int32),
                   jnp.asarray(bins, jnp.int32),
                   jnp.asarray(w2))  # [2k, C_act, B]
@@ -225,7 +230,7 @@ def update_bin_state(values: jnp.ndarray, counts: jnp.ndarray,
     packed[1] = bins
     packed[2:] = w2
     delta = _update_delta_call(k, B, C_act, n // CHUNK, _interpret())
-    with jax.enable_x64(False):
+    with _enable_x64(False):
         deltas = delta(jnp.asarray(packed))
     return _apply_delta_call(k, C_act)(values, counts, deltas)
 

@@ -38,6 +38,25 @@ OP_INSERT = 0
 OP_DELETE_KEY = 1
 
 
+def _record_table_checkpoint(task: TaskInfo, table: str, seconds: float,
+                             nbytes: int) -> None:
+    """Per-table checkpoint cost: gauges + a flight-recorder span (best
+    effort — persistence must never fail on a metrics problem)."""
+    try:
+        from ..obs import tracing
+        from ..obs.metrics import checkpoint_table_gauge
+
+        checkpoint_table_gauge(task, table, "seconds").set(seconds)
+        checkpoint_table_gauge(task, table, "bytes").set(nbytes)
+        end = tracing.now_us()
+        tracing.record_span(
+            "checkpoint.table", "checkpoint", end - seconds * 1e6,
+            seconds * 1e6, tid=task.task_id,
+            args={"table": table, "bytes": nbytes})
+    except Exception:
+        pass
+
+
 def key_hash_of(key: Any) -> int:
     """u64 hash for range partitioning of checkpointed keys.  Integer keys are
     assumed to already be key-space hashes (our keyed operators key by the
@@ -267,6 +286,7 @@ class ParquetBackend(BackingStore):
             bytes=0, watermark=watermark,
         )
         for name, snap in tables.items():
+            t_table = _time.perf_counter()
             kh, ts, keys, values, ops = _serialize_rows(snap)
             if len(kh) == 0:
                 continue
@@ -289,6 +309,8 @@ class ParquetBackend(BackingStore):
                 min_key_hash=int(kh.min()) if len(kh) else 0,
                 max_key_hash=int(kh.max()) if len(kh) else int(U64_MAX),
             )
+            _record_table_checkpoint(
+                task, name, _time.perf_counter() - t_table, len(data))
         meta.finish_time = _time.time_ns() // 1_000
         self.storage.put(
             self.metadata_file(task.job_id, epoch, task.operator_id, task.task_index),

@@ -105,6 +105,7 @@ class WorkerServer:
         def run() -> None:
             async def beat() -> None:
                 client = RpcClient(controller_addr, "ControllerGrpc")
+                rollup_warned = False
                 while not stop.is_set():
                     # chunked sleep: exit promptly on shutdown
                     slept = 0.0
@@ -114,9 +115,35 @@ class WorkerServer:
                     if stop.is_set():
                         break
                     try:
+                        # piggyback a compact per-operator metric rollup on
+                        # the heartbeat: the controller aggregates these
+                        # into job-level rates/lag/backpressure without
+                        # ever scraping workers over HTTP (registry
+                        # collection is thread-safe, so reading it from
+                        # the heartbeat thread is fine).  msgpack-packed:
+                        # the proto field is opaque bytes so the nested
+                        # {op: {metric: value}} map needs no proto schema
+                        try:
+                            from ..obs.metrics import job_operator_summary
+                            from ..rpc.transport import _ser_msgpack
+
+                            summary = _ser_msgpack(
+                                job_operator_summary(job_id))
+                        except Exception:
+                            # heartbeats must keep flowing without the
+                            # rollup, but say so once: a persistent pack
+                            # failure otherwise silently blanks every
+                            # job-level rollup the console serves
+                            if not rollup_warned:
+                                rollup_warned = True
+                                logger.warning(
+                                    "heartbeat metrics rollup failed; "
+                                    "heartbeats continue without metrics",
+                                    exc_info=True)
+                            summary = None
                         await client.call("Heartbeat", {
                             "worker_id": worker_id, "job_id": job_id,
-                            "time": now_micros()})
+                            "time": now_micros(), "metrics": summary})
                     except Exception as e:
                         if not stop.is_set():
                             logger.warning("heartbeat failed: %s", e)

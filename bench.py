@@ -307,9 +307,37 @@ def bench_parallelism() -> int:
     return min(4, max(1, os.cpu_count() or 1))
 
 
+def operator_flight_stats(before: dict, after: dict) -> dict:
+    """Per-operator deltas of the flight-recorder counters across the
+    timed runs (obs.metrics.job_operator_summary snapshots): where the
+    kernel seconds, backpressure stalls, and per-batch latency landed —
+    the per-operator breakdown the driver reads to see WHICH operator a
+    regression lives in, not just that events/s moved."""
+    ops = {}
+    for op, cur in after.items():
+        prev = before.get(op, {})
+        d = {k: v - prev.get(k, 0.0) for k, v in cur.items()}
+        row = {}
+        for key, out in (("kernel_seconds_total", "kernel_seconds"),
+                         ("backpressure_seconds_total",
+                          "backpressure_seconds"),
+                         ("messages_sent_total", "messages_sent")):
+            if d.get(key, 0.0) > 0:
+                row[out] = round(d[key], 4)
+        for fam, out in (("batch_processing_seconds", "batch_latency_avg"),
+                         ("event_time_lag_seconds", "event_time_lag_avg")):
+            c = d.get(fam + "_count", 0.0)
+            if c > 0:
+                row[out] = round(d.get(fam + "_sum", 0.0) / c, 6)
+        if row:
+            ops[op] = row
+    return ops
+
+
 def run_query(name: str, sql_template: str) -> dict:
     from arroyo_tpu.connectors.memory import clear_sink, sink_output
     from arroyo_tpu.engine.engine import LocalRunner
+    from arroyo_tpu.obs.metrics import job_operator_summary
     from arroyo_tpu.sql import plan_sql
 
     sql = sql_template.format(n=NUM_EVENTS, b=BATCH)
@@ -323,6 +351,7 @@ def run_query(name: str, sql_template: str) -> dict:
     clear_sink("results")
     LocalRunner(prog).run()
 
+    flight_before = job_operator_summary("local-job")
     best_dt = None
     for _ in range(2):
         clear_sink("results")
@@ -331,6 +360,8 @@ def run_query(name: str, sql_template: str) -> dict:
         dt = time.perf_counter() - t0
         best_dt = dt if best_dt is None else min(best_dt, dt)
     dt = best_dt
+    flight = operator_flight_stats(flight_before,
+                                   job_operator_summary("local-job"))
     outs = sink_output("results")
     n_out = sum(len(b) for b in outs)
     assert n_out > 0, f"{name} produced no output"
@@ -342,6 +373,8 @@ def run_query(name: str, sql_template: str) -> dict:
         "unit": "events/sec",
         "parallelism": par,
     }
+    if flight:
+        result["operators"] = flight
     ctl = run_control(name)
     result.update(ctl)
     if "control_events_per_sec" in ctl:

@@ -212,3 +212,255 @@ def test_table_size_gauge_updates_at_checkpoint(tmp_path):
     vals = asyncio.run(run())
     assert vals, "no table-size gauges recorded"
     assert any(v > 0 for v in vals.values())
+
+
+# ---------------------------------------------------------------------------
+# flight recorder: lag/latency histograms, trace spans, checkpoint cost
+# ---------------------------------------------------------------------------
+
+
+def test_lag_and_latency_histograms_populated():
+    """The per-operator flight-recorder histograms (event-time lag,
+    watermark lag, batch latency, queue wait) fill in during a normal
+    watermarked run."""
+    from arroyo_tpu import Stream
+    from arroyo_tpu.engine.engine import LocalRunner
+
+    prog = (Stream.source("impulse", {"event_rate": 100_000.0,
+                                      "message_count": 20_000,
+                                      "event_time_interval_micros": 100,
+                                      "batch_size": 512})
+            .watermark(max_lateness_micros=0)
+            .map(lambda c: {"counter": c["counter"]}, name="lagmap")
+            .sink("blackhole", {}))
+    LocalRunner(prog).run()
+    snap = snapshot()
+
+    def count_of(metric):
+        return sum(v for k, v in snap.items()
+                   if k.startswith(metric + "_count"))
+
+    for metric in ("arroyo_worker_event_time_lag_seconds",
+                   "arroyo_worker_watermark_lag_seconds",
+                   "arroyo_worker_batch_processing_seconds",
+                   "arroyo_worker_queue_wait_seconds"):
+        assert count_of(metric) > 0, (metric, sorted(snap)[:40])
+    # histograms render with reference-compatible names + labels
+    text = render_metrics().decode()
+    assert 'arroyo_worker_event_time_lag_seconds_bucket{' in text
+    assert 'operator_name=' in text
+
+
+def test_admin_trace_endpoint_serves_chrome_trace():
+    """GET /trace returns Chrome-trace JSON (Perfetto-loadable): ph=X
+    complete events with ts/dur microseconds, filterable by category."""
+    from arroyo_tpu.obs import tracing
+
+    async def scenario():
+        tracing.reset()
+        with tracing.span("checkpoint.sync", "checkpoint", tid="op-1-0",
+                          args={"epoch": 3}):
+            pass
+        with tracing.span("kernel", "kernel", tid="op-2-0"):
+            pass
+        admin = AdminServer("worker")
+        port = await admin.start()
+        async with httpx.AsyncClient(
+                base_url=f"http://127.0.0.1:{port}") as c:
+            r = await c.get("/trace")
+            assert r.status_code == 200
+            doc = r.json()
+            evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+            names = {e["name"] for e in evs}
+            assert {"checkpoint.sync", "kernel"} <= names
+            ck = next(e for e in evs if e["name"] == "checkpoint.sync")
+            assert ck["args"]["epoch"] == 3
+            assert ck["tid"] == "op-1-0"
+            assert ck["dur"] >= 0 and ck["ts"] > 0
+            # category filter
+            r = await c.get("/trace", params={"cat": "kernel"})
+            names = {e["name"] for e in r.json()["traceEvents"]
+                     if e["ph"] == "X"}
+            assert names == {"kernel"}
+        await admin.stop()
+
+    asyncio.new_event_loop().run_until_complete(scenario())
+
+
+def test_checkpoint_metrics_and_spans(tmp_path):
+    """After a checkpointed run: per-subtask checkpoint duration/bytes
+    histogram samples, per-table cost gauges, and checkpoint trace spans
+    all appear."""
+    from arroyo_tpu import Stream
+    from arroyo_tpu.engine.engine import Engine
+    from arroyo_tpu.graph.logical import AggKind, AggSpec
+    from arroyo_tpu.obs import tracing
+    from arroyo_tpu.types import StopMode
+
+    tracing.reset()
+    prog = (Stream.source("impulse", {"event_rate": 50_000.0,
+                                      "message_count": 50_000,
+                                      "event_time_interval_micros": 1000,
+                                      "batch_size": 512})
+            .watermark(max_lateness_micros=0)
+            .map(lambda c: {"counter": c["counter"],
+                            "bucket": c["counter"] % 9}, name="ckb")
+            .key_by("bucket")
+            .tumbling_aggregate(1_000_000,
+                                [AggSpec(AggKind.COUNT, None, "cnt")])
+            .sink("blackhole", {}))
+
+    async def run():
+        eng = Engine.for_local(prog, "ckpt-metrics-job",
+                               checkpoint_url=f"file://{tmp_path}/ck")
+        running = eng.start()
+        await asyncio.sleep(0.1)
+        await running.checkpoint(1)
+        assert await running.wait_for_checkpoint(1)
+        await running.stop(StopMode.IMMEDIATE)
+        try:
+            await running.join()
+        except RuntimeError:
+            pass
+
+    asyncio.new_event_loop().run_until_complete(run())
+    snap = snapshot()
+    dur = {k: v for k, v in snap.items()
+           if k.startswith("arroyo_worker_checkpoint_duration_seconds_count")
+           and "ckpt-metrics-job" in k}
+    assert any(v > 0 for v in dur.values()), sorted(snap)[:40]
+    tbl = snapshot("arroyo_worker_checkpoint_table_bytes")
+    assert any(v > 0 and "ckpt-metrics-job" in k for k, v in tbl.items()), tbl
+    cats = {s[0] for s in tracing.spans("checkpoint")}
+    assert "checkpoint.sync" in cats
+    assert "checkpoint.table" in cats
+
+
+def test_kernel_time_attributed_per_operator():
+    """timed_device dispatch time lands in the active task's
+    arroyo_worker_kernel_seconds_total counter (the always-cheap
+    per-operator accumulator generalizing ARROYO_TIMING)."""
+    from arroyo_tpu.obs import perf
+
+    ti = TaskInfo("kacc-job", "op-k", "kernels", 0, 1)
+    tm = TaskMetrics(ti)
+    acc = perf.KernelAccumulator(ti, tm)
+    token = perf.set_active_task(acc)
+    try:
+        out = perf.timed_device(lambda x: x * 2, 21)
+    finally:
+        perf.reset_active_task(token)
+    assert out == 42
+    vals = {k: v for k, v in snapshot(
+        "arroyo_worker_kernel_seconds").items()
+        if "kacc-job" in k and "_total" in k}
+    assert any(v > 0 for v in vals.values()), vals
+
+
+def test_controller_job_rollup_aggregates_heartbeat_snapshots():
+    """The controller folds per-worker heartbeat summaries into job-level
+    per-operator rollups: counters sum across workers, rates come from
+    sample deltas, lag is worst-across-workers, backpressure from the
+    queue gauges."""
+    from arroyo_tpu import Stream
+    from arroyo_tpu.controller.controller import (ControllerServer, Job,
+                                                  WorkerInfo)
+
+    prog = (Stream.source("impulse", {"event_rate": 1.0,
+                                      "message_count": 1})
+            .sink("blackhole", {}))
+    ctrl = ControllerServer.__new__(ControllerServer)  # no sockets needed
+    ctrl.jobs = {}
+    job = Job("rj", prog, "file:///tmp/x", 1)
+    ctrl.jobs["rj"] = job
+    w = WorkerInfo("w0", "", "", 1)
+    w.prev_snapshot = {"opA": {"messages_sent_total": 100.0,
+                               "event_time_lag_seconds_sum": 1.0,
+                               "event_time_lag_seconds_count": 10.0}}
+    w.prev_time = 100.0
+    w.metric_snapshot = {"opA": {"messages_sent_total": 300.0,
+                                 "messages_recv_total": 300.0,
+                                 "event_time_lag_seconds_sum": 3.0,
+                                 "event_time_lag_seconds_count": 20.0,
+                                 "tx_queue_size": 100.0,
+                                 "tx_queue_rem": 25.0,
+                                 "kernel_seconds_total": 1.5}}
+    w.snapshot_time = 102.0
+    w2 = WorkerInfo("w1", "", "", 1)
+    w2.metric_snapshot = {"opA": {"messages_sent_total": 50.0,
+                                  "event_time_lag_seconds_sum": 50.0,
+                                  "event_time_lag_seconds_count": 10.0}}
+    w2.snapshot_time = 102.0
+    job.workers = {"w0": w, "w1": w2}
+    (agg,) = ctrl.job_rollup("rj")
+    assert agg["operator_id"] == "opA"
+    assert agg["workers"] == 2
+    assert agg["messages_sent"] == 350.0
+    assert agg["records_per_sec"] == pytest.approx(100.0)  # (300-100)/2s
+    # worst lag across workers: w0's window avg 0.2s vs w1's lifetime 5s
+    assert agg["event_time_lag"] == pytest.approx(5.0)
+    assert agg["backpressure"] == pytest.approx(0.75)
+    assert agg["kernel_seconds"] == pytest.approx(1.5)
+
+
+def test_job_rollup_lag_is_worst_subtask_not_worker_average():
+    """Workers ship per-subtask histogram pairs (`fam_sum@idx`) so the
+    rollup reports the worst co-located subtask, not the worker-wide
+    average that would hide one hot subtask among idle siblings."""
+    from arroyo_tpu import Stream
+    from arroyo_tpu.controller.controller import (ControllerServer, Job,
+                                                  WorkerInfo)
+
+    prog = (Stream.source("impulse", {"event_rate": 1.0,
+                                      "message_count": 1})
+            .sink("blackhole", {}))
+    ctrl = ControllerServer.__new__(ControllerServer)
+    ctrl.jobs = {}
+    job = Job("rj2", prog, "file:///tmp/x", 1)
+    ctrl.jobs["rj2"] = job
+    w = WorkerInfo("w0", "", "", 1)
+    # one worker hosting 4 subtasks: three at 0.1s avg lag, one at 60s.
+    # The flat worker-summed pair averages to ~15.1s; the per-subtask
+    # pairs must surface 60s.
+    snap = {"event_time_lag_seconds_sum": 60.3,
+            "event_time_lag_seconds_count": 4.0,
+            # one subtask saturated (rem 0), three idle: summed gauges
+            # say backpressure 0.25, worst subtask says 1.0
+            "tx_queue_size": 400.0, "tx_queue_rem": 300.0}
+    for i, (s, c) in enumerate([(0.1, 1.0), (0.1, 1.0), (0.1, 1.0),
+                                (60.0, 1.0)]):
+        snap[f"event_time_lag_seconds_sum@{i}"] = s
+        snap[f"event_time_lag_seconds_count@{i}"] = c
+        snap[f"tx_queue_size@{i}"] = 100.0
+        snap[f"tx_queue_rem@{i}"] = 0.0 if i == 3 else 100.0
+    w.metric_snapshot = {"opA": snap}
+    w.snapshot_time = 102.0
+    job.workers = {"w0": w}
+    (agg,) = ctrl.job_rollup("rj2")
+    assert agg["event_time_lag"] == pytest.approx(60.0)
+    assert agg["backpressure"] == pytest.approx(1.0)
+    assert "_bp_worst" not in agg
+
+    # legacy/flat payloads (no @ keys) still roll up via the summed pair
+    w.metric_snapshot = {"opA": {"event_time_lag_seconds_sum": 60.3,
+                                 "event_time_lag_seconds_count": 4.0}}
+    (agg,) = ctrl.job_rollup("rj2")
+    assert agg["event_time_lag"] == pytest.approx(60.3 / 4.0)
+
+
+def test_job_operator_summary_ships_per_subtask_lag_pairs():
+    """The heartbeat summary carries per-subtask `_sum@idx/_count@idx`
+    pairs for the lag/latency families alongside the worker-summed flat
+    pair (which bench.py and legacy consumers keep reading)."""
+    from arroyo_tpu.obs.metrics import job_operator_summary
+
+    TaskMetrics(TaskInfo("subjob", "opS", "opS", 0, 2)) \
+        .event_time_lag.observe(0.1)
+    TaskMetrics(TaskInfo("subjob", "opS", "opS", 1, 2)) \
+        .event_time_lag.observe(60.0)
+    g = job_operator_summary("subjob")["opS"]
+    assert g["event_time_lag_seconds_count"] == pytest.approx(2.0)
+    assert g["event_time_lag_seconds_sum"] == pytest.approx(60.1)
+    assert g["event_time_lag_seconds_sum@0"] == pytest.approx(0.1)
+    assert g["event_time_lag_seconds_sum@1"] == pytest.approx(60.0)
+    assert g["event_time_lag_seconds_count@1"] == pytest.approx(1.0)

@@ -1,0 +1,58 @@
+#!/usr/bin/env bash
+# smoke.sh — <60s pre-snapshot gate.
+#
+# Round 5 shipped a Nexmark source that crashed on every run: the bench
+# recorded 0 events/s and nothing pointed at the failing operator.  This
+# gate catches that class of regression before a snapshot lands:
+#
+#   1. a tiny Nexmark pipeline end-to-end through the SQL planner and
+#      LocalRunner — non-zero exit on any source crash or empty sink;
+#   2. the metrics scrape must be non-empty and contain the
+#      flight-recorder histogram families (an empty scrape means the
+#      obs wiring regressed even if the pipeline "ran");
+#   3. tests/test_obs.py — the observability contract suite.
+#
+# Usage: tools/smoke.sh   (from anywhere; runs on CPU for determinism)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+python - <<'PY'
+import sys
+
+from arroyo_tpu.connectors.memory import clear_sink, sink_output
+from arroyo_tpu.engine.engine import LocalRunner
+from arroyo_tpu.obs.metrics import render_metrics
+from arroyo_tpu.sql import plan_sql
+
+SQL = """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark', event_rate = '1000000', num_events = '50000',
+  rate_limited = 'false', batch_size = '4096'
+);
+SELECT bid.auction as auction,
+       TUMBLE(INTERVAL '2' SECOND) as window,
+       count(*) AS num
+FROM nexmark WHERE bid is not null GROUP BY 1, 2
+"""
+
+clear_sink("results")
+LocalRunner(plan_sql(SQL)).run()
+rows = sum(len(b) for b in sink_output("results"))
+if rows <= 0:
+    sys.exit("smoke: nexmark pipeline produced no output "
+             "(silent-source-crash regression)")
+
+text = render_metrics().decode()
+if not text.strip():
+    sys.exit("smoke: /metrics scrape is empty")
+for family in ("arroyo_worker_messages_recv",
+               "arroyo_worker_event_time_lag_seconds_bucket",
+               "arroyo_worker_batch_processing_seconds_bucket",
+               "arroyo_worker_queue_wait_seconds_bucket"):
+    if family not in text:
+        sys.exit(f"smoke: metrics scrape is missing {family}")
+print(f"smoke: nexmark ok ({rows} result rows), metrics scrape ok")
+PY
+
+exec python -m pytest tests/test_obs.py -q -p no:cacheprovider

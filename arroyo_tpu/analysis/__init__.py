@@ -1,0 +1,47 @@
+"""arroyolint — streaming-invariant static analysis for arroyo_tpu.
+
+Two halves, mirroring how the reference leans on rustc to reject whole
+bug classes before they run:
+
+1. **Codebase lints** (AST passes over the package, ``python -m
+   arroyo_tpu.analysis``): checkpoint-state arity/schema consistency
+   (the round-5 Nexmark 3-vs-4 unpack crash class), blocking calls in
+   async hot paths, implicit host<->device syncs in operator
+   steady-state code, trace purity of functions handed to
+   ``jax.jit``/``pallas_call``, and drift between ``rpc.proto`` and the
+   hand-surgered ``rpc_pb2.py`` descriptors.
+
+2. **Plan-time validation** (``validate_program``): graph-level
+   invariants over ``graph.logical.Program`` — keyed-state operators
+   behind shuffle edges, watermark/window consistency, join key-schema
+   agreement, no dangling nodes — run at pipeline-create time
+   (api/rest.py) and before compilation (engine/build.py).
+
+Findings support inline waivers::
+
+    something_flagged()  # arroyolint: disable=<pass> -- reason
+
+plus a checked-in baseline (tools/arroyolint_baseline.json) for
+accepted pre-existing findings; the CI gate requires zero findings that
+are neither waived nor baselined.
+"""
+
+from .core import (  # noqa: F401
+    DEFAULT_BASELINE,
+    Finding,
+    load_baseline,
+    run_analysis,
+    write_baseline,
+)
+from .plan_validator import (  # noqa: F401
+    PlanDiagnostic,
+    PlanValidationError,
+    check_program,
+    validate_program,
+)
+
+__all__ = [
+    "Finding", "run_analysis", "load_baseline", "write_baseline",
+    "DEFAULT_BASELINE", "PlanDiagnostic", "PlanValidationError",
+    "check_program", "validate_program",
+]

@@ -1,0 +1,81 @@
+"""``python -m arroyo_tpu.analysis`` — run arroyolint over the package.
+
+Exit status: 0 when every finding is waived or baselined, 1 otherwise
+(the CI gate contract; tools/lint.sh and tools/smoke.sh call this).
+
+    python -m arroyo_tpu.analysis                 # lint arroyo_tpu/
+    python -m arroyo_tpu.analysis path1 path2     # explicit paths
+    python -m arroyo_tpu.analysis --json          # machine-readable
+    python -m arroyo_tpu.analysis --all           # show waived too
+    python -m arroyo_tpu.analysis --pass ckpt-arity,host-sync
+    python -m arroyo_tpu.analysis --write-baseline  # accept current
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .core import (
+    DEFAULT_BASELINE,
+    run_analysis,
+    unwaived,
+    write_baseline,
+)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m arroyo_tpu.analysis",
+        description="arroyolint: streaming-invariant static analysis")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: the arroyo_tpu "
+                         "package)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: "
+                         "tools/arroyolint_baseline.json)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report everything)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept all current unwaived findings into "
+                         "the baseline file")
+    ap.add_argument("--pass", dest="passes",
+                    help="comma-separated pass ids to run")
+    ap.add_argument("--json", action="store_true",
+                    help="emit findings as JSON")
+    ap.add_argument("--all", action="store_true",
+                    help="also print waived/baselined findings")
+    args = ap.parse_args(argv)
+
+    passes = ([p.strip() for p in args.passes.split(",") if p.strip()]
+              if args.passes else None)
+    baseline = None if (args.no_baseline or args.write_baseline) \
+        else args.baseline
+    findings = run_analysis(args.paths or None, baseline_path=baseline,
+                            passes=passes)
+
+    if args.write_baseline:
+        n = write_baseline(findings, args.baseline)
+        print(f"arroyolint: wrote {n} finding(s) to {args.baseline}")
+        return 0
+
+    gate = unwaived(findings)
+    shown = findings if args.all else gate
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in shown],
+            "total": len(findings), "gate": len(gate),
+        }, indent=1))
+    else:
+        for f in sorted(shown, key=lambda f: (f.rel_path(), f.line)):
+            print(f.render())
+        n_waived = sum(1 for f in findings if f.waived)
+        n_base = sum(1 for f in findings if f.baselined)
+        print(f"arroyolint: {len(gate)} finding(s) "
+              f"({n_waived} waived, {n_base} baselined)")
+    return 1 if gate else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

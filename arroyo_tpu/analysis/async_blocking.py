@@ -1,0 +1,94 @@
+"""Blocking-call-in-async detector.
+
+The engine is one asyncio loop per worker: a single ``time.sleep`` in a
+connector's async poll loop stalls every subtask on the worker.  Flags,
+inside ``async def`` bodies (nested sync ``def``s excluded — they run
+on executors via ``run_in_executor``):
+
+- ``time.sleep(...)`` (any ``<name>.sleep`` where the name binds the
+  time module, e.g. ``_time.sleep``)
+- ``<fut>.result()`` — blocks the loop when the future is not done
+- ``open(...)`` — sync file I/O
+- sync HTTP/subprocess: ``urllib.request.urlopen``, ``requests.*``,
+  ``subprocess.run/check_call/check_output/call``
+- ``socket.socket(...)`` construction (sync socket I/O follows)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding, call_name
+
+PASS_ID = "async-blocking"
+
+_TIME_MODULE_NAMES = {"time", "_time"}
+_SUBPROCESS_BLOCKING = {"subprocess.run", "subprocess.check_call",
+                        "subprocess.check_output", "subprocess.call"}
+
+
+def _flag_for(call: ast.Call) -> tuple:
+    """(code, message) when this call blocks, else (None, None)."""
+    name = call_name(call)
+    if not name:
+        return None, None
+    parts = name.split(".")
+    if len(parts) == 2 and parts[1] == "sleep" \
+            and parts[0] in _TIME_MODULE_NAMES:
+        return "sleep", (f"{name}() blocks the event loop; use "
+                         "await asyncio.sleep()")
+    if parts[-1] == "result" and not call.args and not call.keywords:
+        return "future-result", (
+            ".result() blocks the event loop unless the future is "
+            "already done; prefer await")
+    if name == "open":
+        return "sync-io", ("sync open() in async function; offload "
+                           "file I/O via run_in_executor")
+    if name in _SUBPROCESS_BLOCKING:
+        return "subprocess", (f"{name}() blocks the event loop; use "
+                              "asyncio.create_subprocess_exec")
+    if name == "urllib.request.urlopen" or name.startswith("requests."):
+        return "sync-http", (f"{name}() is sync HTTP inside async "
+                             "code; offload via run_in_executor")
+    if name == "socket.socket":
+        return "sync-socket", ("sync socket in async function; use "
+                               "asyncio streams")
+    return None, None
+
+
+class _AsyncScan(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[Finding] = []
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._scan_async_body(node)
+        # nested async defs inside this one are re-visited by the scan
+        # itself; no generic_visit (sync nested defs must stay unscanned)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.generic_visit(node)  # reach async defs nested in sync ones
+
+    def _scan_async_body(self, fn: ast.AsyncFunctionDef) -> None:
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, ast.FunctionDef):
+                continue  # sync helper: runs on an executor thread
+            if isinstance(node, ast.AsyncFunctionDef):
+                self._scan_async_body(node)
+                continue
+            if isinstance(node, ast.Call):
+                code, msg = _flag_for(node)
+                if code:
+                    self.findings.append(Finding(
+                        PASS_ID, code, self.path, node.lineno,
+                        f"in async {fn.name}(): {msg}"))
+            stack.extend(ast.iter_child_nodes(node))
+
+
+def check(tree: ast.AST, lines, path: str) -> List[Finding]:
+    scan = _AsyncScan(path)
+    scan.visit(tree)
+    return scan.findings

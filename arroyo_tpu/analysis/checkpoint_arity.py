@@ -1,0 +1,228 @@
+"""Checkpoint-state arity/schema checker — the round-5 Nexmark bug class.
+
+Round 5 shipped ``gen_next`` returning a 4-tuple while the consumer
+unpacked 3 names, crashing the benchmark source on every run.  Both
+halves of that bug are statically visible inside one module:
+
+1. **State-table tuple shapes**: a table obtained from
+   ``ctx.state.get_global_keyed_state("s")`` (or ``get_keyed_state``)
+   whose ``insert(..., (a, b, c, d))`` writes N-tuples must only be
+   unpacked/indexed on the restore path within N: an exact unpack of a
+   different arity, a slice past N, or a constant index >= N is a
+   latent restore crash.
+
+2. **Producer/consumer tuple arity**: a local function whose returns
+   are tuple literals of arity N, consumed by a tuple-unpack of M != N
+   names — directly (``a, b = f()``), through ``await``, or through the
+   ``loop.run_in_executor(None, f)`` indirection the Nexmark prefetch
+   uses (``fut = loop.run_in_executor(None, gen_next)``; later
+   ``a, b, c = await fut``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Finding
+
+PASS_ID = "ckpt-arity"
+
+_STATE_GETTERS = {"get_global_keyed_state", "get_keyed_state"}
+
+
+def _const_index(sl: ast.expr) -> Optional[int]:
+    if isinstance(sl, ast.Constant) and isinstance(sl.value, int):
+        return sl.value
+    return None
+
+
+def _table_of(call: ast.Call) -> Optional[str]:
+    """Table name when ``call`` is ``<...>.get_*_keyed_state("name")``."""
+    if (isinstance(call.func, ast.Attribute)
+            and call.func.attr in _STATE_GETTERS and call.args
+            and isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, str)):
+        return call.args[0].value
+    return None
+
+
+class _ModuleScan(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[Finding] = []
+        # state tables: var name -> table name; table -> insert arities
+        self.table_vars: Dict[str, str] = {}
+        self.insert_arities: Dict[str, Set[int]] = {}
+        # saved-value vars: var name -> table name (from state.get(...))
+        self.saved_vars: Dict[str, str] = {}
+        # producer/consumer: fn name -> set of tuple-return arities
+        # (None in the set marks a non-tuple return -> arity unknown)
+        self.fn_returns: Dict[str, Set[Optional[int]]] = {}
+        # executor futures: var name -> producer fn name
+        self.future_vars: Dict[str, str] = {}
+        # deferred consumer checks resolved after the full scan
+        self.unpack_sites: List[tuple] = []  # (line, fn_name, n_targets)
+        self.read_sites: List[tuple] = []  # (line, table, kind, value)
+
+    # -- producers ---------------------------------------------------------
+
+    def _scan_fn_returns(self, node) -> None:
+        arities: Set[Optional[int]] = set()
+        # manual walk pruning nested def SUBTREES (ast.walk would leak
+        # a nested helper's returns into this function's arity set);
+        # nested defs are collected on their own visit
+        stack = list(ast.iter_child_nodes(node))
+        while stack:
+            sub = stack.pop()
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(sub, ast.Return) and sub.value is not None:
+                if isinstance(sub.value, ast.Tuple):
+                    arities.add(len(sub.value.elts))
+                else:
+                    arities.add(None)
+            stack.extend(ast.iter_child_nodes(sub))
+        if arities:
+            # same-named defs in different scopes merge their arity
+            # sets: call sites can't be attributed to one def, so only
+            # an arity NO definition produces may be flagged
+            self.fn_returns.setdefault(node.name, set()).update(arities)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._scan_fn_returns(node)
+        self.generic_visit(node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._scan_fn_returns(node)
+        self.generic_visit(node)
+
+    # -- assignments -------------------------------------------------------
+
+    def _executor_producer(self, value: ast.expr) -> Optional[str]:
+        """Producer fn name when ``value`` contains
+        ``<...>.run_in_executor(_, fn, ...)`` (IfExp branches included)."""
+        for sub in ast.walk(value):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr == "run_in_executor"
+                    and len(sub.args) >= 2
+                    and isinstance(sub.args[1], ast.Name)):
+                return sub.args[1].id
+        return None
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        value = node.value
+        target = node.targets[0] if len(node.targets) == 1 else None
+
+        if isinstance(target, ast.Name):
+            if isinstance(value, ast.Call):
+                table = _table_of(value)
+                if table is not None:
+                    self.table_vars[target.id] = table
+                elif (isinstance(value.func, ast.Attribute)
+                        and value.func.attr == "get"
+                        and isinstance(value.func.value, ast.Name)
+                        and value.func.value.id in self.table_vars):
+                    self.saved_vars[target.id] = \
+                        self.table_vars[value.func.value.id]
+            producer = self._executor_producer(value)
+            if producer is not None:
+                self.future_vars[target.id] = producer
+
+        # tuple-unpack consumers:  a, b, c = <rhs>
+        if isinstance(target, ast.Tuple):
+            if any(isinstance(t, ast.Starred) for t in target.elts):
+                self.generic_visit(node)
+                return
+            n = len(target.elts)
+            rhs = value
+            if isinstance(rhs, ast.Await):
+                rhs = rhs.value
+            if isinstance(rhs, ast.Call) and isinstance(rhs.func, ast.Name):
+                self.unpack_sites.append(
+                    ("call", node.lineno, rhs.func.id, n))
+            elif isinstance(rhs, ast.Name):
+                if rhs.id in self.future_vars:
+                    self.unpack_sites.append(
+                        ("call", node.lineno, self.future_vars[rhs.id], n))
+                elif rhs.id in self.saved_vars:
+                    self.read_sites.append(
+                        ("unpack", node.lineno,
+                         self.saved_vars[rhs.id], n))
+        self.generic_visit(node)
+
+    # -- state inserts / reads --------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "insert" and node.args:
+            table = None
+            base = node.func.value
+            if isinstance(base, ast.Name):
+                table = self.table_vars.get(base.id)
+            elif isinstance(base, ast.Call):
+                table = _table_of(base)
+            if table is not None and isinstance(node.args[-1], ast.Tuple):
+                self.insert_arities.setdefault(table, set()).add(
+                    len(node.args[-1].elts))
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node: ast.Subscript) -> None:
+        if isinstance(node.value, ast.Name) \
+                and node.value.id in self.saved_vars:
+            table = self.saved_vars[node.value.id]
+            sl = node.slice
+            if isinstance(sl, ast.Slice):
+                upper = _const_index(sl.upper) if sl.upper else None
+                if upper is not None and sl.lower is None:
+                    self.read_sites.append(
+                        ("slice", node.lineno, table, upper))
+            else:
+                idx = _const_index(sl)
+                if idx is not None and idx >= 0:
+                    self.read_sites.append(
+                        ("index", node.lineno, table, idx))
+        self.generic_visit(node)
+
+    # -- resolution --------------------------------------------------------
+
+    def resolve(self) -> List[Finding]:
+        for kind, line, name, n in self.unpack_sites:
+            arities = self.fn_returns.get(name)
+            if not arities or None in arities:
+                continue  # unknown/non-tuple returns: can't prove a bug
+            if n not in arities:
+                want = "/".join(str(a) for a in sorted(arities))
+                self.findings.append(Finding(
+                    PASS_ID, "tuple-unpack-mismatch", self.path, line,
+                    f"unpacking {n} values from {name}() which returns "
+                    f"a {want}-tuple"))
+        for kind, line, table, n in self.read_sites:
+            arities = self.insert_arities.get(table)
+            if not arities:
+                continue
+            mx = max(arities)
+            if kind == "unpack" and n not in arities:
+                want = "/".join(str(a) for a in sorted(arities))
+                self.findings.append(Finding(
+                    PASS_ID, "state-unpack-mismatch", self.path, line,
+                    f"restore path unpacks {n} values from state table "
+                    f"{table!r} whose inserts write {want}-tuples"))
+            elif kind == "slice" and n > mx:
+                self.findings.append(Finding(
+                    PASS_ID, "state-slice-overrun", self.path, line,
+                    f"restore path slices [:{n}] of state table "
+                    f"{table!r} whose inserts write {mx}-tuples"))
+            elif kind == "index" and n >= mx:
+                self.findings.append(Finding(
+                    PASS_ID, "state-index-overrun", self.path, line,
+                    f"restore path indexes [{n}] of state table "
+                    f"{table!r} whose inserts write {mx}-tuples"))
+        return self.findings
+
+
+def check(tree: ast.AST, lines, path: str) -> List[Finding]:
+    scan = _ModuleScan(path)
+    scan.visit(tree)
+    return scan.resolve()

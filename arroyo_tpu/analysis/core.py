@@ -1,0 +1,282 @@
+"""arroyolint core: finding model, waivers, baseline, and the runner.
+
+A *pass* is a module exposing ``PASS_ID`` and either
+
+- ``check(tree, lines, path) -> List[Finding]`` — an AST pass run per
+  file, or
+- ``check_repo(root) -> List[Finding]`` — a repo-level pass run once
+  (e.g. proto drift).
+
+Waivers: a finding is suppressed when its line (or the immediately
+preceding comment-only line) carries::
+
+    # arroyolint: disable=<pass>[,<pass>...] -- reason
+
+The reason is mandatory — a waiver without one is itself reported.
+``disable=all`` suppresses every pass on that line.
+
+Baseline: tools/arroyolint_baseline.json holds fingerprints of accepted
+pre-existing findings (the adoption ratchet — new findings still fail).
+Fingerprints hash (relative path, pass, code, stripped line text,
+occurrence index), so they survive unrelated line drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REPO_ROOT = os.path.dirname(PKG_ROOT)
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "tools",
+                                "arroyolint_baseline.json")
+
+_WAIVER_RE = re.compile(
+    r"#\s*arroyolint:\s*disable=([\w,\-]+)\s*(?:--\s*(\S.*))?")
+
+
+@dataclass
+class Finding:
+    pass_id: str
+    code: str
+    path: str  # absolute or repo-relative; normalized at report time
+    line: int
+    message: str
+    severity: str = "error"
+    waived: bool = False
+    waive_reason: str = ""
+    baselined: bool = False
+    fingerprint: str = ""
+
+    def rel_path(self) -> str:
+        p = self.path
+        if os.path.isabs(p):
+            try:
+                p = os.path.relpath(p, REPO_ROOT)
+            except ValueError:
+                pass
+        return p.replace(os.sep, "/")
+
+    def to_json(self) -> Dict:
+        return {
+            "pass": self.pass_id, "code": self.code,
+            "path": self.rel_path(), "line": self.line,
+            "message": self.message, "severity": self.severity,
+            "waived": self.waived, "baselined": self.baselined,
+            "fingerprint": self.fingerprint,
+        }
+
+    def render(self) -> str:
+        tag = ""
+        if self.waived:
+            tag = " [waived]"
+        elif self.baselined:
+            tag = " [baseline]"
+        return (f"{self.rel_path()}:{self.line}: "
+                f"{self.pass_id}/{self.code}: {self.message}{tag}")
+
+
+@dataclass
+class Waiver:
+    passes: List[str]
+    reason: str
+    line: int
+
+
+def parse_waivers(lines: Sequence[str], path: str
+                  ) -> Tuple[Dict[int, Waiver], List[Finding]]:
+    """Line number -> waiver in effect on that line.  A waiver on a
+    comment-only line also covers the next non-blank line."""
+    waivers: Dict[int, Waiver] = {}
+    problems: List[Finding] = []
+    for i, text in enumerate(lines, start=1):
+        m = _WAIVER_RE.search(text)
+        if not m:
+            continue
+        passes = [p.strip() for p in m.group(1).split(",") if p.strip()]
+        reason = (m.group(2) or "").strip()
+        if not reason:
+            problems.append(Finding(
+                "waiver", "missing-reason", path, i,
+                "waiver without a justification: use "
+                "'# arroyolint: disable=<pass> -- reason'"))
+        w = Waiver(passes, reason, i)
+        waivers[i] = w
+        if text.split("#", 1)[0].strip() == "":
+            # standalone comment line: cover the next non-blank line
+            for j in range(i + 1, min(i + 3, len(lines) + 1)):
+                if lines[j - 1].strip():
+                    waivers.setdefault(j, w)
+                    break
+    return waivers, problems
+
+
+def apply_waivers(findings: List[Finding], waivers: Dict[int, Waiver]
+                  ) -> None:
+    for f in findings:
+        if f.pass_id == "waiver":
+            continue  # the missing-reason enforcement finding is not
+            # itself waivable — 'disable=all' must not self-waive
+        w = waivers.get(f.line)
+        if w and ("all" in w.passes or f.pass_id in w.passes):
+            f.waived = True
+            f.waive_reason = w.reason
+
+
+def assign_fingerprints(findings: List[Finding],
+                        lines_by_path: Dict[str, Sequence[str]]) -> None:
+    seen: Dict[Tuple, int] = {}
+    for f in findings:
+        lines = lines_by_path.get(f.path, ())
+        text = (lines[f.line - 1].strip()
+                if 0 < f.line <= len(lines) else "")
+        key = (f.rel_path(), f.pass_id, f.code, text)
+        n = seen.get(key, 0)
+        seen[key] = n + 1
+        raw = "|".join((f.rel_path(), f.pass_id, f.code, text, str(n)))
+        f.fingerprint = hashlib.sha1(raw.encode()).hexdigest()[:16]
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+def load_baseline(path: str = DEFAULT_BASELINE) -> Dict[str, Dict]:
+    try:
+        with open(path) as fh:
+            data = json.load(fh)
+    except (FileNotFoundError, json.JSONDecodeError):
+        return {}
+    return {e["fingerprint"]: e for e in data.get("findings", [])}
+
+
+def write_baseline(findings: Iterable[Finding],
+                   path: str = DEFAULT_BASELINE,
+                   reason: str = "pre-existing; accepted at baseline "
+                                 "creation") -> int:
+    entries = []
+    for f in findings:
+        if f.waived or f.pass_id == "waiver":
+            # a reasonless waiver must be FIXED (given a reason), never
+            # accepted into the baseline
+            continue
+        entries.append({
+            "fingerprint": f.fingerprint, "pass": f.pass_id,
+            "code": f.code, "path": f.rel_path(), "line": f.line,
+            "message": f.message, "reason": reason,
+        })
+    entries.sort(key=lambda e: (e["path"], e["line"], e["pass"]))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as fh:
+        json.dump({"version": 1, "findings": entries}, fh, indent=1)
+        fh.write("\n")
+    return len(entries)
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Dict[str, Dict]) -> None:
+    for f in findings:
+        if f.pass_id == "waiver":
+            continue  # unbaselineable, like unwaivable above
+        if not f.waived and f.fingerprint in baseline:
+            f.baselined = True
+
+
+# -- runner -----------------------------------------------------------------
+
+
+def _iter_py_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            out.append(p)
+            continue
+        for dirpath, dirnames, files in os.walk(p):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            out.extend(os.path.join(dirpath, fn)
+                       for fn in files if fn.endswith(".py"))
+    return sorted(set(out))
+
+
+def _ast_passes():
+    from . import (
+        async_blocking,
+        checkpoint_arity,
+        host_sync,
+        trace_purity,
+    )
+
+    return [checkpoint_arity, async_blocking, host_sync, trace_purity]
+
+
+def _repo_passes():
+    from . import proto_drift
+
+    return [proto_drift]
+
+
+def run_analysis(paths: Optional[Sequence[str]] = None,
+                 baseline_path: Optional[str] = DEFAULT_BASELINE,
+                 passes: Optional[Sequence[str]] = None,
+                 repo_root: str = REPO_ROOT) -> List[Finding]:
+    """Run every pass; returns ALL findings with ``waived``/``baselined``
+    flags applied — callers gate on the ones with neither."""
+    paths = list(paths) if paths else [PKG_ROOT]
+    findings: List[Finding] = []
+    lines_by_path: Dict[str, Sequence[str]] = {}
+    for path in _iter_py_files(paths):
+        try:
+            with open(path, encoding="utf-8") as fh:
+                src = fh.read()
+            tree = ast.parse(src, filename=path)
+        except (OSError, SyntaxError) as e:
+            findings.append(Finding("core", "unparsable", path,
+                                    getattr(e, "lineno", 0) or 0,
+                                    f"could not parse: {e}"))
+            continue
+        lines = src.splitlines()
+        lines_by_path[path] = lines
+        waivers, problems = parse_waivers(lines, path)
+        file_findings: List[Finding] = list(problems)
+        for mod in _ast_passes():
+            if passes and mod.PASS_ID not in passes:
+                continue
+            file_findings.extend(mod.check(tree, lines, path))
+        apply_waivers(file_findings, waivers)
+        findings.extend(file_findings)
+    for mod in _repo_passes():
+        if passes and mod.PASS_ID not in passes:
+            continue
+        findings.extend(mod.check_repo(repo_root))
+    assign_fingerprints(findings, lines_by_path)
+    if baseline_path:
+        apply_baseline(findings, load_baseline(baseline_path))
+    return findings
+
+
+def unwaived(findings: Iterable[Finding]) -> List[Finding]:
+    return [f for f in findings if not f.waived and not f.baselined]
+
+
+# -- shared AST helpers -----------------------------------------------------
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted name of a call target, '' when not a plain name/attr
+    chain (e.g. ``time.sleep`` -> 'time.sleep')."""
+    parts: List[str] = []
+    cur = node.func
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    if parts:  # method on a non-name expression: report '?.attr'
+        return "?." + ".".join(reversed(parts))
+    return ""

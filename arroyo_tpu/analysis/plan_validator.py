@@ -1,0 +1,222 @@
+"""Plan-time validator: graph-level invariants over logical Programs.
+
+The analog of the checks rustc + the reference's planner enforce before
+a pipeline ever runs — here run at pipeline-create time (api/rest.py)
+and before compilation (engine/build.py via Engine).  Error-severity
+diagnostics reject the plan; warnings surface through the console's
+validation endpoint but do not block.
+
+Checks (codes):
+
+- ``cycle``            — the operator graph must be a DAG
+- ``dangling-node``    — a non-source node with no inputs computes
+                         nothing (the mutated-plan class where an edge
+                         was dropped entirely)
+- ``dead-end``         — warning: a non-sink node whose output reaches
+                         nothing (prune_dead normally removes these)
+- ``keyed-not-shuffled`` — an operator with key-partitioned state fed
+                         by a FORWARD edge sees only a slice of each
+                         key's rows; every in-edge must be a shuffle
+                         unless the operator is pinned to one subtask
+                         (max_parallelism == 1, e.g. the global TopN
+                         merge stage)
+- ``join-sides``       — a join needs exactly one LEFT and one RIGHT
+                         shuffle-join in-edge
+- ``key-schema-mismatch`` — join sides must shuffle on the same key
+                         arity or co-partitioning breaks silently
+- ``window-no-watermark`` — window operators never fire without an
+                         upstream watermark assigner
+- ``window-spec``      — non-positive window width/slide/gap
+- ``slide-width``      — warning: slide not dividing width falls off
+                         the bin-merged fast path
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import TYPE_CHECKING, Dict, List, Optional
+
+if TYPE_CHECKING:  # graph.logical imports networkx only — cheap, but
+    from ..graph.logical import Program  # keep import-time layering clean
+
+
+@dataclass
+class PlanDiagnostic:
+    code: str
+    severity: str  # 'error' | 'warning'
+    message: str
+    node: Optional[str] = None
+
+    def to_json(self) -> Dict:
+        return {"code": self.code, "severity": self.severity,
+                "node": self.node, "message": self.message}
+
+    def render(self) -> str:
+        where = f" [{self.node}]" if self.node else ""
+        return f"{self.severity}: {self.code}{where}: {self.message}"
+
+
+class PlanValidationError(ValueError):
+    def __init__(self, diagnostics: List[PlanDiagnostic]):
+        self.diagnostics = diagnostics
+        super().__init__("; ".join(d.render() for d in diagnostics))
+
+
+def _keyed_state_kinds():
+    from ..graph.logical import OpKind
+
+    return {
+        OpKind.WINDOW, OpKind.SLIDING_WINDOW_AGGREGATOR,
+        OpKind.TUMBLING_WINDOW_AGGREGATOR, OpKind.TUMBLING_TOP_N,
+        OpKind.SLIDING_AGGREGATING_TOP_N, OpKind.WINDOW_JOIN,
+        OpKind.JOIN_WITH_EXPIRATION, OpKind.NON_WINDOW_AGGREGATOR,
+        OpKind.COUNT, OpKind.AGGREGATE, OpKind.WINDOW_ARGMAX,
+    }
+
+
+def _key_arity(key_schema: str) -> int:
+    ks = (key_schema or "").strip()
+    if ks in ("", "()"):
+        return 0
+    return len([c for c in ks.split(",") if c.strip()])
+
+
+def validate_program(program: "Program") -> List[PlanDiagnostic]:
+    import networkx as nx
+
+    from ..graph.logical import (
+        EdgeType,
+        OpKind,
+        SessionWindow,
+        SlidingAggregatingTopNSpec,
+        SlidingAggregatorSpec,
+        SlidingWindow,
+        TumblingAggregatorSpec,
+        TumblingWindow,
+        WindowSpec,
+    )
+
+    diags: List[PlanDiagnostic] = []
+    g = program.graph
+
+    if not nx.is_directed_acyclic_graph(g):
+        diags.append(PlanDiagnostic(
+            "cycle", "error",
+            "operator graph contains a cycle; streaming plans must be "
+            "DAGs"))
+        return diags  # downstream checks assume a DAG
+
+    keyed_kinds = _keyed_state_kinds()
+    join_kinds = {OpKind.WINDOW_JOIN, OpKind.JOIN_WITH_EXPIRATION}
+
+    for op_id in g.nodes:
+        node = program.node(op_id)
+        kind = node.operator.kind
+        in_edges = list(g.in_edges(op_id, data=True))
+
+        if not in_edges and kind != OpKind.CONNECTOR_SOURCE:
+            diags.append(PlanDiagnostic(
+                "dangling-node", "error",
+                f"{node.operator.name} ({kind.value}) has no inputs "
+                "but is not a source — a dropped edge or dead subplan",
+                node=op_id))
+        if g.out_degree(op_id) == 0 and kind != OpKind.CONNECTOR_SINK:
+            diags.append(PlanDiagnostic(
+                "dead-end", "warning",
+                f"{node.operator.name} ({kind.value}) output reaches "
+                "no sink", node=op_id))
+
+        if kind in keyed_kinds and in_edges:
+            if node.max_parallelism != 1:
+                forwards = [s for s, _, d in in_edges
+                            if d["edge"].typ is EdgeType.FORWARD]
+                if forwards:
+                    diags.append(PlanDiagnostic(
+                        "keyed-not-shuffled", "error",
+                        f"{node.operator.name} ({kind.value}) holds "
+                        "key-partitioned state but is fed by FORWARD "
+                        f"edge(s) from {forwards}; each subtask would "
+                        "see only a slice of each key's rows",
+                        node=op_id))
+
+        if kind in join_kinds:
+            left = [d["edge"] for _, _, d in in_edges
+                    if d["edge"].typ is EdgeType.SHUFFLE_JOIN_LEFT]
+            right = [d["edge"] for _, _, d in in_edges
+                     if d["edge"].typ is EdgeType.SHUFFLE_JOIN_RIGHT]
+            if len(left) != 1 or len(right) != 1:
+                diags.append(PlanDiagnostic(
+                    "join-sides", "error",
+                    f"{node.operator.name} needs exactly one left and "
+                    f"one right input (got {len(left)} left, "
+                    f"{len(right)} right)", node=op_id))
+            elif _key_arity(left[0].key_schema) \
+                    != _key_arity(right[0].key_schema):
+                diags.append(PlanDiagnostic(
+                    "key-schema-mismatch", "error",
+                    f"{node.operator.name} joins streams shuffled on "
+                    f"different key arities ({left[0].key_schema!r} vs "
+                    f"{right[0].key_schema!r}); rows for the same join "
+                    "key would land on different subtasks", node=op_id))
+
+        if kind in program.WINDOWED_KINDS:
+            if not any(program.node(anc).operator.kind == OpKind.WATERMARK
+                       for anc in nx.ancestors(g, op_id)):
+                diags.append(PlanDiagnostic(
+                    "window-no-watermark", "error",
+                    f"{node.operator.name} ({kind.value}) requires a "
+                    "watermark-assigning operator upstream; without one "
+                    "its windows never fire", node=op_id))
+
+        spec = node.operator.spec
+        width = slide = None
+        if isinstance(spec, (SlidingAggregatorSpec,
+                             SlidingAggregatingTopNSpec)):
+            width, slide = spec.width_micros, spec.slide_micros
+        elif isinstance(spec, TumblingAggregatorSpec):
+            width = spec.width_micros
+        elif isinstance(spec, WindowSpec):
+            if isinstance(spec.typ, TumblingWindow):
+                width = spec.typ.width_micros
+            elif isinstance(spec.typ, SlidingWindow):
+                width, slide = spec.typ.width_micros, spec.typ.slide_micros
+            elif isinstance(spec.typ, SessionWindow):
+                if spec.typ.gap_micros <= 0:
+                    diags.append(PlanDiagnostic(
+                        "window-spec", "error",
+                        f"{node.operator.name}: session gap must be "
+                        "positive", node=op_id))
+        if width is not None and width <= 0:
+            diags.append(PlanDiagnostic(
+                "window-spec", "error",
+                f"{node.operator.name}: window width must be positive "
+                f"(got {width})", node=op_id))
+        if slide is not None:
+            if slide <= 0:
+                diags.append(PlanDiagnostic(
+                    "window-spec", "error",
+                    f"{node.operator.name}: slide must be positive "
+                    f"(got {slide})", node=op_id))
+            elif width and width % slide != 0:
+                diags.append(PlanDiagnostic(
+                    "slide-width", "warning",
+                    f"{node.operator.name}: slide {slide} does not "
+                    f"divide width {width}; panes fall off the "
+                    "bin-merged fast path", node=op_id))
+
+    return diags
+
+
+def errors_of(diags: List[PlanDiagnostic]) -> List[PlanDiagnostic]:
+    return [d for d in diags if d.severity == "error"]
+
+
+def check_program(program: "Program") -> List[PlanDiagnostic]:
+    """Validate and raise PlanValidationError on any error-severity
+    diagnostic; returns the full diagnostic list (warnings included)
+    otherwise."""
+    diags = validate_program(program)
+    errs = errors_of(diags)
+    if errs:
+        raise PlanValidationError(errs)
+    return diags

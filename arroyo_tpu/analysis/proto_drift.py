@@ -1,0 +1,209 @@
+"""Proto-drift check: rpc.proto vs the hand-surgered rpc_pb2.py.
+
+protoc is not in the image, so schema changes are made by
+FileDescriptorProto surgery on the serialized blob inside
+``rpc/gen/rpc_pb2.py`` while ``rpc/proto/rpc.proto`` remains the
+human-readable schema.  Nothing mechanical kept them in sync — a
+surgery typo (wrong field number, missed message) would ship a wire
+format silently diverging from the documented schema.
+
+This pass parses the .proto text with a minimal proto3 grammar
+(messages, scalar/message/map fields, optional/repeated labels,
+services) and compares it against the descriptors the generated module
+actually registers: message sets, field names/numbers/types/labels,
+map key/value types, and service method signatures must all match.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Dict, List, Tuple
+
+from .core import Finding
+
+PASS_ID = "proto-drift"
+
+PROTO_REL = "arroyo_tpu/rpc/proto/rpc.proto"
+
+# proto3 scalar type name -> FieldDescriptor.TYPE_* enum value
+_SCALAR_TYPES = {
+    "double": 1, "float": 2, "int64": 3, "uint64": 4, "int32": 5,
+    "fixed64": 6, "fixed32": 7, "bool": 8, "string": 9, "bytes": 12,
+    "uint32": 13, "sfixed32": 15, "sfixed64": 16, "sint32": 17,
+    "sint64": 18,
+}
+_TYPE_MESSAGE = 11
+_LABEL_REPEATED = 3
+
+_FIELD_RE = re.compile(
+    r"(?:(optional|repeated)\s+)?"
+    r"(map\s*<\s*(\w+)\s*,\s*(\w+)\s*>|[\w.]+)\s+"
+    r"(\w+)\s*=\s*(\d+)\s*;")
+_RPC_RE = re.compile(
+    r"rpc\s+(\w+)\s*\(\s*(stream\s+)?([\w.]+)\s*\)\s*"
+    r"returns\s*\(\s*(stream\s+)?([\w.]+)\s*\)")
+
+
+def _strip_comments(text: str) -> str:
+    return re.sub(r"//[^\n]*", "", text)
+
+
+def _blocks(text: str, kind: str) -> Dict[str, str]:
+    """Top-level ``kind name { body }`` blocks (no nesting of the same
+    kind in this schema)."""
+    out: Dict[str, str] = {}
+    for m in re.finditer(rf"\b{kind}\s+(\w+)\s*\{{", text):
+        depth, i = 1, m.end()
+        while i < len(text) and depth:
+            if text[i] == "{":
+                depth += 1
+            elif text[i] == "}":
+                depth -= 1
+            i += 1
+        out[m.group(1)] = text[m.end():i - 1]
+    return out
+
+
+def parse_proto(text: str) -> Tuple[Dict, Dict]:
+    """-> (messages, services); messages[name][field] =
+    (number, type_str, label) with type_str like 'string',
+    'TaskAssignment' or 'map<string,string>'."""
+    text = _strip_comments(text)
+    messages: Dict[str, Dict[str, Tuple[int, str, str]]] = {}
+    for name, body in _blocks(text, "message").items():
+        fields: Dict[str, Tuple[int, str, str]] = {}
+        for fm in _FIELD_RE.finditer(body):
+            label = fm.group(1) or ""
+            typ = fm.group(2)
+            if typ.startswith("map"):
+                typ = f"map<{fm.group(3)},{fm.group(4)}>"
+                label = ""
+            fields[fm.group(5)] = (int(fm.group(6)), typ, label)
+        messages[name] = fields
+    services: Dict[str, Dict[str, Tuple[str, str]]] = {}
+    for name, body in _blocks(text, "service").items():
+        services[name] = {m.group(1): (m.group(3), m.group(5))
+                          for m in _RPC_RE.finditer(body)}
+    return messages, services
+
+
+def _is_repeated(fd) -> bool:
+    try:  # newer protobuf: .label is deprecated in favor of .is_repeated
+        return bool(fd.is_repeated)
+    except AttributeError:
+        return fd.label == _LABEL_REPEATED
+
+
+def _describe_field(fd) -> Tuple[str, str]:
+    """Descriptor field -> (type_str, label) in parse_proto's terms."""
+    if fd.type == _TYPE_MESSAGE and fd.message_type.GetOptions().map_entry:
+        kv = {f.name: f for f in fd.message_type.fields}
+        inv = {v: k for k, v in _SCALAR_TYPES.items()}
+        kt = inv.get(kv["key"].type, "?")
+        vt = (kv["value"].message_type.name
+              if kv["value"].type == _TYPE_MESSAGE
+              else inv.get(kv["value"].type, "?"))
+        return f"map<{kt},{vt}>", ""
+    if fd.type == _TYPE_MESSAGE:
+        typ = fd.message_type.name
+    else:
+        inv = {v: k for k, v in _SCALAR_TYPES.items()}
+        typ = inv.get(fd.type, f"type#{fd.type}")
+    if _is_repeated(fd):
+        return typ, "repeated"
+    # proto3 explicit presence on a scalar surfaces as a synthetic oneof
+    if fd.containing_oneof is not None:
+        return typ, "optional"
+    return typ, ""
+
+
+def compare(messages: Dict, services: Dict, descriptor,
+            proto_path: str) -> List[Finding]:
+    """Compare parsed .proto structures against a FileDescriptor."""
+    findings: List[Finding] = []
+
+    def f(code: str, msg: str) -> None:
+        findings.append(Finding(PASS_ID, code, proto_path, 0, msg))
+
+    gen_msgs = dict(descriptor.message_types_by_name)
+    for name, fields in messages.items():
+        md = gen_msgs.pop(name, None)
+        if md is None:
+            f("missing-message",
+              f"message {name} is in rpc.proto but absent from the "
+              "generated descriptors")
+            continue
+        gen_fields = {fd.name: fd for fd in md.fields}
+        for fname, (number, typ, label) in fields.items():
+            fd = gen_fields.pop(fname, None)
+            if fd is None:
+                f("missing-field",
+                  f"{name}.{fname} is in rpc.proto but absent from "
+                  "the generated descriptors")
+                continue
+            if fd.number != number:
+                f("field-number",
+                  f"{name}.{fname}: rpc.proto says field number "
+                  f"{number}, generated descriptor says {fd.number}")
+            gtyp, glabel = _describe_field(fd)
+            if gtyp != typ:
+                f("field-type",
+                  f"{name}.{fname}: rpc.proto says {typ}, generated "
+                  f"descriptor says {gtyp}")
+            if glabel != label:
+                f("field-label",
+                  f"{name}.{fname}: rpc.proto says "
+                  f"{label or 'singular'}, generated descriptor says "
+                  f"{glabel or 'singular'}")
+        for fname in gen_fields:
+            f("extra-field",
+              f"{name}.{fname} is in the generated descriptors but "
+              "not in rpc.proto")
+    for name in gen_msgs:
+        f("extra-message",
+          f"message {name} is in the generated descriptors but not "
+          "in rpc.proto")
+
+    gen_svcs = dict(descriptor.services_by_name)
+    for name, methods in services.items():
+        sd = gen_svcs.pop(name, None)
+        if sd is None:
+            f("missing-service", f"service {name} is in rpc.proto but "
+              "absent from the generated descriptors")
+            continue
+        gen_methods = {m.name: m for m in sd.methods}
+        for mname, (inp, outp) in methods.items():
+            md = gen_methods.pop(mname, None)
+            if md is None:
+                f("missing-rpc", f"{name}.{mname} is in rpc.proto but "
+                  "absent from the generated descriptors")
+                continue
+            if md.input_type.name != inp.split(".")[-1] \
+                    or md.output_type.name != outp.split(".")[-1]:
+                f("rpc-signature",
+                  f"{name}.{mname}: rpc.proto says ({inp}) -> {outp}, "
+                  f"generated descriptor says "
+                  f"({md.input_type.name}) -> {md.output_type.name}")
+        for mname in gen_methods:
+            f("extra-rpc", f"{name}.{mname} is in the generated "
+              "descriptors but not in rpc.proto")
+    for name in gen_svcs:
+        f("extra-service", f"service {name} is in the generated "
+          "descriptors but not in rpc.proto")
+    return findings
+
+
+def check_repo(root: str) -> List[Finding]:
+    proto_path = os.path.join(root, PROTO_REL)
+    if not os.path.exists(proto_path):
+        return []
+    with open(proto_path) as fh:
+        messages, services = parse_proto(fh.read())
+    try:
+        from ..rpc.gen import rpc_pb2
+    except Exception as e:  # the generated module failing to import IS
+        # the drift signal surgery most often produces
+        return [Finding(PASS_ID, "pb2-import", proto_path, 0,
+                        f"rpc_pb2.py failed to import: {e}")]
+    return compare(messages, services, rpc_pb2.DESCRIPTOR, proto_path)

@@ -1,0 +1,105 @@
+"""Trace-purity pass: functions handed to ``jax.jit``/``pallas_call``
+must be pure.
+
+A jitted function runs its Python body ONCE at trace time; wall-clock
+reads, ``random`` draws, and global mutation are silently frozen into
+the compiled program (or worse, torn between trace and execution).
+Detects jit targets by decorator (``@jax.jit``, ``@jit``,
+``@partial(jax.jit, ...)``), by wrapping (``jax.jit(fn)``), and by
+kernel position (``pallas_call(kernel, ...)`` / ``pl.pallas_call``),
+then flags inside their bodies:
+
+- wall clock: ``time.time/monotonic/perf_counter``, ``now_micros()``
+- randomness outside jax: ``random.*``, ``np.random.*``, bound RNG
+  draws are invisible statically and stay out of scope
+- ``global`` / ``nonlocal`` declarations (mutation at trace time)
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set
+
+from .core import Finding, call_name
+
+PASS_ID = "trace-purity"
+
+_WALL_CLOCK = {"time.time", "time.monotonic", "time.perf_counter",
+               "_time.time", "_time.monotonic", "_time.perf_counter",
+               "now_micros", "time.time_ns"}
+_JIT_NAMES = {"jax.jit", "jit"}
+_PALLAS_NAMES = {"pallas_call", "pl.pallas_call", "jax.experimental"
+                 ".pallas.pallas_call"}
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    if isinstance(dec, ast.Call):
+        name = call_name(dec)
+        if name in ("partial", "functools.partial") and dec.args:
+            inner = dec.args[0]
+            return (isinstance(inner, (ast.Name, ast.Attribute))
+                    and _expr_name(inner) in _JIT_NAMES)
+        return name in _JIT_NAMES
+    return _expr_name(dec) in _JIT_NAMES
+
+
+def _expr_name(node: ast.expr) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _collect_jitted(tree: ast.AST) -> Dict[str, ast.AST]:
+    """name -> FunctionDef for every function that is jitted or used as
+    a pallas kernel anywhere in the module."""
+    defs: Dict[str, ast.AST] = {}
+    jitted: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+            if any(_is_jit_decorator(d) for d in node.decorator_list):
+                jitted.add(node.name)
+        elif isinstance(node, ast.Call):
+            name = call_name(node)
+            if name in _JIT_NAMES and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                jitted.add(node.args[0].id)
+            elif name in _PALLAS_NAMES and node.args \
+                    and isinstance(node.args[0], ast.Name):
+                jitted.add(node.args[0].id)
+    return {n: defs[n] for n in jitted if n in defs}
+
+
+def check(tree: ast.AST, lines, path: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn_name, fn in _collect_jitted(tree).items():
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in _WALL_CLOCK:
+                    findings.append(Finding(
+                        PASS_ID, "wall-clock", path, node.lineno,
+                        f"jitted {fn_name}() reads the wall clock "
+                        f"({name}) — frozen at trace time"))
+                elif name.startswith("random.") \
+                        or name.startswith("np.random.") \
+                        or name.startswith("numpy.random."):
+                    findings.append(Finding(
+                        PASS_ID, "impure-random", path, node.lineno,
+                        f"jitted {fn_name}() draws host randomness "
+                        f"({name}) — frozen at trace time; use "
+                        "jax.random with an explicit key"))
+            elif isinstance(node, (ast.Global, ast.Nonlocal)):
+                kind = ("global" if isinstance(node, ast.Global)
+                        else "nonlocal")
+                findings.append(Finding(
+                    PASS_ID, "global-mutation", path, node.lineno,
+                    f"jitted {fn_name}() declares {kind} "
+                    f"{', '.join(node.names)} — mutation happens at "
+                    "trace time, not per call"))
+    return findings

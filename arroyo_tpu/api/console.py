@@ -304,7 +304,13 @@ async function validateSql() {
     headers:{'content-type':'application/json'},
     body: JSON.stringify({query: $('sql').value})});
   const j = await r.json();
-  if (r.ok) $('dag').innerHTML = renderDag(j.graph);
+  if (r.ok) {
+    $('dag').innerHTML = renderDag(j.graph);
+    const diags = j.diagnostics || [];
+    if (diags.length) $('planmsg').textContent = diags.map(d =>
+      d.severity + ': ' + d.code + (d.node ? ' [' + d.node + ']' : '')
+      + ': ' + d.message).join('\n');
+  }
   else $('planmsg').textContent = j.error;
 }
 

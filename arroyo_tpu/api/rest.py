@@ -187,6 +187,22 @@ class ApiServer:
         except (SqlPlanError, SqlCompileError, ValueError, KeyError) as e:
             raise HttpError(400, f"SQL error: {e}")
 
+    @staticmethod
+    def _validate_plan(prog, reject: bool):
+        """Plan-time validation (analysis.plan_validator): returns the
+        structured diagnostics for the console's validation endpoint;
+        with ``reject`` a plan with error-severity diagnostics 400s
+        before a job row or running pipeline ever exists."""
+        from ..analysis.plan_validator import errors_of, validate_program
+
+        diags = validate_program(prog)
+        errs = errors_of(diags)
+        if reject and errs:
+            raise HttpError(
+                400, "plan validation failed: "
+                     + "; ".join(d.render() for d in errs))
+        return [d.to_json() for d in diags]
+
     def _install_connection_tables(self, provider: SchemaProvider) -> None:
         """Saved connection tables become CREATE TABLEs the planner sees."""
         from ..sql.ast_nodes import CreateTable
@@ -262,7 +278,12 @@ class ApiServer:
             if not query:
                 raise HttpError(400, "missing 'query'")
             prog = self._plan(query, int(body.get("parallelism", 1)))
-            return {"graph": _graph_json(prog)}
+            # validation endpoint: structured plan diagnostics (errors
+            # AND warnings) so the console can render them inline
+            # without attempting a create
+            return {"graph": _graph_json(prog),
+                    "diagnostics": self._validate_plan(prog,
+                                                       reject=False)}
 
         @r.post("/v1/pipelines")
         async def create_pipeline(req: Request):
@@ -279,6 +300,7 @@ class ApiServer:
             except (TypeError, ValueError):
                 raise HttpError(400, "ttl_secs must be a number")
             prog = self._plan(query, parallelism)
+            self._validate_plan(prog, reject=True)
             if preview:
                 # the reference's preview mode (pipelines.rs:191-198):
                 # parallelism 1, every connector sink swapped for the

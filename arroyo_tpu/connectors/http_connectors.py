@@ -237,7 +237,8 @@ class WebhookSink(Operator):
                 done, self._inflight = await asyncio.wait(
                     self._inflight, return_when=asyncio.FIRST_COMPLETED)
                 for d in done:
-                    d.result()  # propagate errors -> task failure -> recovery
+                    # arroyolint: disable=async-blocking -- d comes from asyncio.wait's done set; .result() only propagates errors
+                    d.result()
 
             async def post(p=payload):
                 async with self._session.post(self.cfg.endpoint, data=p) as r:

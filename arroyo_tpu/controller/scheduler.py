@@ -121,6 +121,7 @@ class ProcessScheduler(Scheduler):
                 continue
             try:
                 pid = int(ext.split("-", 1)[1])
+                # arroyolint: disable=async-blocking -- tiny procfs read on the rarely-run reap path
                 with open(f"/proc/{pid}/cmdline", "rb") as f:
                     cmdline = f.read()
                 if b"arroyo_tpu.worker.server" in cmdline:

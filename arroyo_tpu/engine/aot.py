@@ -58,6 +58,11 @@ def compile_program(program) -> CompileReport:
     report = CompileReport()
     for msg in program.validate():
         report.errors.append(msg)
+    from ..analysis.plan_validator import validate_program
+
+    report.errors.extend(
+        d.render() for d in validate_program(program)
+        if d.severity == "error")
     if report.errors:
         return report
     for node_id in program.topo_order():

@@ -29,6 +29,24 @@ from .operators_basic import (
 _BUILDERS: Dict[OpKind, Callable[[LogicalOperator], Operator]] = {}
 
 
+def validate_before_build(program) -> None:
+    """Plan-time gate run before any physical operator is constructed:
+    graph-level invariants (keyed state behind shuffles, watermark/
+    window consistency, join key schemas, no dangling nodes) are
+    rejected here with structured diagnostics instead of surfacing as
+    wrong results or a hung pipeline at runtime.  Escape hatch:
+    ``ARROYO_PLAN_VALIDATE=0`` (triage only — a plan that fails here is
+    broken)."""
+    import os
+
+    if os.environ.get("ARROYO_PLAN_VALIDATE", "1") in ("0", "off",
+                                                       "false"):
+        return
+    from ..analysis.plan_validator import check_program
+
+    check_program(program)  # raises PlanValidationError on errors
+
+
 def register_builder(kind: OpKind):
     def deco(fn):
         _BUILDERS[kind] = fn

@@ -66,6 +66,12 @@ class Engine:
         errors = program.validate()
         if errors:
             raise ValueError("; ".join(errors))
+        # full plan-time validation (analysis.plan_validator): keyed
+        # state behind shuffles, join key schemas, dangling nodes —
+        # reject before any operator is built
+        from .build import validate_before_build
+
+        validate_before_build(program)
         self.program = program
         self.job_id = job_id
         self.run_id = run_id

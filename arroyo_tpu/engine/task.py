@@ -218,6 +218,7 @@ class TaskRunner:
                     # the upstream-is-slow half of backpressure analysis)
                     metrics.queue_wait.observe(_time.perf_counter() - wait_t0)
                 if get_control in done:
+                    # arroyolint: disable=async-blocking -- future is in asyncio.wait's done set; .result() cannot block
                     cm = get_control.result()
                     if cm.kind == "commit":
                         await self.operator.handle_commit(cm.epoch, self.ctx)
@@ -228,6 +229,7 @@ class TaskRunner:
                         return
                 if get_merged not in done:
                     continue
+                # arroyolint: disable=async-blocking -- future is in asyncio.wait's done set; .result() cannot block
                 idx, side, msg = get_merged.result()
 
                 if msg.kind == MessageKind.RECORD:

@@ -334,6 +334,26 @@ def operator_flight_stats(before: dict, after: dict) -> dict:
     return ops
 
 
+def preflight_validate(prog, metric: str) -> None:
+    """Plan-validator pre-flight: a benchmark pipeline that fails
+    graph-level validation must exit non-zero with a structured error
+    line, not run to a recorded 0 events/s (the round-5 failure mode
+    was exactly a broken pipeline scoring zero silently)."""
+    from arroyo_tpu.analysis.plan_validator import (
+        errors_of,
+        validate_program,
+    )
+
+    errs = errors_of(validate_program(prog))
+    if errs:
+        print(json.dumps({
+            "metric": metric, "value": 0, "unit": "events/sec",
+            "error": "plan validation failed",
+            "diagnostics": [d.to_json() for d in errs],
+        }))
+        sys.exit(2)
+
+
 def run_query(name: str, sql_template: str) -> dict:
     from arroyo_tpu.connectors.memory import clear_sink, sink_output
     from arroyo_tpu.engine.engine import LocalRunner
@@ -348,6 +368,7 @@ def run_query(name: str, sql_template: str) -> dict:
     # peak sustained throughput is the stable, comparable number
     par = bench_parallelism()
     prog = plan_sql(sql, parallelism=par)
+    preflight_validate(prog, f"nexmark_{name}_events_per_sec")
     clear_sink("results")
     LocalRunner(prog).run()
 
@@ -462,6 +483,7 @@ def run_latency() -> dict:
     sql = LAT_SQL.format(rate=int(rate), n=int(rate * secs),
                          b=lat_batch, base=base)
     prog = plan_sql(sql)
+    preflight_validate(prog, "latency_e2e_ms")
     # warm run of the same program: compiles must not pollute the
     # measured latency distribution (jit cache is keyed by program fns)
     clear_sink("results")
@@ -586,6 +608,7 @@ def run_config5() -> dict:
     # The single-partition topic caps SOURCE parallelism at 1; the keyed
     # session/aggregate stages still fan out.
     prog = plan_sql(sql, p, parallelism=bench_parallelism())
+    preflight_validate(prog, "baseline5_session_udaf_kafka_events_per_sec")
 
     def timed_run():
         clear_sink("results")

@@ -1,0 +1,544 @@
+"""arroyolint contract suite: each pass catches its seeded bug class
+(including a reintroduction of the round-5 Nexmark 3-vs-4 unpack bug),
+waivers and the baseline suppress correctly, the proto-drift check
+matches the real repo, and the plan validator accepts real plans while
+rejecting mutated ones."""
+
+import ast
+import json
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from arroyo_tpu.analysis import core
+from arroyo_tpu.analysis import (
+    async_blocking,
+    checkpoint_arity,
+    host_sync,
+    proto_drift,
+    trace_purity,
+)
+
+
+def _run_pass(mod, src, path="fixture.py", **kw):
+    src = textwrap.dedent(src)
+    return mod.check(ast.parse(src), src.splitlines(), path, **kw)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint arity — the round-5 Nexmark bug class
+# ---------------------------------------------------------------------------
+
+ROUND5_NEXMARK_BUG = """
+    import asyncio
+
+    class Src:
+        async def run(self, ctx):
+            state = ctx.state.get_global_keyed_state("s")
+            saved = state.get(0)
+            loop = asyncio.get_event_loop()
+
+            def gen_next():
+                b, nums = gen.next_batch(64)
+                return b, nums, gen.events_so_far, gen.snapshot_rng_state()
+
+            fut = loop.run_in_executor(None, gen_next) if gen.has_next \\
+                else None
+            while fut is not None:
+                batch, nums, count_after = await fut
+                fut = (loop.run_in_executor(None, gen_next)
+                       if gen.has_next else None)
+                state.insert(0, (batch, nums, count_after, "rng_snap"))
+"""
+
+
+def test_ckpt_arity_catches_round5_nexmark_bug():
+    findings = _run_pass(checkpoint_arity, ROUND5_NEXMARK_BUG)
+    codes = {f.code for f in findings}
+    # the consumer unpacks 3 values from the 4-tuple-returning producer
+    # routed through run_in_executor — exactly the round-5 crash
+    assert "tuple-unpack-mismatch" in codes, findings
+    assert any("gen_next" in f.message for f in findings)
+
+
+def test_ckpt_arity_cli_exits_nonzero_on_seeded_bug(tmp_path):
+    """Acceptance: the analyzer CLI exits non-zero on the seeded
+    round-5 fixture (and test_cli_repo_is_green covers exit 0)."""
+    fixture = tmp_path / "nexmark_round5.py"
+    fixture.write_text(textwrap.dedent(ROUND5_NEXMARK_BUG))
+    r = subprocess.run(
+        [sys.executable, "-m", "arroyo_tpu.analysis", "--no-baseline",
+         str(fixture)], capture_output=True, text=True)
+    assert r.returncode != 0, r.stdout + r.stderr
+    assert "tuple-unpack-mismatch" in r.stdout
+
+
+def test_ckpt_arity_clean_on_fixed_shape():
+    src = ROUND5_NEXMARK_BUG.replace(
+        "batch, nums, count_after = await fut",
+        "batch, nums, count_after, rng_snap = await fut")
+    assert not _run_pass(checkpoint_arity, src)
+
+
+def test_ckpt_arity_state_unpack_mismatch():
+    findings = _run_pass(checkpoint_arity, """
+        async def run(ctx):
+            state = ctx.state.get_global_keyed_state("s")
+            saved = state.get(0)
+            if saved is not None:
+                base_time, split, count = saved
+            state.insert(0, (1, 2, 3, 4))
+    """)
+    assert [f.code for f in findings] == ["state-unpack-mismatch"]
+
+
+def test_ckpt_arity_slice_and_index_overrun():
+    findings = _run_pass(checkpoint_arity, """
+        def f(ctx):
+            state = ctx.state.get_global_keyed_state("s")
+            saved = state.get(0)
+            a = saved[:4]
+            b = saved[3]
+            state.insert(0, (1, 2, 3))
+    """)
+    codes = sorted(f.code for f in findings)
+    assert codes == ["state-index-overrun", "state-slice-overrun"]
+
+
+def test_ckpt_arity_nested_helper_does_not_contaminate_outer():
+    """A nested helper's tuple returns must not leak into the enclosing
+    function's arity set: outer() returns a 2-tuple even though its
+    nested helper returns 4 — unpacking 4 from outer() is the bug."""
+    findings = _run_pass(checkpoint_arity, """
+        async def outer():
+            def helper():
+                return 1, 2, 3, 4
+            return 1, 2
+
+        async def consume():
+            a, b, c, d = await outer()
+            w, x, y = helper()
+    """)
+    msgs = sorted(f.message for f in findings)
+    assert len(findings) == 2, findings
+    assert "unpacking 4 values from outer()" in msgs[1]
+    assert "unpacking 3 values from helper()" in msgs[0]
+
+
+def test_ckpt_arity_guarded_access_ok():
+    """The real nexmark shape: slice within arity, guarded index."""
+    findings = _run_pass(checkpoint_arity, """
+        def f(ctx):
+            state = ctx.state.get_global_keyed_state("s")
+            saved = state.get(0)
+            base, split, count = saved[:3]
+            rng = saved[3] if len(saved) > 3 else None
+            state.insert(0, (base, split, count, rng))
+    """)
+    assert not findings
+
+
+# ---------------------------------------------------------------------------
+# blocking calls in async
+# ---------------------------------------------------------------------------
+
+
+def test_async_blocking_flags_sleep_and_result():
+    findings = _run_pass(async_blocking, """
+        import time
+
+        async def poll():
+            time.sleep(1)
+            fut.result()
+            open("/tmp/x")
+    """)
+    assert sorted(f.code for f in findings) == [
+        "future-result", "sleep", "sync-io"]
+
+
+def test_async_blocking_ignores_sync_and_nested_executor_helpers():
+    findings = _run_pass(async_blocking, """
+        import time
+
+        def sync_retry():
+            time.sleep(1)  # sync helper: runs on an executor
+
+        async def poll():
+            def offloaded():
+                time.sleep(2)  # shipped to run_in_executor
+            await loop.run_in_executor(None, offloaded)
+            await asyncio.sleep(0)
+    """)
+    assert not findings
+
+
+def test_async_blocking_waiver_suppresses():
+    src = textwrap.dedent("""
+        import time
+
+        async def poll():
+            time.sleep(1)  # arroyolint: disable=async-blocking -- test fixture
+    """)
+    findings = _run_pass(async_blocking, src)
+    waivers, problems = core.parse_waivers(src.splitlines(), "fixture.py")
+    core.apply_waivers(findings, waivers)
+    assert not problems
+    assert len(findings) == 1 and findings[0].waived
+
+
+def test_waiver_without_reason_is_itself_a_finding():
+    src = "x = 1  # arroyolint: disable=host-sync\n"
+    _, problems = core.parse_waivers(src.splitlines(), "fixture.py")
+    assert [p.code for p in problems] == ["missing-reason"]
+
+
+def test_reasonless_disable_all_cannot_self_waive(tmp_path):
+    """A reasonless `disable=all` must NOT waive its own missing-reason
+    enforcement finding — the gate stays red, and --write-baseline
+    refuses to accept the enforcement finding."""
+    fixture = tmp_path / "fx.py"
+    fixture.write_text(textwrap.dedent("""
+        import time
+
+        async def poll():
+            time.sleep(1)  # arroyolint: disable=all
+    """))
+    findings = core.run_analysis([str(fixture)], baseline_path=None)
+    gate = core.unwaived(findings)
+    assert [f.code for f in gate] == ["missing-reason"], findings
+    baseline = tmp_path / "b.json"
+    core.write_baseline(findings, str(baseline))
+    again = core.run_analysis([str(fixture)],
+                              baseline_path=str(baseline))
+    assert [f.code for f in core.unwaived(again)] == ["missing-reason"]
+
+
+# ---------------------------------------------------------------------------
+# host-device sync
+# ---------------------------------------------------------------------------
+
+
+def test_host_sync_flags_readbacks_in_scope():
+    findings = _run_pass(host_sync, """
+        import numpy as np
+
+        def process_batch(dev):
+            host = np.asarray(dev)
+            n = dev.sum().item()
+            dev.block_until_ready()
+    """, path="arroyo_tpu/ops/fake.py")
+    assert sorted(f.code for f in findings) == [
+        "asarray", "block-until-ready", "item"]
+
+
+def test_host_sync_checkpoint_paths_exempt_and_scope_enforced():
+    src = """
+        import numpy as np
+
+        def snapshot_state(dev):
+            return np.asarray(dev)  # checkpoint path: intended readback
+    """
+    assert not _run_pass(host_sync, src, path="arroyo_tpu/ops/fake.py")
+    # connectors are out of scope entirely (host-side numpy territory)
+    src2 = "import numpy as np\ndef f(d):\n    return np.asarray(d)\n"
+    assert not host_sync.check(ast.parse(src2), src2.splitlines(),
+                               "arroyo_tpu/connectors/fake.py")
+    assert host_sync.check(ast.parse(src2), src2.splitlines(),
+                           "anywhere.py", force=True)
+
+
+def test_host_sync_jnp_metadata_not_flagged():
+    findings = _run_pass(host_sync, """
+        import jax.numpy as jnp
+
+        NEG = float(jnp.finfo(jnp.float64).min)
+
+        def f(x):
+            return float(jnp.sum(x))
+    """, path="arroyo_tpu/ops/fake.py")
+    assert [f.code for f in findings] == ["scalarize"]
+
+
+# ---------------------------------------------------------------------------
+# trace purity
+# ---------------------------------------------------------------------------
+
+
+def test_trace_purity_flags_impure_jit_targets():
+    findings = _run_pass(trace_purity, """
+        import time
+        import jax
+
+        @jax.jit
+        def kernel(x):
+            t = time.time()
+            return x * t
+
+        def pallas_kernel(ref):
+            return np.random.random() + ref[0]
+
+        out = pallas_call(pallas_kernel, out_shape=None)
+
+        def pure(x):
+            return x + 1
+
+        pure_j = jax.jit(pure)
+    """)
+    assert sorted(f.code for f in findings) == [
+        "impure-random", "wall-clock"]
+    assert all("pure" not in f.message.split("(")[0] for f in findings)
+
+
+def test_trace_purity_flags_global_mutation():
+    findings = _run_pass(trace_purity, """
+        import jax
+
+        COUNT = 0
+
+        @jax.jit
+        def kernel(x):
+            global COUNT
+            COUNT += 1
+            return x
+    """)
+    assert [f.code for f in findings] == ["global-mutation"]
+
+
+# ---------------------------------------------------------------------------
+# proto drift
+# ---------------------------------------------------------------------------
+
+
+def test_proto_drift_repo_in_sync():
+    assert proto_drift.check_repo(core.REPO_ROOT) == []
+
+
+def test_proto_drift_detects_tampering():
+    from arroyo_tpu.rpc.gen import rpc_pb2
+
+    with open(f"{core.REPO_ROOT}/{proto_drift.PROTO_REL}") as fh:
+        messages, services = proto_drift.parse_proto(fh.read())
+    # simulate descriptor-surgery drift: wrong number, wrong type,
+    # missing field, phantom message
+    messages["HeartbeatReq"]["time"] = (9, "uint64", "")
+    messages["RegisterWorkerReq"]["slots"] = (5, "string", "")
+    messages["CommitReq"]["phantom"] = (3, "bool", "")
+    messages["PhantomMsg"] = {"x": (1, "string", "")}
+    findings = proto_drift.compare(messages, services,
+                                   rpc_pb2.DESCRIPTOR, "rpc.proto")
+    codes = {f.code for f in findings}
+    assert codes == {"field-number", "field-type", "missing-field",
+                     "missing-message"}, findings
+
+
+def test_proto_drift_parser_reads_real_schema():
+    with open(f"{core.REPO_ROOT}/{proto_drift.PROTO_REL}") as fh:
+        messages, services = proto_drift.parse_proto(fh.read())
+    assert messages["HeartbeatReq"]["metrics"] == (4, "bytes", "optional")
+    assert messages["StartExecutionReq"]["worker_data_addrs"] == (
+        5, "map<string,string>", "")
+    assert messages["StartExecutionReq"]["tasks"] == (
+        3, "TaskAssignment", "repeated")
+    assert services["ControllerGrpc"]["Heartbeat"] == (
+        "HeartbeatReq", "Empty")
+
+
+# ---------------------------------------------------------------------------
+# baseline + end-to-end runner
+# ---------------------------------------------------------------------------
+
+
+def test_baseline_roundtrip(tmp_path):
+    fixture = tmp_path / "fx.py"
+    fixture.write_text(textwrap.dedent("""
+        import time
+
+        async def poll():
+            time.sleep(1)
+    """))
+    baseline = tmp_path / "baseline.json"
+    first = core.run_analysis([str(fixture)], baseline_path=None)
+    assert core.unwaived(first)
+    core.write_baseline(first, str(baseline), reason="test accepts")
+    again = core.run_analysis([str(fixture)],
+                              baseline_path=str(baseline))
+    assert not core.unwaived(again)
+    assert any(f.baselined for f in again)
+    # a NEW finding is not masked by the baseline
+    fixture.write_text(fixture.read_text()
+                       + "\nasync def poll2():\n    time.sleep(2)\n")
+    third = core.run_analysis([str(fixture)],
+                              baseline_path=str(baseline))
+    fresh = core.unwaived(third)
+    assert len(fresh) == 1 and fresh[0].line > 5
+
+
+def test_fingerprints_stable_across_line_drift(tmp_path):
+    fixture = tmp_path / "fx.py"
+    body = "import time\n\nasync def poll():\n    time.sleep(1)\n"
+    fixture.write_text(body)
+    f1 = core.run_analysis([str(fixture)], baseline_path=None)
+    fixture.write_text("# a new leading comment\n# another\n" + body)
+    f2 = core.run_analysis([str(fixture)], baseline_path=None)
+    fp = lambda fs: {f.fingerprint for f in fs
+                     if f.pass_id == "async-blocking"}
+    assert fp(f1) == fp(f2)
+
+
+def test_cli_repo_is_green():
+    """Acceptance: `python -m arroyo_tpu.analysis` exits 0 on the repo
+    (zero unwaived findings against the checked-in baseline)."""
+    r = subprocess.run([sys.executable, "-m", "arroyo_tpu.analysis"],
+                       capture_output=True, text=True,
+                       cwd=core.REPO_ROOT)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ---------------------------------------------------------------------------
+# plan validator (unit level; fuzz-plan routing lives in test_fuzz_sql)
+# ---------------------------------------------------------------------------
+
+
+def _simple_windowed_program(parallelism=2):
+    from arroyo_tpu.graph.logical import AggKind, AggSpec, Stream
+
+    return (Stream.source("impulse", {"event_rate": 1000.0,
+                                      "message_count": 10},
+                          parallelism=parallelism)
+            .watermark()
+            .key_by("counter")
+            .tumbling_aggregate(1_000_000,
+                                [AggSpec(AggKind.COUNT, None, "c")])
+            .sink("blackhole"))
+
+
+def test_plan_validator_accepts_stream_api_program():
+    from arroyo_tpu.analysis.plan_validator import (
+        errors_of,
+        validate_program,
+    )
+
+    assert not errors_of(validate_program(_simple_windowed_program()))
+
+
+def test_plan_validator_rejects_forward_into_keyed_state():
+    from arroyo_tpu.analysis.plan_validator import (
+        PlanValidationError,
+        check_program,
+    )
+    from arroyo_tpu.graph.logical import EdgeType
+
+    prog = _simple_windowed_program()
+    for _, dst, data in prog.graph.edges(data=True):
+        if data["edge"].typ is EdgeType.SHUFFLE:
+            data["edge"].typ = EdgeType.FORWARD
+    with pytest.raises(PlanValidationError) as ei:
+        check_program(prog)
+    assert any(d.code == "keyed-not-shuffled"
+               for d in ei.value.diagnostics)
+
+
+def test_plan_validator_exempts_pinned_merge_stage():
+    """The global TopN merge stage is FORWARD-fed by design: one pinned
+    subtask sees everything, so no shuffle is required."""
+    from arroyo_tpu.analysis.plan_validator import (
+        errors_of,
+        validate_program,
+    )
+    from arroyo_tpu.graph.logical import EdgeType
+
+    prog = _simple_windowed_program()
+    for _, dst, data in prog.graph.edges(data=True):
+        if data["edge"].typ is EdgeType.SHUFFLE:
+            data["edge"].typ = EdgeType.FORWARD
+            prog.node(dst).max_parallelism = 1
+    assert not errors_of(validate_program(prog))
+
+
+def test_plan_validator_rejects_missing_watermark():
+    from arroyo_tpu.analysis.plan_validator import (
+        errors_of,
+        validate_program,
+    )
+    from arroyo_tpu.graph.logical import AggKind, AggSpec, Stream
+
+    prog = (Stream.source("impulse", {"event_rate": 1000.0,
+                                      "message_count": 10})
+            .key_by("counter")
+            .tumbling_aggregate(1_000_000,
+                                [AggSpec(AggKind.COUNT, None, "c")])
+            .sink("blackhole"))
+    errs = errors_of(validate_program(prog))
+    assert any(d.code == "window-no-watermark" for d in errs)
+
+
+def test_plan_validator_rejects_cycle_and_bad_spec():
+    from arroyo_tpu.analysis.plan_validator import (
+        errors_of,
+        validate_program,
+    )
+    from arroyo_tpu.graph.logical import EdgeType
+
+    prog = _simple_windowed_program()
+    nodes = list(prog.graph.nodes)
+    prog.add_edge(nodes[-1], nodes[0], EdgeType.FORWARD)
+    errs = errors_of(validate_program(prog))
+    assert [d.code for d in errs] == ["cycle"]
+
+
+def test_plan_validator_warns_on_dead_end_and_slide():
+    from arroyo_tpu.analysis.plan_validator import (
+        errors_of,
+        validate_program,
+    )
+    from arroyo_tpu.graph.logical import AggKind, AggSpec, Stream
+
+    s = (Stream.source("impulse", {"event_rate": 1000.0,
+                                   "message_count": 10})
+         .watermark()
+         .key_by("counter")
+         .sliding_aggregate(3_000_000, 2_000_000,
+                            [AggSpec(AggKind.COUNT, None, "c")]))
+    prog = s.program  # no sink: dead end
+    diags = validate_program(prog)
+    assert not errors_of(diags)
+    codes = {d.code for d in diags}
+    assert {"dead-end", "slide-width"} <= codes
+
+
+def test_rest_validate_endpoint_reports_diagnostics(run_async):
+    """The console's validation endpoint carries the structured plan
+    diagnostics for a valid windowed query (no error severity)."""
+    import httpx
+
+    from arroyo_tpu.api.rest import ApiServer
+    from arroyo_tpu.controller.controller import ControllerServer
+
+    async def scenario():
+        controller = ControllerServer()
+        await controller.start()
+        api = ApiServer(controller)
+        port = await api.start()
+        try:
+            async with httpx.AsyncClient(
+                    base_url=f"http://127.0.0.1:{port}",
+                    timeout=30) as c:
+                r = await c.post("/v1/pipelines/validate", json={
+                    "query": "CREATE TABLE imp WITH "
+                             "(connector='impulse', event_rate='100', "
+                             "message_count='10');"
+                             "SELECT count(*) as c, "
+                             "TUMBLE(INTERVAL '1' SECOND) as w "
+                             "FROM imp GROUP BY 2"})
+                assert r.status_code == 200, r.text
+                out = r.json()
+                assert "diagnostics" in out
+                assert not [d for d in out["diagnostics"]
+                            if d["severity"] == "error"], out
+        finally:
+            await api.stop()
+            await controller.stop()
+
+    run_async(scenario())

@@ -182,8 +182,17 @@ def _run_window_fuzz(seed, mode, width_s, slide_s, gap_s, n,
     GROUP BY 1, 2
     """
     clear_sink("results")
-    LocalRunner(Planner(p).plan(
-        sql, query_parallelism=parallelism)).run()
+    prog = Planner(p).plan(sql, query_parallelism=parallelism)
+    # every fuzz-generated plan must pass graph-level validation (the
+    # same gate Engine applies before building operators)
+    from arroyo_tpu.analysis.plan_validator import (
+        errors_of,
+        validate_program,
+    )
+
+    assert not errors_of(validate_program(prog)), (
+        seed, [d.render() for d in validate_program(prog)])
+    LocalRunner(prog).run()
     outs = sink_output("results")
     out = Batch.concat(outs) if outs else None
 
@@ -213,6 +222,85 @@ def _run_window_fuzz(seed, mode, width_s, slide_s, gap_s, n,
             else:
                 assert have == pytest.approx(want, rel=1e-9, abs=1e-9), (
                     seed, key, col, have, want)
+
+
+@pytest.mark.parametrize("mutation", ["drop_shuffle", "key_mismatch",
+                                      "orphan"])
+@pytest.mark.parametrize("seed", [1, 2])
+def test_fuzz_plan_validator_rejects_mutations(seed, mutation):
+    """Fuzz-generated plans pass the plan validator untouched (asserted
+    inside _run_window_fuzz); the SAME plans with a seeded mutation —
+    a dropped shuffle edge, a mismatched join key schema, an orphaned
+    node — must be rejected with the matching diagnostic code."""
+    from arroyo_tpu.analysis.plan_validator import (
+        PlanValidationError,
+        check_program,
+        errors_of,
+        validate_program,
+    )
+    from arroyo_tpu.graph.logical import (
+        ColumnExpr,
+        EdgeType,
+        LogicalOperator,
+        OpKind,
+    )
+    from arroyo_tpu.sql.planner import Planner
+
+    rng = np.random.default_rng(seed)
+    ts, k, v = _make_table(rng, 2000, 9, 6, 0.1)
+    p = SchemaProvider()
+    p.add_memory_table("t", {"k": "i", "v": "f"},
+                       [Batch(ts, {"k": k, "v": v})])
+    p.add_memory_table("u", {"k": "i", "w": "f"},
+                       [Batch(ts, {"k": k, "w": v})])
+    if mutation == "key_mismatch":
+        sql = """
+        SELECT a.k as k, a.c as c, b.d as d
+        FROM (SELECT k, TUMBLE(INTERVAL '1' SECOND) as window,
+                     count(*) as c FROM t GROUP BY 1, 2) a
+        JOIN (SELECT k, TUMBLE(INTERVAL '1' SECOND) as window,
+                     count(*) as d FROM u GROUP BY 1, 2) b
+        ON a.k = b.k AND a.window = b.window
+        """
+    else:
+        sql = """
+        SELECT k, TUMBLE(INTERVAL '1' SECOND) as window, count(*) as c
+        FROM t GROUP BY 1, 2
+        """
+    prog = Planner(p).plan(sql, query_parallelism=2)
+    assert not errors_of(validate_program(prog))  # valid as planned
+
+    if mutation == "drop_shuffle":
+        for src, dst, data in prog.graph.edges(data=True):
+            node = prog.node(dst)
+            if (data["edge"].typ is EdgeType.SHUFFLE
+                    and node.max_parallelism != 1
+                    and node.operator.kind
+                    in (OpKind.TUMBLING_WINDOW_AGGREGATOR,
+                        OpKind.WINDOW)):
+                data["edge"].typ = EdgeType.FORWARD
+                break
+        else:
+            raise AssertionError("no shuffle edge found to mutate")
+        want = "keyed-not-shuffled"
+    elif mutation == "key_mismatch":
+        for src, dst, data in prog.graph.edges(data=True):
+            if data["edge"].typ is EdgeType.SHUFFLE_JOIN_RIGHT:
+                data["edge"].key_schema = "k,extra_col"
+                break
+        else:
+            raise AssertionError("no join edge found to mutate")
+        want = "key-schema-mismatch"
+    else:  # orphan: a node whose inputs were dropped entirely
+        prog.add_node(LogicalOperator(
+            OpKind.EXPRESSION, "orphan",
+            expr=ColumnExpr("orphan", lambda c: c)))
+        want = "dangling-node"
+
+    errs = errors_of(validate_program(prog))
+    assert any(d.code == want for d in errs), (mutation, errs)
+    with pytest.raises(PlanValidationError):
+        check_program(prog)
 
 
 @pytest.mark.parametrize("seed", [51, 52, 53, 54])

@@ -1,0 +1,16 @@
+#!/usr/bin/env bash
+# lint.sh — arroyolint gate: zero unwaived static-analysis findings.
+#
+# Runs every arroyolint pass (checkpoint-state arity, blocking-calls-
+# in-async, implicit host-device syncs, trace purity, proto drift) over
+# the package and fails on any finding that is neither inline-waived
+# (# arroyolint: disable=<pass> -- reason) nor accepted in
+# tools/arroyolint_baseline.json.  Wired into tools/smoke.sh so the
+# <60s pre-snapshot gate rejects the round-5 bug class before a commit
+# lands.
+#
+# Usage: tools/lint.sh [extra arroyolint args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+exec python -m arroyo_tpu.analysis "$@"

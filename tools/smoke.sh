@@ -5,17 +5,23 @@
 # recorded 0 events/s and nothing pointed at the failing operator.  This
 # gate catches that class of regression before a snapshot lands:
 #
-#   1. a tiny Nexmark pipeline end-to-end through the SQL planner and
-#      LocalRunner — non-zero exit on any source crash or empty sink;
-#   2. the metrics scrape must be non-empty and contain the
+#   1. arroyolint (tools/lint.sh): zero unwaived static-analysis
+#      findings — the checkpoint-arity pass catches exactly the round-5
+#      producer/consumer mismatch before anything runs;
+#   2. a tiny Nexmark pipeline end-to-end through the SQL planner and
+#      LocalRunner — non-zero exit on any source crash or empty sink
+#      (the plan-time validator also gates this via Engine);
+#   3. the metrics scrape must be non-empty and contain the
 #      flight-recorder histogram families (an empty scrape means the
 #      obs wiring regressed even if the pipeline "ran");
-#   3. tests/test_obs.py — the observability contract suite.
+#   4. tests/test_obs.py — the observability contract suite.
 #
 # Usage: tools/smoke.sh   (from anywhere; runs on CPU for determinism)
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+
+bash tools/lint.sh
 
 python - <<'PY'
 import sys

@@ -137,6 +137,13 @@ FROM nexmark WHERE bid is not null GROUP BY 1, 2</textarea>
         <pre id="ckptdetail" style="display:none;margin-top:8px"></pre></div>
       <div><h2>Errors</h2><pre id="errors">—</pre></div>
     </div>
+    <div style="margin-top:10px"><h2>Autoscaler
+      <span id="as_state" style="color:var(--dim)"></span>
+      <span style="float:right;text-transform:none;letter-spacing:0">
+        <button class="secondary" style="margin:0;padding:4px 10px"
+                onclick="toggleAutoscaler()" id="as_toggle">enable</button>
+      </span></h2>
+      <pre id="autoscaler">decision ledger: watch a job…</pre></div>
   </section>
 </main>
 <script>
@@ -500,6 +507,51 @@ async function pollJob() {
       `${e.created_at ?? ''} ${e.message ?? JSON.stringify(e)}`)
       .join('\\n') || '—';
   }
+  pollAutoscaler(jid);
+}
+
+// ---- autoscaler decision ledger -------------------------------------------
+
+let autoscalerEnabled = false;
+async function pollAutoscaler(jid) {
+  const r = await fetch(`/v1/jobs/${jid}/autoscaler`).catch(() => null);
+  if (!r || !r.ok) return;
+  const a = await r.json();
+  autoscalerEnabled = !!a.enabled;
+  $('as_state').textContent = !a.global_enabled
+    ? '(globally disabled: ARROYO_AUTOSCALE=0)'
+    : `(${a.enabled ? 'enabled' : 'disabled'} · ` +
+      `${a.evaluations} evals · ${a.actuations} actuations · ` +
+      `${a.vetoes} vetoes)`;
+  $('as_toggle').textContent = a.enabled ? 'disable' : 'enable';
+  // decision t is the policy's monotonic clock: show each entry as an
+  // offset behind the newest one (0.0s = most recent evaluation)
+  const ds = (a.decisions || []).slice(-10);
+  const tmax = ds.length ? Number(ds[ds.length - 1].t) : 0;
+  const rows = ds.reverse().map(d => {
+    const what = d.action === 'scale_up' || d.action === 'scale_down'
+      ? `${d.action} ${d.operator_id} ` +
+        `${d.from_parallelism}→${d.to_parallelism}` +
+        `${d.actuated ? ' ✓' : d.error ? ' ✗ ' + d.error : ''}`
+      : d.action === 'veto'
+        ? `veto [${d.reason}]` + (d.operator_id ? ` ${d.operator_id}` : '')
+        : `hold (${d.reason})`;
+    return `-${(tmax - Number(d.t)).toFixed(1)}s  ${what}`;
+  });
+  $('autoscaler').textContent = rows.join('\\n') || '(no evaluations yet)';
+}
+
+async function toggleAutoscaler() {
+  if (!watching) { $('as_state').textContent = '(watch a job first)'; return; }
+  const r = await fetch(`/v1/jobs/${watching.jid}/autoscaler`, {
+    method: 'PUT', headers: {'content-type': 'application/json'},
+    body: JSON.stringify({enabled: !autoscalerEnabled})});
+  if (!r.ok) {
+    const j = await r.json().catch(() => ({}));
+    $('as_state').textContent = '(' + (j.error || r.status) + ')';
+    return;
+  }
+  pollAutoscaler(watching.jid);
 }
 
 function fmtBytes(b) {

@@ -93,6 +93,9 @@ class Router:
     def patch(self, p: str):
         return lambda h: (self.route("PATCH", p, h), h)[1]
 
+    def put(self, p: str):
+        return lambda h: (self.route("PUT", p, h), h)[1]
+
     def delete(self, p: str):
         return lambda h: (self.route("DELETE", p, h), h)[1]
 

@@ -247,7 +247,7 @@ class ApiServer:
                         "name": p, "in": "path", "required": True,
                         "schema": {"type": "string"},
                     } for p in params]
-                if method in ("POST", "PATCH"):
+                if method in ("POST", "PATCH", "PUT"):
                     op["requestBody"] = {"content": {
                         "application/json": {"schema": {"type": "object"}}}}
                 entry[method.lower()] = op
@@ -452,6 +452,67 @@ class ApiServer:
             rows = self.db.execute(
                 "SELECT * FROM jobs ORDER BY created_at").fetchall()
             return {"data": [self._job_json(j) for j in rows]}
+
+        @r.get("/v1/jobs/{jid}/autoscaler")
+        async def autoscaler_status(req: Request):
+            """Autoscaler state for one job: policy knobs, counters, and
+            the decision ledger (every evaluation's inputs digest plus
+            the action taken or the veto that blocked it)."""
+            jid = req.params["jid"]
+            if jid not in self.controller.jobs:
+                raise HttpError(404, "no such job")
+            scaler = self.controller.autoscalers.get(jid)
+            if scaler is None:
+                # subsystem globally disabled (ARROYO_AUTOSCALE=0), or a
+                # job admitted before the feature: report, don't 404 —
+                # a throwaway (unregistered, never-started) autoscaler
+                # keeps the payload shape identical to the live one
+                from ..autoscale.supervisor import JobAutoscaler
+
+                scaler = JobAutoscaler(self.controller, jid)
+            return scaler.status()
+
+        @r.put("/v1/jobs/{jid}/autoscaler")
+        async def autoscaler_update(req: Request):
+            """Enable/disable the job's autoscaler and/or merge policy
+            knob updates ({"enabled": bool, "policy": {knob: value}})."""
+            from ..config import config as _config
+
+            from ..autoscale.supervisor import JobAutoscaler
+
+            jid = req.params["jid"]
+            if jid not in self.controller.jobs:
+                raise HttpError(404, "no such job")
+            body = req.json()
+            scaler = self.controller.autoscalers.get(jid)
+            if scaler is None and not _config().autoscale_enabled:
+                raise HttpError(409, "autoscaling is globally disabled "
+                                     "(ARROYO_AUTOSCALE=0)")
+            # validate the WHOLE body before any side effect: a 422 must
+            # not leave a freshly attached (possibly default-enabled and
+            # persisted) loop behind
+            new_cfg = None
+            if "policy" in body:
+                if not isinstance(body["policy"], dict):
+                    raise HttpError(422, "'policy' must be an object")
+                base = (scaler if scaler is not None
+                        else JobAutoscaler(self.controller, jid))
+                try:
+                    new_cfg = base.policy.cfg.merged(body["policy"])
+                except (KeyError, TypeError, ValueError) as e:
+                    raise HttpError(422, f"invalid policy: {e}")
+            if scaler is None:
+                # single registration path: the controller's attach owns
+                # the prev-loop-stop guard and default-on semantics
+                self.controller._attach_autoscaler(jid)
+                scaler = self.controller.autoscalers[jid]
+            if new_cfg is not None:
+                scaler.policy.cfg = new_cfg
+            if "enabled" in body:
+                scaler.set_enabled(bool(body["enabled"]))
+            # durable controllers resume the autoscaler with the job
+            self.controller.persist_autoscaler(jid)
+            return scaler.status()
 
         @r.get("/v1/pipelines/{pid}/jobs/{jid}/errors")
         async def job_errors(req: Request):

@@ -1,0 +1,28 @@
+"""Closed-loop autoscaler: rollup-driven elastic rescaling.
+
+The control loop (supervisor.JobAutoscaler) periodically reads a job's
+per-operator flight-recorder rollups from the controller, runs them
+through a pluggable policy (policy.BacklogDrainPolicy — backlog-drain
+parallelism model with hysteresis, per-direction cooldowns, per-operator
+bounds and a global slot budget), records every evaluation in a bounded
+decision ledger (ledger.DecisionLedger, served at
+``GET /v1/jobs/{id}/autoscaler``), and actuates via the controller's
+existing checkpoint-stop / key-range-reshard / restart rescale path.
+
+``ARROYO_AUTOSCALE=0`` disables the subsystem globally; sim.py is the
+deterministic simulator the tests and the smoke gate drive.
+"""
+
+from .ledger import DecisionLedger
+from .policy import BacklogDrainPolicy, Decision, EvalInput, PolicyConfig
+from .supervisor import JobAutoscaler, upstream_map
+
+__all__ = [
+    "BacklogDrainPolicy",
+    "Decision",
+    "DecisionLedger",
+    "EvalInput",
+    "JobAutoscaler",
+    "PolicyConfig",
+    "upstream_map",
+]

@@ -1,0 +1,55 @@
+"""Decision ledger: a bounded ring of every autoscaler evaluation.
+
+The ledger is the autoscaler's flight recorder — inputs digest,
+recommendation, and the action taken or the veto that blocked it, for
+every evaluation — served at ``GET /v1/jobs/{id}/autoscaler`` and
+rendered by the console.  Bounded so a long-running job cannot grow it
+without limit (same rationale as the trace-span ring in obs/tracing.py).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from .policy import Decision
+
+DEFAULT_CAP = 512
+
+
+class DecisionLedger:
+    def __init__(self, cap: int = DEFAULT_CAP):
+        self._ring: deque = deque(maxlen=cap)
+        # actuations are rare and the interesting part of the record:
+        # keep them separately so a busy loop's holds can never push
+        # them out of the REST payload
+        self._actuated: deque = deque(maxlen=64)
+        self.evaluations = 0
+        self.actuations = 0
+        self.vetoes = 0
+
+    def append(self, decision: Decision) -> None:
+        self._ring.append(decision)
+        self.evaluations += 1
+        if decision.action == "veto":
+            self.vetoes += 1
+
+    def record_actuated(self, decision: Decision) -> None:
+        decision.actuated = True
+        self.actuations += 1
+        self._actuated.append(decision)
+
+    def actuated_json(self) -> List[Dict[str, Any]]:
+        return [d.to_json() for d in self._actuated]
+
+    def last(self) -> Optional[Decision]:
+        return self._ring[-1] if self._ring else None
+
+    def decisions(self) -> List[Decision]:
+        return list(self._ring)
+
+    def to_json(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        items = list(self._ring)
+        if limit is not None:
+            items = items[-limit:]
+        return [d.to_json() for d in items]

@@ -1,0 +1,396 @@
+"""Backlog-drain autoscaling policy.
+
+The policy is a pure decision function over the controller's per-operator
+job rollups (``controller.job_rollup``): every evaluation receives the
+current rollup list, the DAG's parallelism map and upstream topology, and
+an externally supplied clock — it never reads wall time itself, which is
+what makes the deterministic simulator (``autoscale/sim.py``) possible.
+
+Model (PanJoin-style adaptive provisioning, arxiv 1811.05065):
+
+* An operator's *pressure* is the worse of (a) the backpressure its
+  upstream operators report on the queues feeding it (their tx queues
+  full == this operator can't keep up) and (b) its watermark-lag score —
+  lag mapped linearly from ``lag_warn_secs`` (0) to ``lag_high_secs``
+  (1), counted toward scale-up only while the lag trend is not falling.
+* Scale-up: the single worst operator whose pressure has stayed at or
+  above ``high_water`` for ``up_sustain`` consecutive evaluations — the
+  bottleneck, never the whole DAG.  Required parallelism comes from the
+  backlog-drain estimate ``p * (1 + bp) * (1 + lag/target_drain_secs)``
+  (offered/processed ratio approximated by the backpressure ratio, plus
+  catch-up headroom to drain the observed lag within the target), capped
+  at ``max_step_factor`` growth per action and the per-operator/global
+  bounds.
+* Scale-down: only when every operator is calm (pressure at or below
+  ``low_water`` for ``down_sustain`` evaluations, none above
+  ``high_water``), the backlog has drained (lag <= ``drain_lag_secs``),
+  and the down cooldown has expired; one subtask step at a time, most
+  over-provisioned operator first.
+* Hysteresis is the [low_water, high_water] band where nothing happens;
+  per-direction cooldowns after any actuation stop flapping on load
+  square waves.
+* Any recommendation is vetoed (and recorded) when the rollup is stale —
+  older than one evaluation interval — or when the global worker-slot
+  budget would be exceeded.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+# decision.action values
+SCALE_UP = "scale_up"
+SCALE_DOWN = "scale_down"
+HOLD = "hold"
+VETO = "veto"
+
+# veto reasons (ledger + prometheus label values)
+VETO_STALE = "stale_rollup"
+VETO_COOLDOWN = "cooldown"
+VETO_BUDGET = "slot_budget"
+VETO_ACTUATION_FAILED = "actuation_failed"
+
+
+@dataclass
+class PolicyConfig:
+    """Knobs for BacklogDrainPolicy (all JSON-serializable; the REST
+    ``PUT .../autoscaler`` endpoint merges partial updates into this)."""
+
+    interval_secs: float = 15.0      # evaluation cadence AND staleness bar
+    high_water: float = 0.7          # pressure >= this -> bottleneck
+    low_water: float = 0.2           # pressure <= this -> calm
+    up_sustain: int = 2              # consecutive hot evals before up
+    down_sustain: int = 4            # consecutive calm evals before down
+    up_cooldown_secs: float = 60.0   # min gap after any action before up
+    down_cooldown_secs: float = 300.0
+    lag_warn_secs: float = 10.0      # watermark lag mapping to pressure 0
+    lag_high_secs: float = 60.0      # ... and to pressure 1
+    # starvation discriminator: an upstream's backpressure is one scalar
+    # across all its out-edges, so under fan-out it would indict every
+    # consumer — but a consumer whose avg queue wait exceeds this is
+    # starving for input (the bottleneck is a sibling), not slow itself
+    starve_wait_secs: float = 0.5
+    drain_lag_secs: float = 5.0      # down only when lag drained below
+    target_drain_secs: float = 60.0  # catch-up horizon in the drain model
+    max_step_factor: float = 2.0     # at most double per scale-up
+    min_parallelism: int = 1
+    max_parallelism: int = 16        # default per-operator ceiling
+    slot_budget: Optional[int] = None  # global sum-of-parallelism cap
+    # per-operator {"min": int, "max": int} overrides; an operator whose
+    # max equals its current parallelism is pinned
+    per_op: Dict[str, Dict[str, int]] = field(default_factory=dict)
+
+    def bounds(self, op_id: str) -> Tuple[int, int]:
+        o = self.per_op.get(op_id, {})
+        return (int(o.get("min", self.min_parallelism)),
+                int(o.get("max", self.max_parallelism)))
+
+    def to_json(self) -> Dict[str, Any]:
+        return asdict(self)
+
+    def merged(self, updates: Dict[str, Any]) -> "PolicyConfig":
+        """New config with ``updates`` applied; unknown keys raise and
+        values are coerced to the knob's type — a mistyped REST update
+        must fail the PUT, not poison every later evaluation."""
+        cur = self.to_json()
+        for k, v in updates.items():
+            if k not in cur:
+                raise KeyError(f"unknown policy knob {k!r}")
+            if k in ("up_sustain", "down_sustain", "min_parallelism",
+                     "max_parallelism"):
+                v = int(v)
+            elif k == "slot_budget":
+                v = None if v is None else int(v)
+            elif k == "per_op":
+                if not isinstance(v, dict) or not all(
+                        isinstance(b, dict)
+                        and set(b) <= {"min", "max"} and b
+                        for b in v.values()):
+                    raise ValueError(
+                        "per_op must be {op_id: {'min':int,'max':int}}")
+                v = {op: {kk: int(vv) for kk, vv in b.items()}
+                     for op, b in v.items()}
+            else:
+                v = float(v)
+                if not math.isfinite(v):
+                    raise ValueError(f"{k} must be finite")
+            cur[k] = v
+        out = PolicyConfig(**cur)
+        out._check_ranges()
+        return out
+
+    def _check_ranges(self) -> None:
+        """Reject configs that would break the loop itself — a zero
+        interval busy-spins the controller, an inverted hysteresis band
+        or step factor quietly disables one direction forever."""
+        if self.interval_secs <= 0:
+            raise ValueError("interval_secs must be > 0")
+        if not 0 <= self.low_water <= self.high_water <= 1.0:
+            # pressure is clamped to [0,1]: a band above 1 would quietly
+            # disable scale-up AND the never-shrink-under-load guard
+            raise ValueError("need 0 <= low_water <= high_water <= 1")
+        if self.up_sustain < 1 or self.down_sustain < 1:
+            raise ValueError("sustain counts must be >= 1")
+        if self.up_cooldown_secs < 0 or self.down_cooldown_secs < 0:
+            raise ValueError("cooldowns must be >= 0")
+        if not 0 <= self.lag_warn_secs < self.lag_high_secs:
+            raise ValueError("need 0 <= lag_warn_secs < lag_high_secs")
+        if self.drain_lag_secs < 0 or self.starve_wait_secs < 0:
+            raise ValueError("drain_lag/starve_wait must be >= 0")
+        if self.target_drain_secs <= 0:
+            raise ValueError("target_drain_secs must be > 0")
+        if self.max_step_factor <= 1:
+            raise ValueError("max_step_factor must be > 1")
+        if self.min_parallelism < 1 \
+                or self.max_parallelism < self.min_parallelism:
+            raise ValueError("need 1 <= min_parallelism <= max_parallelism")
+        if self.slot_budget is not None and self.slot_budget < 1:
+            raise ValueError("slot_budget must be >= 1")
+        for op, b in self.per_op.items():
+            lo, hi = self.bounds(op)
+            if not 1 <= lo <= hi:
+                raise ValueError(f"per_op[{op!r}]: need 1 <= min <= max")
+
+
+@dataclass
+class EvalInput:
+    """One evaluation's inputs — everything the policy may look at."""
+
+    now: float                          # injected clock (monotonic-like)
+    rollups: List[Dict[str, Any]]       # controller.job_rollup() shape
+    parallelism: Dict[str, int]         # operator_id -> current subtasks
+    upstream: Dict[str, List[str]]      # operator_id -> producers
+    # plan-level StreamNode.max_parallelism pins (only pinned ops
+    # present): rescale_job would silently clamp past these, so a
+    # recommendation beyond them is a disruptive full-job no-op
+    hard_max: Dict[str, int] = field(default_factory=dict)
+
+
+@dataclass
+class Decision:
+    """One ledger entry: the inputs digest, the recommendation, and the
+    action taken or the veto that blocked it."""
+
+    t: float
+    action: str                          # scale_up|scale_down|hold|veto
+    reason: str = ""                     # trigger or veto reason
+    operator_id: Optional[str] = None
+    from_parallelism: Optional[int] = None
+    to_parallelism: Optional[int] = None
+    overrides: Optional[Dict[str, int]] = None  # set when actionable
+    inputs: Dict[str, Dict[str, float]] = field(default_factory=dict)
+    rollup_age_secs: Optional[float] = None
+    actuated: bool = False
+    error: Optional[str] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        return {k: v for k, v in asdict(self).items() if v not in
+                (None, {}, "")} | {"t": round(self.t, 3),
+                                   "action": self.action}
+
+
+class BacklogDrainPolicy:
+    """Stateful wrapper around the pure pressure/step math: keeps the
+    per-operator sustain counters, the previous lag sample (for the
+    trend check) and the last-actuation timestamps between evaluations."""
+
+    def __init__(self, cfg: Optional[PolicyConfig] = None):
+        self.cfg = cfg or PolicyConfig()
+        self._hot_streak: Dict[str, int] = {}
+        self._calm_streak: Dict[str, int] = {}
+        self._prev_lag: Dict[str, float] = {}
+        self._last_action_t: Optional[float] = None
+        self._last_action: Optional[str] = None
+
+    # -- signal extraction -------------------------------------------------
+
+    @staticmethod
+    def _lag_of(roll: Dict[str, Any]) -> float:
+        lag = roll.get("watermark_lag")
+        if lag is None:
+            lag = roll.get("event_time_lag", 0.0)
+        return float(lag or 0.0)
+
+    def _lag_score(self, lag: float) -> float:
+        cfg = self.cfg
+        span = max(cfg.lag_high_secs - cfg.lag_warn_secs, 1e-9)
+        return min(max((lag - cfg.lag_warn_secs) / span, 0.0), 1.0)
+
+    def signals(self, inp: EvalInput) -> Dict[str, Dict[str, float]]:
+        """Per-operator {pressure, bp_in, lag, rate, parallelism} — the
+        inputs digest the ledger records for every evaluation."""
+        by_op = {r.get("operator_id"): r for r in inp.rollups}
+        out: Dict[str, Dict[str, float]] = {}
+        for op, p in inp.parallelism.items():
+            known = op in by_op
+            roll = by_op.get(op, {})
+            bp_in = max((float(by_op.get(u, {}).get("backpressure") or 0.0)
+                         for u in inp.upstream.get(op, [])), default=0.0)
+            # upstream backpressure is one scalar across all the
+            # upstream's out-edges, and watermark lag propagates to
+            # every branch behind a stalled shared upstream: a consumer
+            # that spends its time WAITING for input is starving behind
+            # a slow sibling, not the bottleneck — NEITHER shared
+            # signal may indict it for scale-up (its calm_pressure
+            # keeps the lag, conservatively blocking scale-down too)
+            qw = float(roll.get("queue_wait") or 0.0)
+            starving = qw > self.cfg.starve_wait_secs
+            if starving:
+                bp_in = 0.0
+            lag = self._lag_of(roll)
+            score = self._lag_score(lag)
+            rising = lag >= self._prev_lag.get(op, 0.0) - 0.5
+            out[op] = {
+                "pressure": (0.0 if starving
+                             else max(bp_in, score if rising else 0.0)),
+                # full (trend-free) pressure gates scale-down: a falling
+                # but still-large lag must keep the operator hot
+                "calm_pressure": max(bp_in, score),
+                # absent from the rollup != calm: a heartbeat-dead
+                # worker's hot operator simply vanishes from job_rollup
+                # while livelier siblings keep the rollup fresh —
+                # unknown ops must never qualify for scale-down
+                "known": 1.0 if known else 0.0,
+                "bp_in": bp_in,
+                "lag": lag,
+                "queue_wait": qw,
+                "rate": float(roll.get("records_per_sec") or 0.0),
+                "parallelism": p,
+            }
+        return out
+
+    # -- evaluation --------------------------------------------------------
+
+    def evaluate(self, inp: EvalInput) -> Decision:
+        cfg = self.cfg
+        if not inp.rollups:
+            return Decision(t=inp.now, action=HOLD, reason="no_rollup")
+        sig = self.signals(inp)
+        for op, s in sig.items():
+            self._prev_lag[op] = s["lag"]
+            if s["pressure"] >= cfg.high_water:
+                self._hot_streak[op] = self._hot_streak.get(op, 0) + 1
+            else:
+                self._hot_streak[op] = 0
+            if s["calm_pressure"] <= cfg.low_water and s["known"]:
+                self._calm_streak[op] = self._calm_streak.get(op, 0) + 1
+            else:
+                self._calm_streak[op] = 0
+
+        ages = [r.get("age_secs") for r in inp.rollups]
+        age = max((a for a in ages if a is not None), default=None)
+        stale = age is None or age > cfg.interval_secs
+        base = dict(t=inp.now, inputs=sig, rollup_age_secs=age)
+
+        up = self._scale_up_candidate(inp, sig)
+        if up is not None:
+            return self._gate(up, stale, base)
+        down = self._scale_down_candidate(inp, sig)
+        if down is not None:
+            return self._gate(down, stale, base)
+        return Decision(action=HOLD, reason="steady", **base)
+
+    def _gate(self, d: Decision, stale: bool,
+              base: Dict[str, Any]) -> Decision:
+        """Apply the veto gates common to both directions, in order:
+        stale inputs first (an actuation on old data is never safe),
+        then the per-direction cooldown."""
+        cfg = self.cfg
+        for k, v in base.items():
+            setattr(d, k, v)
+        if stale:
+            d.action, d.reason = VETO, VETO_STALE
+            d.overrides = None
+            return d
+        if d.action == VETO:
+            # already vetoed by the candidate itself (slot budget): no
+            # cooldown applies and NOTHING actuated — recording an
+            # action time here would let a phantom action block real
+            # scale-ups/downs for a full cooldown
+            return d
+        cooldown = (cfg.up_cooldown_secs if d.action == SCALE_UP
+                    else cfg.down_cooldown_secs)
+        if (self._last_action_t is not None
+                and d.t - self._last_action_t < cooldown):
+            d.action, d.reason = VETO, VETO_COOLDOWN
+            d.overrides = None
+            return d
+        self._last_action_t = d.t
+        self._last_action = d.action
+        return d
+
+    def _scale_up_candidate(self, inp: EvalInput,
+                            sig: Dict[str, Dict[str, float]]
+                            ) -> Optional[Decision]:
+        cfg = self.cfg
+        hot = [(s["pressure"], op) for op, s in sig.items()
+               if self._hot_streak.get(op, 0) >= cfg.up_sustain]
+        budget_hit = None
+        total = sum(inp.parallelism.values())
+        # worst first; op id tie-break keeps the choice deterministic
+        for pressure, op in sorted(hot, key=lambda x: (-x[0], x[1])):
+            p = inp.parallelism[op]
+            lo, hi = cfg.bounds(op)
+            hi = min(hi, inp.hard_max.get(op, hi))
+            if p >= hi:
+                continue  # pinned or already at its ceiling
+            s = sig[op]
+            growth = min((1.0 + s["bp_in"])
+                         * (1.0 + min(s["lag"], cfg.lag_high_secs)
+                            / max(cfg.target_drain_secs, 1e-9)),
+                         cfg.max_step_factor)
+            desired = max(p + 1, math.ceil(p * growth))
+            desired = min(desired, hi)
+            if cfg.slot_budget is not None:
+                desired = min(desired, cfg.slot_budget - (total - p))
+                if desired <= p:
+                    budget_hit = op
+                    continue
+            return Decision(
+                t=inp.now, action=SCALE_UP,
+                reason=f"pressure {pressure:.2f} >= {cfg.high_water} "
+                       f"for {self._hot_streak[op]} evals",
+                operator_id=op, from_parallelism=p, to_parallelism=desired,
+                overrides={op: desired})
+        if budget_hit is not None:
+            return Decision(
+                t=inp.now, action=VETO, reason=VETO_BUDGET,
+                operator_id=budget_hit,
+                from_parallelism=inp.parallelism[budget_hit])
+        return None
+
+    def _scale_down_candidate(self, inp: EvalInput,
+                              sig: Dict[str, Dict[str, float]]
+                              ) -> Optional[Decision]:
+        cfg = self.cfg
+        if any(s["calm_pressure"] >= cfg.high_water for s in sig.values()):
+            return None  # something is still hot; never shrink under load
+        if any(not s["known"] for s in sig.values()):
+            # partial rollup (a worker stopped reporting): the invisible
+            # operator may be the hot one — never shrink ANY operator
+            # while the job is partially blind
+            return None
+        calm = []
+        for op, s in sig.items():
+            p = inp.parallelism[op]
+            lo, _hi = cfg.bounds(op)
+            if (p > lo
+                    and self._calm_streak.get(op, 0) >= cfg.down_sustain
+                    and s["lag"] <= cfg.drain_lag_secs):
+                calm.append((s["calm_pressure"], op))
+        # most over-provisioned (least pressure) first, one step at a time
+        for pressure, op in sorted(calm, key=lambda x: (x[0], x[1])):
+            p = inp.parallelism[op]
+            lo, _hi = cfg.bounds(op)
+            desired = max(lo, p - 1)
+            if desired >= p:
+                continue
+            return Decision(
+                t=inp.now, action=SCALE_DOWN,
+                reason=f"pressure {pressure:.2f} <= {cfg.low_water} "
+                       f"for {self._calm_streak[op]} evals, lag drained",
+                operator_id=op, from_parallelism=p, to_parallelism=desired,
+                overrides={op: desired})
+        return None

@@ -1,0 +1,195 @@
+"""JobAutoscaler: the live per-job control loop.
+
+Thin I/O shell around BacklogDrainPolicy: every ``interval_secs`` it
+reads the controller's heartbeat-aggregated rollups for the job, runs
+one policy evaluation, records the decision in the ledger and the
+prometheus counters, and — when the policy recommends and nothing vetoes
+— actuates through the existing ``controller.rescale_job`` with
+per-operator overrides.  All decision logic lives in the policy so the
+deterministic simulator exercises exactly the code that runs here.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from typing import Any, Dict, List, Optional
+
+from ..config import config
+from ..obs import metrics as m
+from .ledger import DecisionLedger
+from .policy import (
+    HOLD,
+    SCALE_UP,
+    VETO,
+    VETO_ACTUATION_FAILED,
+    BacklogDrainPolicy,
+    Decision,
+    EvalInput,
+    PolicyConfig,
+)
+
+logger = logging.getLogger(__name__)
+
+
+def upstream_map(program) -> Dict[str, List[str]]:
+    """operator_id -> producer operator_ids, from the logical DAG."""
+    return {op: sorted(program.graph.predecessors(op))
+            for op in program.graph.nodes}
+
+
+class JobAutoscaler:
+    """One control loop per job.  Created for every job the controller
+    accepts (so the decision ledger and REST surface always exist); the
+    evaluation task only runs while ``enabled``."""
+
+    def __init__(self, controller, job_id: str,
+                 policy: Optional[BacklogDrainPolicy] = None,
+                 enabled: bool = False):
+        self.controller = controller
+        self.job_id = job_id
+        self.policy = policy or BacklogDrainPolicy(
+            PolicyConfig(interval_secs=config().autoscale_interval_secs))
+        self.ledger = DecisionLedger()
+        self.enabled = enabled
+        self._task: Optional[asyncio.Task] = None
+        self._rescaling = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def set_enabled(self, enabled: bool) -> None:
+        self.enabled = enabled
+        if enabled:
+            self.start()
+        else:
+            self.stop()
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(self._loop())
+
+    def stop(self) -> None:
+        if self._task is not None and not self._task.done():
+            self._task.cancel()
+        self._task = None
+
+    @property
+    def running(self) -> bool:
+        return self._task is not None and not self._task.done()
+
+    def status(self) -> Dict[str, Any]:
+        """REST/console payload: config, counters, the decision ring."""
+        job = self.controller.jobs.get(self.job_id)
+        return {
+            "job_id": self.job_id,
+            "enabled": self.enabled,
+            "global_enabled": config().autoscale_enabled,
+            "running": self.running,
+            "policy": self.policy.cfg.to_json(),
+            "evaluations": self.ledger.evaluations,
+            "actuations": self.ledger.actuations,
+            "vetoes": self.ledger.vetoes,
+            "parallelism": ({n.operator_id: n.parallelism
+                             for n in job.program.nodes()}
+                            if job is not None else {}),
+            # recent tail only — each entry carries a per-operator
+            # inputs digest, and the console polls this every second;
+            # actuations ride in their own list so a busy loop's holds
+            # can never push them out of view
+            "decisions": self.ledger.to_json(limit=128),
+            "actuated": self.ledger.actuated_json(),
+        }
+
+    # -- the loop ----------------------------------------------------------
+
+    async def _loop(self) -> None:
+        try:
+            while True:
+                await asyncio.sleep(self.policy.cfg.interval_secs)
+                job = self.controller.jobs.get(self.job_id)
+                if job is None or job.fsm.state.terminal:
+                    return
+                if not self.enabled:
+                    return  # disabled mid-sleep; set_enabled restarts
+                try:
+                    await self.evaluate_once(job)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:
+                    # the autoscaler must never take the controller down
+                    logger.exception("autoscaler evaluation for %s failed",
+                                     self.job_id)
+        except asyncio.CancelledError:
+            raise
+
+    async def evaluate_once(self, job) -> Decision:
+        """One evaluation tick: read rollups, decide, maybe actuate."""
+        from ..controller.state_machine import JobState
+
+        if self._rescaling or job.fsm.state != JobState.RUNNING:
+            # mid-rescale / not running: nothing to measure — skip the
+            # tick entirely instead of flooding the ledger with holds
+            return Decision(t=time.monotonic(), action=HOLD,
+                            reason="not_running")
+        rollups = self.controller.job_rollup(self.job_id)
+        decision = self.policy.evaluate(EvalInput(
+            now=time.monotonic(),
+            rollups=rollups,
+            parallelism={n.operator_id: n.parallelism
+                         for n in job.program.nodes()},
+            upstream=upstream_map(job.program),
+            # plan-pinned operators (StreamNode.max_parallelism, e.g. a
+            # global merge stage) are hard ceilings: recommending past
+            # them would checkpoint-stop the whole job for a no-op
+            hard_max={n.operator_id: n.max_parallelism
+                      for n in job.program.nodes()
+                      if n.max_parallelism is not None}))
+        self.ledger.append(decision)
+        m.autoscaler_counter(m.AUTOSCALER_DECISIONS, self.job_id,
+                             decision.action).inc()
+        if decision.action == VETO:
+            m.autoscaler_counter(m.AUTOSCALER_VETOES, self.job_id,
+                                 decision.reason).inc()
+        if decision.overrides:
+            await self._actuate(decision)
+        return decision
+
+    async def _actuate(self, decision: Decision) -> None:
+        # shielded: cancelling the loop (disable toggle, controller
+        # shutdown racing a tick) must not abort a rescale in flight —
+        # the FSM is between checkpoint-stop and restart there, and an
+        # abort would strand the job in RESCALING with no workers
+        await asyncio.shield(self._do_rescale(decision))
+
+    async def _do_rescale(self, decision: Decision) -> None:
+        direction = "up" if decision.action == SCALE_UP else "down"
+        self._rescaling = True
+        try:
+            await self.controller.rescale_job(self.job_id,
+                                              dict(decision.overrides))
+        except Exception as e:
+            # record the failure in the SAME ledger entry so the REST
+            # surface shows "recommended but failed", not a silent hold.
+            # The cooldown stamped at recommendation time intentionally
+            # stands: the failed attempt still checkpoint-stopped the
+            # job (controller.rescale_job recovers it), and retrying a
+            # failing rescale every interval would hammer a job that is
+            # already struggling
+            decision.error = f"{type(e).__name__}: {e}"
+            self.ledger.vetoes += 1
+            m.autoscaler_counter(m.AUTOSCALER_VETOES, self.job_id,
+                                 VETO_ACTUATION_FAILED).inc()
+            logger.warning("autoscaler rescale of %s failed: %s",
+                           self.job_id, e)
+            return
+        finally:
+            self._rescaling = False
+        self.ledger.record_actuated(decision)
+        m.autoscaler_counter(m.AUTOSCALER_ACTUATIONS, self.job_id,
+                             direction).inc()
+        for op, p in decision.overrides.items():
+            m.autoscaler_parallelism_gauge(self.job_id, op).set(p)
+        logger.info("autoscaler rescaled %s: %s %s -> %s", self.job_id,
+                    decision.operator_id, decision.from_parallelism,
+                    decision.to_parallelism)

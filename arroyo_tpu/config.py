@@ -68,8 +68,12 @@ class Config:
     )
 
     # Supervision (job_controller/mod.rs:30-32 defaults)
-    checkpoints_to_keep: int = field(
-        default_factory=lambda: _env_int("CHECKPOINTS_TO_KEEP", 4)
+    # checkpoint retention: prune to the last N completed epochs after
+    # every successful checkpoint and after every rescale restore point
+    # (CHECKPOINTS_TO_KEEP accepted as a legacy alias)
+    checkpoint_retention: int = field(
+        default_factory=lambda: _env_int(
+            "CHECKPOINT_RETENTION", _env_int("CHECKPOINTS_TO_KEEP", 3))
     )
     compact_every: int = field(default_factory=lambda: _env_int("COMPACT_EVERY", 2))
     heartbeat_interval_secs: float = field(
@@ -90,6 +94,20 @@ class Config:
         default_factory=lambda: _env_int("STATE_CAPACITY", 1 << 12)
     )  # initial per-subtask keyed-state slots (doubles on overflow;
     # benchmarks pre-size via STATE_CAPACITY to avoid growth recompiles)
+
+    # Autoscaling (arroyo_tpu/autoscale): ARROYO_AUTOSCALE=0 is the
+    # global escape hatch — no per-job control loops run at all.  With
+    # the subsystem enabled, jobs still start with the loop inactive
+    # unless ARROYO_AUTOSCALE_DEFAULT=1 (or the REST PUT enables them).
+    autoscale_enabled: bool = field(
+        default_factory=lambda: _env_bool("ARROYO_AUTOSCALE", True)
+    )
+    autoscale_default_on: bool = field(
+        default_factory=lambda: _env_bool("ARROYO_AUTOSCALE_DEFAULT", False)
+    )
+    autoscale_interval_secs: float = field(
+        default_factory=lambda: _env_float("AUTOSCALE_INTERVAL_SECS", 15.0)
+    )
 
     # Telemetry
     disable_telemetry: bool = field(

@@ -116,6 +116,10 @@ class ControllerServer:
         self.host = host
         self.rpc = RpcServer()
         self.jobs: Dict[str, Job] = {}
+        # per-job autoscalers (arroyo_tpu/autoscale): one per accepted
+        # job so the decision ledger + REST surface always exist; the
+        # loop itself only runs while the job's autoscaler is enabled
+        self.autoscalers: Dict[str, Any] = {}
         self.addr: Optional[str] = None
         self.sink_subscribers: Dict[str, List[asyncio.Queue]] = {}
         # durable job state (states/mod.rs:577-628 analog): every
@@ -195,6 +199,8 @@ class ControllerServer:
             job.ttl_deadline = row.ttl_deadline
             self._attach_store(job, row.n_workers)
             self.jobs[row.job_id] = job
+            self._attach_autoscaler(row.job_id)
+            self._restore_autoscaler(row.job_id, row.autoscale)
             logger.info("resuming job %s from durable store (stored "
                         "state %s, epoch %d)", row.job_id, row.state,
                         row.epoch)
@@ -216,9 +222,81 @@ class ControllerServer:
         for job in self.jobs.values():
             if job.supervisor:
                 job.supervisor.cancel()
+        for scaler in self.autoscalers.values():
+            scaler.stop()
         await self.rpc.stop()
         if self.store is not None:
             self.store.close()
+
+    def _attach_autoscaler(self, job_id: str) -> None:
+        """One JobAutoscaler per accepted job (ledger + REST surface);
+        the evaluation loop starts only when enabled — by default via
+        ARROYO_AUTOSCALE_DEFAULT, or later through the REST PUT.
+        ARROYO_AUTOSCALE=0 keeps the subsystem entirely out."""
+        cfg = config()
+        if not cfg.autoscale_enabled:
+            return
+        from ..autoscale.supervisor import JobAutoscaler
+
+        prev = self.autoscalers.get(job_id)
+        if prev is not None:
+            # a resubmitted job_id must not leak the old loop: two live
+            # loops would race rescale_job against each other
+            prev.stop()
+        scaler = JobAutoscaler(self, job_id)
+        self.autoscalers[job_id] = scaler
+        if cfg.autoscale_default_on:
+            scaler.set_enabled(True)
+        # keep the store in sync: a resubmitted job_id must not inherit
+        # the previous incarnation's persisted spec on the next restart
+        # (the resume path overwrites this again from the stored row)
+        self.persist_autoscaler(job_id)
+
+    def persist_autoscaler(self, job_id: str) -> None:
+        """Persist the per-job autoscaler spec (enabled + policy) so a
+        restarted controller resumes it with the job (the REST PUT calls
+        this after every change)."""
+        if self.store is None:
+            return
+        scaler = self.autoscalers.get(job_id)
+        if scaler is not None:
+            self.store.set_autoscale(job_id, json.dumps({
+                "enabled": scaler.enabled,
+                "policy": scaler.policy.cfg.to_json()}))
+
+    def _restore_autoscaler(self, job_id: str,
+                            spec_json: Optional[str]) -> None:
+        """Re-arm a resumed job's autoscaler from its stored spec."""
+        scaler = self.autoscalers.get(job_id)
+        if not spec_json or scaler is None:
+            return
+        try:
+            spec = json.loads(spec_json)
+        except Exception:
+            # a corrupt spec must not block the job resume itself
+            logger.warning("job %s: stored autoscaler spec unreadable",
+                           job_id, exc_info=True)
+            return
+        if spec.get("policy"):
+            try:
+                from ..autoscale.policy import (BacklogDrainPolicy,
+                                                PolicyConfig)
+
+                cfg = PolicyConfig(**spec["policy"])
+                # same range gate as the REST merge path: a stored
+                # interval_secs=0 would busy-spin the controller loop
+                cfg._check_ranges()
+                scaler.policy = BacklogDrainPolicy(cfg)
+            except Exception:
+                logger.warning("job %s: stored autoscaler policy "
+                               "invalid; keeping defaults", job_id,
+                               exc_info=True)
+        # unconditional, and applied even when the policy was unusable:
+        # a persisted enabled:false must override an
+        # ARROYO_AUTOSCALE_DEFAULT=1 enable from the attach — the
+        # operator explicitly turned this job's autoscaler off
+        scaler.set_enabled(bool(spec.get("enabled")))
+        self.persist_autoscaler(job_id)
 
     # -- job API (what arroyo-api calls via gRPC/DB) ----------------------
 
@@ -240,6 +318,7 @@ class ControllerServer:
                                   JobState.CREATED.value,
                                   ttl_deadline=job.ttl_deadline)
             self._attach_store(job, n_workers)
+        self._attach_autoscaler(job_id)
         job.supervisor = asyncio.ensure_future(
             self._drive(job, n_workers, restore))
         return job_id
@@ -295,6 +374,10 @@ class ControllerServer:
             self.store.set_program(job.job_id, pickle.dumps(job.program),
                                    n_workers)
         await self._restart_workers(job, n_workers, force_stop=False)
+        # the rescale's restore point is now the only epoch the new
+        # topology can resume from — prune retention behind it so the
+        # stop-checkpoint of every rescale doesn't grow storage unbounded
+        await self._prune_checkpoints(job)
 
     def job_state(self, job_id: str) -> JobState:
         return self.jobs[job_id].fsm.state
@@ -791,11 +874,33 @@ class ControllerServer:
                          "dropped": result["to_drop"]},
                         ignore_errors=True)
         # epoch cleanup: keep the last N checkpoints (mod.rs:30, 388-394)
-        keep = config().checkpoints_to_keep
-        min_epoch = max(tracker.epoch - keep + 1, 0)
-        if min_epoch > job.min_epoch:
-            job.min_epoch = min_epoch
-            backend.cleanup_before(job.job_id, min_epoch)
+        await self._prune_checkpoints(job, backend=backend)
+
+    async def _prune_checkpoints(self, job: Job, backend=None) -> None:
+        """Prune to the last ``checkpoint_retention`` completed epochs.
+        Runs after every successful checkpoint AND after every rescale
+        restore point (state/backend cleanup_before does the listing and
+        deletes, which can hit object storage — so off the event loop)."""
+        if job.last_successful_epoch is None:
+            return
+        keep = config().checkpoint_retention
+        min_epoch = max(job.last_successful_epoch - keep + 1, 0)
+        if min_epoch <= job.min_epoch:
+            return
+        job.min_epoch = min_epoch
+        if backend is None:
+            backend = ParquetBackend.for_url(job.checkpoint_url)
+        if self.store is not None:
+            self.store.set_progress(job.job_id, job.epoch, job.min_epoch,
+                                    job.last_successful_epoch)
+        try:
+            await asyncio.get_running_loop().run_in_executor(
+                None, backend.cleanup_before, job.job_id, min_epoch)
+        except Exception:
+            # retention is best-effort: a storage hiccup must not fail
+            # a checkpoint finalize or a completed rescale
+            logger.warning("checkpoint pruning for %s failed", job.job_id,
+                           exc_info=True)
 
     async def _task_finished(self, req: Dict) -> Dict:
         job = self.jobs.get(req["job_id"])

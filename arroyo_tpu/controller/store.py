@@ -35,6 +35,9 @@ class StoredJob:
     last_successful_epoch: Optional[int]
     stop_requested: bool
     ttl_deadline: Optional[float] = None
+    # JSON {"enabled": bool, "policy": {...}} — the autoscaler survives
+    # a controller restart like the job itself does
+    autoscale: Optional[str] = None
 
 
 class ControllerStore:
@@ -58,6 +61,10 @@ class ControllerStore:
             )""")
         try:  # stores created before the ttl column
             self.db.execute("ALTER TABLE jobs ADD COLUMN ttl_deadline REAL")
+        except sqlite3.OperationalError:
+            pass
+        try:  # stores created before the autoscaler column
+            self.db.execute("ALTER TABLE jobs ADD COLUMN autoscale TEXT")
         except sqlite3.OperationalError:
             pass
         self.db.execute("""
@@ -116,6 +123,12 @@ class ControllerStore:
                 (program, n_workers, time.time(), job_id))
         self.db.commit()
 
+    def set_autoscale(self, job_id: str, spec_json: Optional[str]) -> None:
+        self.db.execute(
+            "UPDATE jobs SET autoscale=?, updated_at=? WHERE job_id=?",
+            (spec_json, time.time(), job_id))
+        self.db.commit()
+
     def set_stop_requested(self, job_id: str) -> None:
         self.db.execute(
             "UPDATE jobs SET stop_requested=1, updated_at=? WHERE job_id=?",
@@ -127,11 +140,11 @@ class ControllerStore:
         rows = self.db.execute(
             "SELECT job_id, program, checkpoint_url, n_workers, state,"
             " epoch, min_epoch, last_successful_epoch, stop_requested,"
-            " ttl_deadline"
+            " ttl_deadline, autoscale"
             " FROM jobs WHERE state NOT IN (?, ?, ?)",
             TERMINAL_STATES).fetchall()
         return [StoredJob(r[0], r[1], r[2], r[3], r[4], r[5], r[6], r[7],
-                          bool(r[8]), r[9]) for r in rows]
+                          bool(r[8]), r[9], r[10]) for r in rows]
 
     # -- scheduler external worker ids ------------------------------------
 

@@ -205,6 +205,57 @@ def table_size_gauge(task_info, table_char: str) -> Gauge:
         table_char=table_char)
 
 
+# -- autoscaler instruments --------------------------------------------------
+
+# controller-side: every policy evaluation lands in decisions (labeled by
+# the resulting action incl. hold/veto), blocked recommendations in
+# vetoes (labeled by reason), and completed rescales in actuations
+AUTOSCALER_DECISIONS = "arroyo_autoscaler_decisions_total"
+AUTOSCALER_VETOES = "arroyo_autoscaler_vetoes_total"
+AUTOSCALER_ACTUATIONS = "arroyo_autoscaler_actuations_total"
+AUTOSCALER_PARALLELISM = "arroyo_autoscaler_target_parallelism"
+
+_AUTOSCALER_LABELS = {
+    AUTOSCALER_DECISIONS: ("job_id", "action"),
+    AUTOSCALER_VETOES: ("job_id", "reason"),
+    AUTOSCALER_ACTUATIONS: ("job_id", "direction"),
+}
+_AUTOSCALER_HELP = {
+    AUTOSCALER_DECISIONS: "autoscaler policy evaluations by action",
+    AUTOSCALER_VETOES: "autoscaler recommendations blocked, by reason",
+    AUTOSCALER_ACTUATIONS: "autoscaler-driven rescales that completed",
+}
+_autoscaler_counters: Dict[str, Counter] = {}
+_autoscaler_parallelism: Optional[Gauge] = None
+
+
+def autoscaler_counter(name: str, job_id: str, value: str) -> Counter:
+    """Labeled child of one autoscaler counter family (name must be one
+    of the AUTOSCALER_* counter constants)."""
+    with _lock:
+        if name not in _autoscaler_counters:
+            _autoscaler_counters[name] = Counter(
+                name, _AUTOSCALER_HELP[name], _AUTOSCALER_LABELS[name],
+                registry=REGISTRY)
+    labels = _AUTOSCALER_LABELS[name]
+    return _autoscaler_counters[name].labels(**{labels[0]: job_id,
+                                                labels[1]: value})
+
+
+def autoscaler_parallelism_gauge(job_id: str, operator_id: str) -> Gauge:
+    """The parallelism the autoscaler last targeted per operator — plot
+    against the worker throughput families to see elasticity."""
+    global _autoscaler_parallelism
+    with _lock:
+        if _autoscaler_parallelism is None:
+            _autoscaler_parallelism = Gauge(
+                AUTOSCALER_PARALLELISM,
+                "operator parallelism last targeted by the autoscaler",
+                ("job_id", "operator_id"), registry=REGISTRY)
+    return _autoscaler_parallelism.labels(job_id=job_id,
+                                          operator_id=operator_id)
+
+
 CHECKPOINT_TABLE_SECONDS = "arroyo_worker_checkpoint_table_seconds"
 CHECKPOINT_TABLE_BYTES = "arroyo_worker_checkpoint_table_bytes"
 _table_ckpt_gauges: Dict[str, Gauge] = {}

@@ -10,6 +10,11 @@ Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"} for the
 query named by BENCH_QUERY (default q5, the headline the driver records).
 BENCH_ALL=1 runs every query, printing non-headline results to stderr.
 
+``--autoscale`` runs the elasticity benchmark instead: an impulse flood
+through a real controller with the closed-loop autoscaler enabled, the
+JSON line carrying the decision timeline and throughput-vs-parallelism
+samples (``autoscale`` key) rather than a steady-state headline.
+
 Baseline: the reference publishes no numbers and its Rust CPU backend
 cannot run in this image (no cargo toolchain, BASELINE.md) — so
 ``vs_baseline`` is measured against an honest, clearly-labeled CONTROL:
@@ -24,6 +29,7 @@ import json
 import os
 import subprocess
 import sys
+import tempfile
 import time
 
 NUM_EVENTS = int(os.environ.get("BENCH_EVENTS", 2_000_000))
@@ -914,6 +920,119 @@ def run_kernel_microbench() -> dict:
     return out
 
 
+def run_autoscale_bench() -> dict:
+    """``--autoscale`` mode: elasticity, not steady state.  Run an
+    impulse flood through a real controller with the autoscaler enabled
+    on the bottleneck aggregate and record (a) the decision timeline and
+    (b) throughput-vs-parallelism samples, so BENCH_* artifacts show how
+    the system tracks load, not just its peak."""
+    import asyncio
+
+    from arroyo_tpu import AggKind, AggSpec, Stream
+    from arroyo_tpu.autoscale import BacklogDrainPolicy, PolicyConfig
+    from arroyo_tpu.controller.controller import ControllerServer
+    from arroyo_tpu.controller.scheduler import InProcessScheduler
+    from arroyo_tpu.controller.state_machine import JobState
+
+    n = int(os.environ.get("BENCH_AUTOSCALE_EVENTS", 400_000))
+    rate = float(os.environ.get("BENCH_AUTOSCALE_RATE", 30_000.0))
+    os.environ.setdefault("HEARTBEAT_INTERVAL_SECS", "0.2")
+    # the explicit --autoscale flag wins over an ambient escape hatch:
+    # without this, ARROYO_AUTOSCALE=0 in the environment would crash
+    # the elasticity benchmark instead of measuring it
+    os.environ["ARROYO_AUTOSCALE"] = "1"
+    import arroyo_tpu.config as _cfg
+
+    _cfg.reset_config()
+
+    out_path = os.path.join(tempfile.mkdtemp(prefix="arroyo_as_"),
+                            "out.jsonl")
+
+    async def scenario():
+        from arroyo_tpu.types import now_micros
+
+        ctrl = ControllerServer(InProcessScheduler())
+        await ctrl.start()
+        prog = (
+            # backlog replay: event times start 10 minutes behind the
+            # wall clock, so the watermark-lag signal drives catch-up
+            # provisioning while the rate limit keeps the run long
+            # enough to capture a decision timeline
+            Stream.source("impulse", {"event_rate": rate,
+                                      "message_count": n,
+                                      "event_time_interval_micros": 1000,
+                                      "base_time_micros":
+                                          now_micros() - 600_000_000,
+                                      "batch_size": 256}, parallelism=1)
+            .watermark(max_lateness_micros=0)
+            .map(lambda c: {"counter": c["counter"],
+                            "bucket": c["counter"] % 8}, name="b")
+            .key_by("bucket")
+            .tumbling_aggregate(
+                500 * 1000, [AggSpec(AggKind.COUNT, None, "cnt")],
+                parallelism=1)
+            .sink("single_file", {"path": out_path}, parallelism=1)
+        )
+        agg_id = next(node.operator_id for node in prog.nodes()
+                      if "aggregator" in node.operator_id)
+        t0 = time.perf_counter()
+        job_id = await ctrl.submit_job(prog, n_workers=1)
+        points = []
+        try:
+            scaler = ctrl.autoscalers[job_id]
+            scaler.policy = BacklogDrainPolicy(PolicyConfig(
+                interval_secs=0.3, high_water=0.3, up_sustain=1,
+                lag_warn_secs=0.5, lag_high_secs=5.0,
+                up_cooldown_secs=8.0, down_cooldown_secs=600.0,
+                max_parallelism=1,
+                per_op={agg_id: {"min": 1, "max": 4}}))
+            scaler.set_enabled(True)
+            while not ctrl.jobs[job_id].fsm.state.terminal:
+                await asyncio.sleep(0.5)
+                roll = {r["operator_id"]: r
+                        for r in ctrl.job_rollup(job_id)}
+                par = {node.operator_id: node.parallelism
+                       for node in prog.nodes()}
+                # mid-rescale the rollup can omit the aggregate (workers
+                # restarting): record null, never a substituted total
+                agg_rate = roll.get(agg_id, {}).get("records_per_sec")
+                points.append({
+                    "t": round(time.perf_counter() - t0, 2),
+                    "parallelism": par[agg_id],
+                    "total_parallelism": sum(par.values()),
+                    "records_per_sec": (None if agg_rate is None
+                                        else round(agg_rate, 1)),
+                    "backpressure": roll.get(agg_id, {}).get(
+                        "backpressure"),
+                })
+            state = await ctrl.wait_for_state(job_id, JobState.FINISHED,
+                                              timeout=10)
+            dt = time.perf_counter() - t0
+            timeline = [d.to_json() for d in scaler.ledger.decisions()
+                        if d.action != "hold"]
+            return {
+                "state": state.value, "wall_secs": round(dt, 2),
+                "events": n,
+                "events_per_sec": round(n / dt, 1),
+                "final_parallelism": prog.node(agg_id).parallelism,
+                "actuations": scaler.ledger.actuations,
+                "vetoes": scaler.ledger.vetoes,
+                "decision_timeline": timeline[-64:],
+                "throughput_vs_parallelism": points,
+            }
+        finally:
+            await ctrl.scheduler.stop_workers(job_id)
+            await ctrl.stop()
+
+    result = asyncio.run(scenario())
+    with open(out_path) as f:
+        produced = sum(json.loads(line)["cnt"] for line in f)
+    result["output_events"] = produced
+    result["exactly_once"] = produced == n
+    return {"metric": "autoscale_elasticity", "unit": "decisions",
+            "value": result["actuations"], "autoscale": result}
+
+
 def main_kernels_child() -> None:
     import jax  # noqa: F401  (fail fast if the backend is unreachable)
 
@@ -1169,7 +1288,12 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    if os.environ.get("BENCH_KERNELS_CHILD"):
+    if "--autoscale" in sys.argv[1:]:
+        # elasticity mode runs in-process on the forced-CPU path (it
+        # measures the control loop, not kernels) and emits its own line
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps(run_autoscale_bench()))
+    elif os.environ.get("BENCH_KERNELS_CHILD"):
         main_kernels_child()
     elif os.environ.get("BENCH_CHILD"):
         main_child()

@@ -455,7 +455,8 @@ def test_generated_client_black_box_lifecycle(api_env):
             assert (await client.ping())["pong"]
             ops = set(client.operations)
             assert {"create_pipeline", "list_jobs", "get_pipeline",
-                    "delete_pipeline", "job_checkpoints"} <= ops
+                    "delete_pipeline", "job_checkpoints",
+                    "autoscaler_status", "autoscaler_update"} <= ops
 
             got = await client.validate_pipeline(body={"query": QUERY})
             assert got["graph"]["nodes"]
@@ -463,6 +464,19 @@ def test_generated_client_black_box_lifecycle(api_env):
             pl = await client.create_pipeline(
                 body={"name": "genclient", "query": QUERY})
             job_id = pl["jobs"][0]["id"]
+
+            # autoscaler surface through the generated client: the job
+            # starts with the loop disabled; a PUT round-trips a policy
+            # knob merge and the enable flag
+            st = await client.autoscaler_status(jid=job_id)
+            assert st["enabled"] is False and st["decisions"] == []
+            st = await client.autoscaler_update(
+                jid=job_id, body={"enabled": True,
+                                  "policy": {"high_water": 0.55}})
+            assert st["enabled"] and st["policy"]["high_water"] == 0.55
+            st = await client.autoscaler_update(jid=job_id,
+                                                body={"enabled": False})
+            assert st["enabled"] is False
             for _ in range(200):
                 jobs = (await client.list_jobs())["data"]
                 job = next(j for j in jobs if j["id"] == job_id)

@@ -14,7 +14,10 @@
 #   3. the metrics scrape must be non-empty and contain the
 #      flight-recorder histogram families (an empty scrape means the
 #      obs wiring regressed even if the pipeline "ran");
-#   4. tests/test_obs.py — the observability contract suite.
+#   4. the autoscaler: a deterministic ramp trace through the policy
+#      simulator must scale up the bottleneck (and only it), and the
+#      REST GET/PUT /v1/jobs/{id}/autoscaler surface must round-trip;
+#   5. tests/test_obs.py — the observability contract suite.
 #
 # Usage: tools/smoke.sh   (from anywhere; runs on CPU for determinism)
 set -euo pipefail
@@ -59,6 +62,67 @@ for family in ("arroyo_worker_messages_recv",
     if family not in text:
         sys.exit(f"smoke: metrics scrape is missing {family}")
 print(f"smoke: nexmark ok ({rows} result rows), metrics scrape ok")
+PY
+
+python - <<'PY'
+import asyncio
+import sys
+
+from arroyo_tpu.autoscale import BacklogDrainPolicy, PolicyConfig
+from arroyo_tpu.autoscale.sim import PolicySimulator, SimCluster, \
+    SimOperator, ramp
+
+# 1. simulator smoke: a sustained ramp must scale up ONLY the bottleneck
+sim = PolicySimulator(
+    BacklogDrainPolicy(PolicyConfig(interval_secs=10, up_sustain=2,
+                                    up_cooldown_secs=30)),
+    SimCluster([SimOperator("src", 1e9), SimOperator("agg", 10_000.0),
+                SimOperator("sink", 1e9)]))
+res = sim.run(ramp(5_000, 30_000, over_secs=60), steps=12)
+ups = [d for d in res.actuations if d.action == "scale_up"]
+if not ups:
+    sys.exit("smoke: autoscaler simulator never scaled up on a ramp")
+if {d.operator_id for d in ups} != {"agg"}:
+    sys.exit(f"smoke: autoscaler scaled non-bottleneck operators: {ups}")
+if sim.cluster.parallelism["src"] != 1 or sim.cluster.parallelism["sink"] != 1:
+    sys.exit("smoke: autoscaler touched pinned-calm operators")
+
+# 2. REST surface: GET/PUT round-trip against a live ApiServer
+async def rest_check():
+    import httpx
+
+    from arroyo_tpu import Stream
+    from arroyo_tpu.api.rest import ApiServer
+    from arroyo_tpu.controller.controller import ControllerServer, Job
+    from arroyo_tpu.controller.scheduler import InProcessScheduler
+
+    ctrl = ControllerServer(InProcessScheduler())
+    await ctrl.start()
+    api = ApiServer(ctrl)
+    port = await api.start()
+    prog = Stream.source("impulse", {"message_count": 10}).sink(
+        "blackhole", {})
+    ctrl.jobs["smoke"] = Job("smoke", prog, "file:///tmp/smoke-ckpt", 1)
+    try:
+        async with httpx.AsyncClient(
+                base_url=f"http://127.0.0.1:{port}", timeout=10) as c:
+            r = await c.get("/v1/jobs/smoke/autoscaler")
+            assert r.status_code == 200, r.text
+            assert r.json()["enabled"] is False
+            r = await c.put("/v1/jobs/smoke/autoscaler",
+                            json={"enabled": True,
+                                  "policy": {"high_water": 0.6}})
+            assert r.status_code == 200, r.text
+            body = r.json()
+            assert body["enabled"] and body["policy"]["high_water"] == 0.6
+            r = await c.get("/v1/jobs/missing/autoscaler")
+            assert r.status_code == 404
+    finally:
+        await api.stop()
+        await ctrl.stop()
+
+asyncio.run(rest_check())
+print("smoke: autoscaler simulator + REST surface ok")
 PY
 
 exec python -m pytest tests/test_obs.py -q -p no:cacheprovider

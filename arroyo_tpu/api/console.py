@@ -229,6 +229,25 @@ function renderDag(g, overlay) {
     <marker id="arr" viewBox="0 0 8 8" refX="7" refY="4" markerWidth="7"
      markerHeight="7" orient="auto"><path d="M0 0L8 4L0 8z"
      fill="#3a4450"/></marker></defs>`;
+  // chained operators (node.chain = head id) render as one grouped
+  // task: a dashed outline behind the member boxes — these run fused in
+  // a single TaskRunner with no queue hops between them
+  const chains = {};
+  g.nodes.forEach(n => {
+    if (n.chain) (chains[n.chain] = chains[n.chain] || []).push(
+      n.operator_id);
+  });
+  for (const ids of Object.values(chains)) {
+    if (ids.length < 2) continue;
+    const xs = ids.map(id => pos[id].x), ys = ids.map(id => pos[id].y);
+    const x0 = Math.min(...xs) - 7, y0 = Math.min(...ys) - 7;
+    const x1 = Math.max(...xs) + W + 7, y1 = Math.max(...ys) + H + 7;
+    out += `<rect x="${x0}" y="${y0}" width="${x1 - x0}"
+      height="${y1 - y0}" rx="9" fill="#10161d" stroke="#2a5a8a"
+      stroke-dasharray="5 4"/>
+      <text x="${x0 + 6}" y="${y0 - 3}" fill="#3f7fb5"
+      >chain ×${ids.length}</text>`;
+  }
   for (const e of g.edges) {
     const a = pos[e.src], b = pos[e.dst];
     if (!a || !b) continue;

@@ -892,11 +892,18 @@ def _sink_event_json(item: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def _graph_json(prog) -> Dict[str, Any]:
-    """Pipeline DAG for the console (PipelineGraph in the REST types)."""
+    """Pipeline DAG for the console (PipelineGraph in the REST types).
+    Members of a multi-operator chain carry the chain head's id so the
+    console can render them as one grouped task."""
+    from ..graph.chaining import chain_annotations
+
+    chains = chain_annotations(prog)
     return {
         "nodes": [{"operator_id": n.operator_id,
                    "description": n.operator.name,
-                   "parallelism": n.parallelism}
+                   "parallelism": n.parallelism,
+                   **({"chain": chains[n.operator_id]}
+                      if n.operator_id in chains else {})}
                   for n in prog.nodes()],
         "edges": [{"src": u, "dst": v,
                    "edge_type": prog.edge(u, v).typ.value}

@@ -50,6 +50,16 @@ class Config:
     batch_linger_micros: int = field(
         default_factory=lambda: _env_int("BATCH_LINGER_MICROS", 10_000)
     )
+    # Input-side micro-batch coalescing (engine/coalesce.py): merge
+    # sub-target fragments at task inputs before dispatch.  Target rows
+    # (0 = use target_batch_size) and the bounded linger a partial
+    # buffer may wait for more input.  ARROYO_COALESCE=0 disables.
+    coalesce_target: int = field(
+        default_factory=lambda: _env_int("COALESCE_TARGET", 0)
+    )
+    coalesce_linger_micros: int = field(
+        default_factory=lambda: _env_int("COALESCE_LINGER_MICROS", 2_000)
+    )
 
     # Control plane
     controller_addr: str = field(
@@ -65,6 +75,14 @@ class Config:
     )
     artifact_url: str = field(
         default_factory=lambda: _env_str("ARTIFACT_URL", "file:///tmp/arroyo_tpu/artifacts")
+    )
+    # JAX persistent compilation cache (engine/aot.py): '' = the
+    # env-signature-keyed default under the /tmp scratch dir, 'off'
+    # disables, anything else is used verbatim.  ARROYO_COMPILE_CACHE
+    # accepted as a legacy alias.
+    compile_cache_dir: str = field(
+        default_factory=lambda: _env_str(
+            "COMPILE_CACHE_DIR", _env_str("ARROYO_COMPILE_CACHE", ""))
     )
 
     # Supervision (job_controller/mod.rs:30-32 defaults)

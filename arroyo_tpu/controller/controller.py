@@ -341,8 +341,15 @@ class ControllerServer:
     async def rescale_job(self, job_id: str,
                           overrides: Dict[str, int]) -> None:
         """Rescaling path (states/rescaling.rs): checkpoint-stop, update
-        parallelism, reschedule with state re-sharded by key range."""
+        parallelism, reschedule with state re-sharded by key range.
+
+        A chain is the unit of parallelism: overrides addressed to any
+        chained operator are expanded to the whole chain (otherwise the
+        rescale would split the chain and lose the fusion)."""
+        from ..graph.chaining import expand_overrides
+
         job = self.jobs[job_id]
+        overrides = expand_overrides(job.program, overrides)
         # worker count from the controller's own registry, BEFORE the
         # stop: schedulers' live listings are empty once workers exit
         n_workers = max(len(job.workers), 1)

@@ -40,6 +40,30 @@ from .task import TaskRunner
 
 logger = logging.getLogger(__name__)
 
+_compile_cache_enabled = False
+
+
+def _enable_compile_cache() -> None:
+    """Point jax at the persistent compilation cache once per process
+    (config knob ``compile_cache_dir``; empty = the env-keyed default
+    under /tmp, 'off' disables).  Repeated bench probes, engine rebuilds
+    and worker restarts then reuse XLA executables instead of paying
+    full recompile cost."""
+    global _compile_cache_enabled
+    if _compile_cache_enabled:
+        return
+    _compile_cache_enabled = True
+    d = config().compile_cache_dir
+    if d.lower() in ("off", "0", "false", "disabled", "none"):
+        return
+    try:
+        from .aot import enable_persistent_cache
+
+        enable_persistent_cache(d or None)
+    except Exception:
+        logger.warning("persistent compile cache unavailable",
+                       exc_info=True)
+
 
 @dataclass
 class SubtaskHandle:
@@ -48,6 +72,9 @@ class SubtaskHandle:
     control_tx: asyncio.Queue  # ControlMessage -> task
     is_source: bool
     task: Optional[asyncio.Task] = None
+    # logical operators executed by this runner — [op_id] for a plain
+    # subtask, the full member list (head first) for a chained one
+    member_ids: List[str] = field(default_factory=list)
 
 
 class Engine:
@@ -110,7 +137,18 @@ class Engine:
 
     def start(self) -> "RunningEngine":
         """Build the physical graph and spawn all subtask loops."""
+        _enable_compile_cache()
         g = self.program.graph
+        # operator chaining (graph/chaining.py): maximal linear runs of
+        # same-parallelism forward-edge operators execute inside ONE
+        # TaskRunner — no intermediate queues, one alignment per chain.
+        # ARROYO_CHAIN=0 yields an empty plan and reproduces the
+        # per-operator topology bit-for-bit.
+        from ..graph.chaining import plan_chains, validate_chain_plan
+
+        chain_plan = plan_chains(self.program)
+        validate_chain_plan(self.program, chain_plan)
+        chain_interior = {m for grp in chain_plan.groups for m in grp[1:]}
         # queues[(src_id, src_idx, dst_id, dst_idx)] — the reference's Quad
         queues: Dict[Tuple[str, int, str, int], asyncio.Queue] = {}
         qsize = config().queue_size
@@ -139,78 +177,129 @@ class Engine:
                 self.network.register_in_edge(quad, q)
             return q
 
-        # construct subtasks in topo order
-        for op_id in self.program.topo_order():
-            node: StreamNode = self.program.node(op_id)
-            parallelism = node.parallelism
-            out_edges = list(g.out_edges(op_id, data=True))
-            in_edges = list(g.in_edges(op_id, data=True))
+        def build_subtask(ms: List[str], idx: int) -> None:
+            """One runner for the member run ``ms`` (a full chain, or a
+            single operator) at subtask index ``idx``."""
+            head_id, tail_id = ms[0], ms[-1]
+            head_node: StreamNode = self.program.node(head_id)
+            parallelism = head_node.parallelism
+            out_edges = list(g.out_edges(tail_id, data=True))
+            in_edges = list(g.in_edges(head_id, data=True))
 
-            for idx in range(parallelism):
-                if not self._is_mine(op_id, idx):
-                    continue
-                task_info = TaskInfo(self.job_id, op_id, node.operator.name,
-                                     idx, parallelism)
-
-                # output edge groups (one group per downstream operator)
-                edge_groups: List[List[OutQueue]] = []
-                for _, dst, data in out_edges:
-                    dst_par = self.program.node(dst).parallelism
-                    typ: EdgeType = data["edge"].typ
-                    if typ == EdgeType.FORWARD:
-                        # equal parallelism: 1:1 chain; mismatched: rebalance —
-                        # fan-in (src i -> dst i % dst_par) or fan-out
-                        # (src i -> every dst j with j % src_par == i,
-                        # round-robined per batch by the Collector)
-                        if dst_par > parallelism:
-                            group = [out_queue((op_id, idx, dst, j))
-                                     for j in range(dst_par)
-                                     if j % parallelism == idx]
-                        else:
-                            group = [out_queue((op_id, idx, dst,
-                                                idx % dst_par))]
+            # output edge groups (one group per downstream operator),
+            # leaving from the chain TAIL
+            edge_groups: List[List[OutQueue]] = []
+            for _, dst, data in out_edges:
+                dst_par = self.program.node(dst).parallelism
+                typ: EdgeType = data["edge"].typ
+                if typ == EdgeType.FORWARD:
+                    # equal parallelism: 1:1 chain; mismatched: rebalance —
+                    # fan-in (src i -> dst i % dst_par) or fan-out
+                    # (src i -> every dst j with j % src_par == i,
+                    # round-robined per batch by the Collector)
+                    if dst_par > parallelism:
+                        group = [out_queue((tail_id, idx, dst, j))
+                                 for j in range(dst_par)
+                                 if j % parallelism == idx]
                     else:
-                        group = [out_queue((op_id, idx, dst, j))
-                                 for j in range(dst_par)]
-                    edge_groups.append(group)
+                        group = [out_queue((tail_id, idx, dst,
+                                            idx % dst_par))]
+                else:
+                    group = [out_queue((tail_id, idx, dst, j))
+                             for j in range(dst_par)]
+                edge_groups.append(group)
 
-                # input channels: (side, queue) per upstream subtask
-                inputs: List[Tuple[int, asyncio.Queue]] = []
-                for src, _, data in sorted(
-                        in_edges, key=lambda e: e[2]["edge"].typ.value):
-                    src_par = self.program.node(src).parallelism
-                    typ = data["edge"].typ
-                    side = 1 if typ == EdgeType.SHUFFLE_JOIN_RIGHT else 0
-                    if typ == EdgeType.FORWARD:
-                        if parallelism > src_par:
-                            inputs.append((side, in_queue(
-                                (src, idx % src_par, op_id, idx))))
-                        else:
-                            for j in range(src_par):
-                                if j % parallelism == idx:
-                                    inputs.append((side, in_queue((src, j, op_id, idx))))
+            # input channels into the chain HEAD: (side, queue) per
+            # upstream subtask
+            inputs: List[Tuple[int, asyncio.Queue]] = []
+            for src, _, data in sorted(
+                    in_edges, key=lambda e: e[2]["edge"].typ.value):
+                src_par = self.program.node(src).parallelism
+                typ = data["edge"].typ
+                side = 1 if typ == EdgeType.SHUFFLE_JOIN_RIGHT else 0
+                if typ == EdgeType.FORWARD:
+                    if parallelism > src_par:
+                        inputs.append((side, in_queue(
+                            (src, idx % src_par, head_id, idx))))
                     else:
                         for j in range(src_par):
-                            inputs.append((side, in_queue((src, j, op_id, idx))))
+                            if j % parallelism == idx:
+                                inputs.append((side, in_queue(
+                                    (src, j, head_id, idx))))
+                else:
+                    for j in range(src_par):
+                        inputs.append((side, in_queue((src, j, head_id,
+                                                       idx))))
 
-                operator = build_operator(node.operator)
-                store = StateStore(task_info, self.backend, self.restore_epoch)
-                restore_wm = store.restore_watermark() if self.restore_epoch else None
-                from ..obs.metrics import TaskMetrics
+            from ..obs.metrics import (CHAIN_MEMBERS, TaskMetrics,
+                                       gauge_for_task)
 
-                metrics = TaskMetrics(task_info)
-                ctx = Context(task_info, Collector(edge_groups, metrics),
-                              n_inputs=len(inputs), state_store=store,
+            infos = [TaskInfo(self.job_id, m,
+                              self.program.node(m).operator.name, idx,
+                              parallelism) for m in ms]
+            metrics_list = [TaskMetrics(ti) for ti in infos]
+            stores = [StateStore(ti, self.backend, self.restore_epoch)
+                      for ti in infos]
+            collector = Collector(edge_groups, metrics_list[-1])
+            if len(ms) == 1:
+                operator = build_operator(head_node.operator)
+                rwm = (stores[0].restore_watermark()
+                       if self.restore_epoch else None)
+                ctx = Context(infos[0], collector, n_inputs=len(inputs),
+                              state_store=stores[0],
                               control_tx=self.control_resp,
-                              restore_watermark=restore_wm,
-                              metrics=metrics)
-                control_rx: asyncio.Queue = asyncio.Queue()
-                runner = TaskRunner(task_info, operator, ctx, inputs,
-                                    control_rx, self.control_resp)
-                ctx._runner = runner  # sources poll control via the runner
-                self.subtasks[(op_id, idx)] = SubtaskHandle(
-                    task_info, runner, control_rx,
-                    isinstance(operator, SourceOperator))
+                              restore_watermark=rwm,
+                              metrics=metrics_list[0])
+            else:
+                from .chained import ChainedOperator
+
+                ops = [build_operator(self.program.node(m).operator)
+                       for m in ms]
+                operator = ChainedOperator(infos, ops)
+                ctxs: List[Context] = []
+                for i, (ti, st, mx) in enumerate(
+                        zip(infos, stores, metrics_list)):
+                    coll = (collector if i == len(ms) - 1
+                            else operator.make_link(i))
+                    rwm = (st.restore_watermark()
+                           if self.restore_epoch else None)
+                    ctxs.append(Context(
+                        ti, coll,
+                        n_inputs=len(inputs) if i == 0 else 1,
+                        state_store=st, control_tx=self.control_resp,
+                        restore_watermark=rwm, metrics=mx))
+                operator.bind(ctxs)
+                ctx = ctxs[0]
+            gauge_for_task(infos[0], CHAIN_MEMBERS,
+                           "operators fused into this task").set(len(ms))
+            control_rx: asyncio.Queue = asyncio.Queue()
+            runner = TaskRunner(infos[0], operator, ctx, inputs,
+                                control_rx, self.control_resp)
+            ctx._runner = runner  # sources poll control via the runner
+            self.subtasks[(head_id, idx)] = SubtaskHandle(
+                infos[0], runner, control_rx,
+                isinstance(operator, SourceOperator),
+                member_ids=list(ms))
+
+        # construct subtasks in topo order (chain heads only; interior
+        # members are built inside their head's runner)
+        for op_id in self.program.topo_order():
+            if op_id in chain_interior:
+                continue
+            members = chain_plan.members_of.get(op_id, [op_id])
+            for idx in range(self.program.node(op_id).parallelism):
+                mine = [m for m in members if self._is_mine(m, idx)]
+                if not mine:
+                    continue
+                if len(mine) == len(members):
+                    build_subtask(members, idx)
+                else:
+                    # split assignment across workers (the controller's
+                    # slot packing never produces this, but defensively):
+                    # run each local member unchained so cross-worker
+                    # member edges ride the data plane
+                    for m in mine:
+                        build_subtask([m], idx)
 
         for handle in self.subtasks.values():
             handle.task = asyncio.ensure_future(handle.runner.start())
@@ -227,9 +316,13 @@ class RunningEngine:
         return [h.control_tx for h in self.engine.subtasks.values() if h.is_source]
 
     def operator_controls(self) -> Dict[str, List[asyncio.Queue]]:
+        """Per-operator control queues; every member of a chained task
+        maps to its runner's queue, so operator-addressed control
+        (compaction hot-swaps) still reaches fused operators."""
         out: Dict[str, List[asyncio.Queue]] = {}
         for (op_id, _), h in sorted(self.engine.subtasks.items()):
-            out.setdefault(op_id, []).append(h.control_tx)
+            for m in (h.member_ids or [op_id]):
+                out.setdefault(m, []).append(h.control_tx)
         return out
 
     def sink_controls(self) -> List[asyncio.Queue]:
@@ -252,12 +345,16 @@ class RunningEngine:
         Returns False on timeout."""
         import time as _time
 
-        n_subtasks = len(self.engine.subtasks)
+        # one completion per (member operator, subtask index): a chained
+        # runner reports each member separately, so counting runners
+        # would return before unrelated tasks (e.g. the source) finished
+        expected = {(m, idx) for (op, idx), h in self.engine.subtasks.items()
+                    for m in (h.member_ids or [op])}
         deadline = _time.monotonic() + timeout
-        count = sum(1 for r in self.engine.resps
-                    if r.kind == "checkpoint_completed"
-                    and r.subtask_metadata.epoch == epoch)
-        while count < n_subtasks:
+        done = {(r.operator_id, r.task_index) for r in self.engine.resps
+                if r.kind == "checkpoint_completed"
+                and r.subtask_metadata.epoch == epoch}
+        while not expected <= done:
             remain = deadline - _time.monotonic()
             if remain <= 0:
                 return False
@@ -269,7 +366,7 @@ class RunningEngine:
             self.engine.resps.append(resp)
             if (resp.kind == "checkpoint_completed"
                     and resp.subtask_metadata.epoch == epoch):
-                count += 1
+                done.add((resp.operator_id, resp.task_index))
         return True
 
     async def stop(self, mode: StopMode = StopMode.GRACEFUL) -> None:

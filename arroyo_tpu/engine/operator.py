@@ -32,11 +32,54 @@ class SourceFinishType(Enum):
 class Operator:
     """Base for single-input (and generic) operators."""
 
+    # True when the operator records its own per-batch lag/latency
+    # metrics (ChainedOperator attributes them per member); the
+    # TaskRunner then skips its task-level observation to avoid
+    # double-counting.
+    own_batch_metrics = False
+
     def __init__(self, name: str):
         self.name = name
 
     def tables(self) -> List[TableDescriptor]:
         return []
+
+    async def open(self, ctx: Context) -> None:
+        """Task startup: register state tables, restore persisted timers
+        (reserved table '[' — arroyo-worker/src/lib.rs:152), then
+        ``on_start``.  ChainedOperator overrides to open every member
+        against its own per-member context."""
+        for desc in self.tables():
+            ctx.state.register(desc)
+        timer_table = ctx.state.get_global_keyed_state("[", "timers")
+        saved_timers = timer_table.get("timers")
+        if saved_timers:
+            ctx.timers.restore(saved_timers)
+        await self.on_start(ctx)
+
+    async def checkpoint_state(self, barrier: CheckpointBarrier,
+                               ctx: Context) -> List[Any]:
+        """Snapshot this operator's state at a barrier; returns the
+        ``SubtaskCheckpointMetadata`` list to report (one entry here; a
+        ChainedOperator returns one per member so chained checkpoints
+        stay restorable un-chained and vice versa)."""
+        from ..obs import tracing
+
+        tid = ctx.task_info.task_id
+        with tracing.span("checkpoint.pre", "checkpoint", tid=tid,
+                          args={"epoch": barrier.epoch}):
+            await self.pre_checkpoint(barrier, ctx)
+        ctx.state.get_global_keyed_state("[").insert(
+            "timers", ctx.timers.snapshot())
+        with tracing.span("checkpoint.sync", "checkpoint", tid=tid,
+                          args={"epoch": barrier.epoch}):
+            metadata = ctx.state.checkpoint(barrier.epoch,
+                                            ctx.last_watermark)
+        if ctx.metrics is not None:
+            ctx.metrics.checkpoint_duration.observe(max(
+                (metadata.finish_time - metadata.start_time) / 1e6, 0.0))
+            ctx.metrics.checkpoint_bytes.observe(metadata.bytes)
+        return [metadata]
 
     async def on_start(self, ctx: Context) -> None:
         pass

@@ -82,6 +82,10 @@ class TaskRunner:
         self.task_info = task_info
         self.operator = operator
         self.ctx = ctx
+        # a ChainedOperator's runner ctx is the HEAD member's (input
+        # alignment, timers); downstream broadcasts (barriers, stop/eod,
+        # idle forward) leave from the TAIL member's context
+        self.out_ctx: Context = getattr(operator, "tail_ctx", None) or ctx
         self.inputs = inputs
         self.control_rx = control_rx
         self.control_tx = control_tx
@@ -114,7 +118,7 @@ class TaskRunner:
             # inputs that will never end (the controller tears the job
             # down in distributed mode; end_of_data is the local analog)
             try:
-                await self.ctx.broadcast(Message.end_of_data())
+                await self.out_ctx.broadcast(Message.end_of_data())
             except Exception:
                 pass
         finally:
@@ -126,17 +130,9 @@ class TaskRunner:
             self.finished.set()
 
     async def _run(self) -> None:
-        # register tables, restore, start
-        for desc in self.operator.tables():
-            self.ctx.state.register(desc)
-        # timers persist under the reserved table name '[' like the reference
-        # (arroyo-worker/src/lib.rs:152): restore before on_start so operators
-        # may add to them
-        timer_table = self.ctx.state.get_global_keyed_state("[", "timers")
-        saved_timers = timer_table.get("timers")
-        if saved_timers:
-            self.ctx.timers.restore(saved_timers)
-        await self.operator.on_start(self.ctx)
+        # register tables, restore persisted timers, on_start — per
+        # member for chained operators (Operator.open)
+        await self.operator.open(self.ctx)
         await self.ctx.report(ControlResp(
             kind="task_started", operator_id=self.task_info.operator_id,
             task_index=self.task_info.task_index))
@@ -156,10 +152,10 @@ class TaskRunner:
         finish = await self.operator.run(self.ctx)
         if finish == SourceFinishType.FINAL:
             # final watermark flushes all windows downstream
-            await self.ctx.broadcast(Message.wm(Watermark.event_time(int(MAX_TIMESTAMP))))
-            await self.ctx.broadcast(Message.end_of_data())
+            await self.out_ctx.broadcast(Message.wm(Watermark.event_time(int(MAX_TIMESTAMP))))
+            await self.out_ctx.broadcast(Message.end_of_data())
         elif finish == SourceFinishType.GRACEFUL:
-            await self.ctx.broadcast(Message.stop())
+            await self.out_ctx.broadcast(Message.stop())
         else:
             pass  # immediate: just exit
 
@@ -204,19 +200,35 @@ class TaskRunner:
         get_merged: Optional[asyncio.Future] = None
         get_control: Optional[asyncio.Future] = None
         metrics = self.ctx.metrics
+        coal = self._make_coalescer()
         try:
             while ended < n_inputs:
                 if get_merged is None or get_merged.done():
                     get_merged = asyncio.ensure_future(self.merged.get())
                 if get_control is None or get_control.done():
                     get_control = asyncio.ensure_future(self.control_rx.get())
+                timeout = None
+                if coal is not None and coal.pending:
+                    # bounded linger: wake up to flush even if no more
+                    # input arrives
+                    timeout = max(coal.deadline - _time.monotonic(), 0.0)
                 wait_t0 = _time.perf_counter()
                 done, _ = await asyncio.wait(
-                    [get_merged, get_control], return_when=asyncio.FIRST_COMPLETED)
+                    [get_merged, get_control],
+                    return_when=asyncio.FIRST_COMPLETED, timeout=timeout)
                 if metrics is not None:
                     # time this loop sat waiting for input (starvation —
                     # the upstream-is-slow half of backpressure analysis)
                     metrics.queue_wait.observe(_time.perf_counter() - wait_t0)
+                if (coal is not None and coal.pending
+                        and _time.monotonic() >= coal.deadline):
+                    # linger expired — flush whether or not new input
+                    # arrived (a continuous sub-target trickle must not
+                    # defer the flush until the size target is reached)
+                    for cside, cbatch in coal.flush_all():
+                        await self._process_record(cbatch, cside)
+                if not done:
+                    continue
                 if get_control in done:
                     # arroyolint: disable=async-blocking -- future is in asyncio.wait's done set; .result() cannot block
                     cm = get_control.result()
@@ -235,34 +247,31 @@ class TaskRunner:
                 if msg.kind == MessageKind.RECORD:
                     if metrics is not None:
                         metrics.messages_recv.inc(len(msg.batch))
-                        if len(msg.batch):
-                            # event-time lag at this operator: processing
-                            # wall clock vs the freshest event in the batch.
-                            # Sentinels are excluded by testing the
-                            # timestamp itself (unset/MIN and final-flush
-                            # MAX), not by bounding the lag — a historical
-                            # replay's months-of-backlog lag is exactly the
-                            # signal the histogram exists to carry
-                            ts = int(np.max(msg.batch.timestamp))
-                            if 0 < ts < int(MAX_TIMESTAMP) - 1:
-                                metrics.event_time_lag.observe(
-                                    max((now_micros() - ts) / 1e6, 0.0))
-                        t0 = _time.perf_counter()
-                        await self.operator.process_batch(
-                            msg.batch, self.ctx, side)
-                        metrics.batch_latency.observe(
-                            _time.perf_counter() - t0)
+                    if coal is not None:
+                        for cside, cbatch in coal.add(side, msg.batch):
+                            await self._process_record(cbatch, cside)
                     else:
-                        await self.operator.process_batch(
-                            msg.batch, self.ctx, side)
+                        await self._process_record(msg.batch, side)
                 elif msg.kind == MessageKind.WATERMARK:
+                    # buffered records arrived BEFORE this watermark on
+                    # their channels: flush so they are never reordered
+                    # past it (a window could otherwise fire without them)
+                    if coal is not None and coal.pending:
+                        for cside, cbatch in coal.flush_all():
+                            await self._process_record(cbatch, cside)
                     advanced = self.ctx.observe_watermark(idx, msg.watermark)
                     if advanced is not None:
                         await self._advance_watermark(advanced)
                     elif (msg.watermark.is_idle
                           and self.ctx.watermarks.all_idle()):
-                        await self.ctx.broadcast(Message.wm(Watermark.idle()))
+                        await self.out_ctx.broadcast(
+                            Message.wm(Watermark.idle()))
                 elif msg.kind == MessageKind.BARRIER:
+                    # same ordering rule as watermarks: pre-barrier
+                    # records must be in operator state before snapshot
+                    if coal is not None and coal.pending:
+                        for cside, cbatch in coal.flush_all():
+                            await self._process_record(cbatch, cside)
                     b = msg.barrier
                     pending_barriers[b.epoch] = b
                     self._align_start.setdefault(b.epoch, tracing.now_us())
@@ -276,6 +285,9 @@ class TaskRunner:
                             then_stop = True
                             break
                 elif msg.is_end:
+                    if coal is not None and coal.pending:
+                        for cside, cbatch in coal.flush_all():
+                            await self._process_record(cbatch, cside)
                     ended += 1
                     if msg.kind == MessageKind.STOP:
                         stop_mode = StopMode.GRACEFUL
@@ -310,9 +322,48 @@ class TaskRunner:
         await self._await_pending_commit()
         await self.operator.on_close(self.ctx)
         if then_stop or stop_mode is not None:
-            await self.ctx.broadcast(Message.stop())
+            await self.out_ctx.broadcast(Message.stop())
         else:
-            await self.ctx.broadcast(Message.end_of_data())
+            await self.out_ctx.broadcast(Message.end_of_data())
+
+    def _make_coalescer(self):
+        """Input-side adaptive micro-batch coalescer (see engine/
+        coalesce.py); None when disabled via ARROYO_COALESCE=0."""
+        from ..config import config
+        from .coalesce import BatchCoalescer, coalescing_enabled
+
+        if not coalescing_enabled():
+            return None
+        cfg = config()
+        target = cfg.coalesce_target or cfg.target_batch_size
+        hist = (self.ctx.metrics.coalesce_batches
+                if self.ctx.metrics is not None else None)
+        return BatchCoalescer(target, cfg.coalesce_linger_micros / 1e6,
+                              hist)
+
+    async def _process_record(self, batch, side: int) -> None:
+        """Run one (possibly coalesced) record batch through the
+        operator with the task-level flight-recorder observations —
+        unless the operator attributes per-member metrics itself
+        (ChainedOperator)."""
+        metrics = self.ctx.metrics
+        if metrics is None or self.operator.own_batch_metrics:
+            await self.operator.process_batch(batch, self.ctx, side)
+            return
+        if len(batch):
+            # event-time lag at this operator: processing wall clock vs
+            # the freshest event in the batch.  Sentinels are excluded by
+            # testing the timestamp itself (unset/MIN and final-flush
+            # MAX), not by bounding the lag — a historical replay's
+            # months-of-backlog lag is exactly the signal the histogram
+            # exists to carry
+            ts = int(np.max(batch.timestamp))
+            if 0 < ts < int(MAX_TIMESTAMP) - 1:
+                metrics.event_time_lag.observe(
+                    max((now_micros() - ts) / 1e6, 0.0))
+        t0 = _time.perf_counter()
+        await self.operator.process_batch(batch, self.ctx, side)
+        metrics.batch_latency.observe(_time.perf_counter() - t0)
 
     async def _await_pending_commit(self, timeout: float = 30.0) -> None:
         """A two-phase sink whose pre-commits were sealed by the final
@@ -364,27 +415,20 @@ class TaskRunner:
                                 tracing.now_us() - align_start, tid=tid,
                                 args={"epoch": barrier.epoch})
         await self._report_event(barrier, CheckpointEventType.STARTED_CHECKPOINTING)
-        with tracing.span("checkpoint.pre", "checkpoint", tid=tid,
-                          args={"epoch": barrier.epoch}):
-            await self.operator.pre_checkpoint(barrier, self.ctx)
-        self.ctx.state.get_global_keyed_state("[").insert(
-            "timers", self.ctx.timers.snapshot())
-        with tracing.span("checkpoint.sync", "checkpoint", tid=tid,
-                          args={"epoch": barrier.epoch}):
-            metadata = self.ctx.state.checkpoint(barrier.epoch,
-                                                 self.ctx.last_watermark)
-        if self.ctx.metrics is not None:
-            self.ctx.metrics.checkpoint_duration.observe(max(
-                (metadata.finish_time - metadata.start_time) / 1e6, 0.0))
-            self.ctx.metrics.checkpoint_bytes.observe(metadata.bytes)
+        # snapshot state (per member for chained operators — the
+        # controller's epoch tracker expects one completion per logical
+        # (operator, subtask), and per-member metadata keeps chained
+        # checkpoints restorable un-chained and vice versa)
+        metadatas = await self.operator.checkpoint_state(barrier, self.ctx)
         await self._report_event(barrier, CheckpointEventType.FINISHED_SYNC)
-        await self.ctx.report(ControlResp(
-            kind="checkpoint_completed",
-            operator_id=self.task_info.operator_id,
-            task_index=self.task_info.task_index,
-            subtask_metadata=metadata))
+        for metadata in metadatas:
+            await self.ctx.report(ControlResp(
+                kind="checkpoint_completed",
+                operator_id=metadata.operator_id,
+                task_index=metadata.subtask_index,
+                subtask_metadata=metadata))
         # rebroadcast barrier downstream
-        await self.ctx.broadcast(Message.barrier_msg(barrier))
+        await self.out_ctx.broadcast(Message.barrier_msg(barrier))
 
     async def _report_event(self, b: CheckpointBarrier,
                             et: CheckpointEventType) -> None:

@@ -1,0 +1,161 @@
+"""Operator chaining — the planner pass that fuses maximal linear runs
+of same-parallelism, forward-edge operators into one task.
+
+The reference compiles consecutive operators into a single native binary
+where they run fused in one task; our port historically ran *every*
+operator as its own TaskRunner with an asyncio queue hop, a ``Batch``
+re-materialization and a separate kernel dispatch per hop.  This pass
+computes, over the **logical** graph (which it never mutates), the
+groups of operators the engine may execute inside a single
+:class:`~arroyo_tpu.engine.chained.ChainedOperator`:
+
+* every edge inside a chain is ``FORWARD`` with equal parallelism on
+  both ends (a strict 1:1 subtask pairing — no rebalance, no shuffle);
+* interior connectivity is linear: the upstream end has exactly one
+  out-edge and the downstream end exactly one in-edge, so no fan-in/
+  fan-out is hidden inside a chain;
+* sources and sinks never chain (sources drive their own loop and are
+  where barriers enter the graph; sinks carry two-phase commit
+  semantics and their own control handling).
+
+What breaks a chain, therefore: shuffle edges, parallelism changes,
+fan-in/fan-out, and sources/sinks.
+
+Chain identity is *per member*: checkpoint state tables, metrics labels
+and rollups keep each member's own operator_id, so a checkpoint taken
+chained restores un-chained and vice versa.  ``ARROYO_CHAIN=0`` disables
+the pass entirely and reproduces the per-operator task topology
+bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .logical import EdgeType, OpKind, Program
+
+# operator kinds that never join a chain
+_UNCHAINABLE = (OpKind.CONNECTOR_SOURCE, OpKind.CONNECTOR_SINK)
+
+
+def chaining_enabled() -> bool:
+    """``ARROYO_CHAIN=0`` is the full escape hatch (read per call so
+    tests and the smoke gate can toggle it without a config reset)."""
+    return os.environ.get("ARROYO_CHAIN", "1") not in ("0", "off", "false")
+
+
+@dataclass
+class ChainPlan:
+    """The chaining decision for one Program.
+
+    ``groups`` holds only multi-member chains (head first, topo order);
+    ``head_of`` maps every member of a multi-member chain to its head;
+    ``members_of`` maps each head to its full member list."""
+
+    groups: List[List[str]] = field(default_factory=list)
+    head_of: Dict[str, str] = field(default_factory=dict)
+    members_of: Dict[str, List[str]] = field(default_factory=dict)
+
+    def group_for(self, op_id: str) -> Optional[List[str]]:
+        head = self.head_of.get(op_id)
+        return self.members_of.get(head) if head is not None else None
+
+
+def _chainable_node(program: Program, op_id: str) -> bool:
+    return program.node(op_id).operator.kind not in _UNCHAINABLE
+
+
+def _chainable_edge(program: Program, u: str, v: str) -> bool:
+    g = program.graph
+    if program.edge(u, v).typ is not EdgeType.FORWARD:
+        return False
+    if not (_chainable_node(program, u) and _chainable_node(program, v)):
+        return False
+    if program.node(u).parallelism != program.node(v).parallelism:
+        return False
+    # strictly linear: no fan-out at u, no fan-in at v
+    return g.out_degree(u) == 1 and g.in_degree(v) == 1
+
+
+def plan_chains(program: Program) -> ChainPlan:
+    """Compute maximal linear chains over the logical graph.  Returns an
+    empty plan when chaining is disabled."""
+    plan = ChainPlan()
+    if not chaining_enabled():
+        return plan
+    nxt: Dict[str, str] = {}
+    prev: Dict[str, str] = {}
+    for u, v in program.graph.edges:
+        if _chainable_edge(program, u, v):
+            nxt[u] = v
+            prev[v] = u
+    for op_id in program.topo_order():
+        if op_id in prev or op_id not in nxt:
+            continue  # not a chain head (interior member, or unchained)
+        run = [op_id]
+        while run[-1] in nxt:
+            run.append(nxt[run[-1]])
+        plan.groups.append(run)
+        plan.members_of[op_id] = run
+        for m in run:
+            plan.head_of[m] = op_id
+    return plan
+
+
+def validate_chain_plan(program: Program, plan: ChainPlan) -> None:
+    """Plan-validator hook for the chaining pass: re-check every chain's
+    invariants against the graph and raise ``ValueError`` on violation.
+    Cheap (O(edges)); run by the engine before building chained tasks so
+    a buggy pass can never silently mis-wire a topology."""
+    problems: List[str] = []
+    for grp in plan.groups:
+        if len(grp) < 2:
+            problems.append(f"degenerate chain {grp}")
+            continue
+        for m in grp:
+            if not _chainable_node(program, m):
+                problems.append(f"{m}: sources/sinks cannot chain")
+        for u, v in zip(grp, grp[1:]):
+            if not program.graph.has_edge(u, v):
+                problems.append(f"chain edge {u}->{v} missing from graph")
+            elif not _chainable_edge(program, u, v):
+                problems.append(
+                    f"chain edge {u}->{v} is not chainable (shuffle, "
+                    "parallelism change, or fan-in/fan-out)")
+    if problems:
+        raise ValueError("invalid chain plan: " + "; ".join(problems))
+
+
+def expand_overrides(program: Program,
+                     overrides: Dict[str, int]) -> Dict[str, int]:
+    """Rescale-path awareness: a chain is the unit of parallelism, so a
+    parallelism override addressed to any member applies to the whole
+    chain (otherwise the rescale would split the chain and silently lose
+    the fusion).  The target is capped at the smallest member
+    ``max_parallelism`` so the chain stays uniform after
+    ``Program.update_parallelism``'s per-node caps.  When two overrides
+    land on the same chain, the larger target wins (scale-up safety).
+    No-op when chaining is disabled."""
+    plan = plan_chains(program)
+    if not plan.groups:
+        return dict(overrides)
+    out: Dict[str, int] = {}
+    for op_id, p in overrides.items():
+        group = plan.group_for(op_id)
+        if group is None:
+            out[op_id] = max(out.get(op_id, 0), p) if op_id in out else p
+            continue
+        caps = [program.node(m).max_parallelism for m in group
+                if program.node(m).max_parallelism is not None]
+        target = min([p] + caps)
+        for m in group:
+            out[m] = max(out.get(m, 0), target)
+    return out
+
+
+def chain_annotations(program: Program) -> Dict[str, str]:
+    """{member op_id -> chain head op_id} for multi-member chains — the
+    console's DAG grouping payload.  Empty when chaining is disabled."""
+    return dict(plan_chains(program).head_of)

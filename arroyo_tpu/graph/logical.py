@@ -913,7 +913,8 @@ class Stream:
     # -- sinks -------------------------------------------------------------
 
     def sink(self, connector: str, config: Optional[Dict[str, Any]] = None,
-             parallelism: Optional[int] = None, name: Optional[str] = None) -> Program:
+             parallelism: Optional[int] = None, name: Optional[str] = None,
+             max_parallelism: Optional[int] = None) -> Program:
         from ..connectors.registry import get_connector, validate_config
 
         meta = get_connector(connector)
@@ -925,5 +926,9 @@ class Stream:
             name or f"{connector}_sink",
             spec=ConnectorOpSpec(connector, cfg),
         )
-        self._chain(op, parallelism)
+        tail = self._chain(op, parallelism)
+        if max_parallelism is not None:
+            # sinks that must stay single-writer (e.g. single_file) pin
+            # here so rescales can never fan them out
+            self.program.node(tail.tail).max_parallelism = max_parallelism
         return self.program

@@ -49,42 +49,81 @@ from ..obs.metrics import (BYTES_RECV, BYTES_SENT, FLUSH_LATENCY,  # noqa: E402
 MAGIC = 0xA770_10CB
 KIND_DATA = 0
 KIND_CONTROL = 1
+# schema-less continuation frame: one Arrow record-batch message decoded
+# against the schema delivered by the edge's last KIND_DATA frame.
+# Frames on one TCP connection arrive in order, so the receiver's cached
+# per-edge schema is always the one this batch was encoded under.
+KIND_DATA_BATCH = 2
 
 Quad = Tuple[str, int, str, int]
 
 
-def _encode_batch(batch: Batch) -> bytes:
+def _arrow_parts(batch: Batch):
+    """(schema-with-metadata, RecordBatch) for one Batch — the shared
+    front half of the full-stream and continuation encoders."""
     import pyarrow as pa
 
-    buf = io.BytesIO()
-    table = batch.to_arrow()
+    arrays = batch.arrow_arrays()
     meta = {b"key_cols": ",".join(batch.key_cols).encode()}
     if batch.key_hash is not None:
         meta[b"has_key_hash"] = b"1"
-        table = table.append_column(
-            "__key_hash", pa.array(batch.key_hash, type=pa.uint64()))
-    table = table.replace_schema_metadata(meta)
-    with pa.ipc.new_stream(buf, table.schema) as w:
-        w.write_table(table)
+        arrays["__key_hash"] = pa.array(batch.key_hash, type=pa.uint64())
+    rb = pa.record_batch(list(arrays.values()),
+                         names=list(arrays.keys()))
+    rb = rb.replace_schema_metadata(meta)
+    return rb.schema, rb
+
+
+def _stream_bytes(rb) -> bytes:
+    """Full Arrow IPC stream (schema + one batch) — the KIND_DATA
+    payload, written once per edge stream (and again on schema change)."""
+    import pyarrow as pa
+
+    buf = io.BytesIO()
+    with pa.ipc.new_stream(buf, rb.schema) as w:
+        w.write_batch(rb)
     return buf.getvalue()
 
 
-def _decode_batch(data: bytes) -> Batch:
-    import pyarrow as pa
+def _encode_batch(batch: Batch) -> bytes:
+    schema, rb = _arrow_parts(batch)
+    return _stream_bytes(rb)
 
-    with pa.ipc.open_stream(io.BytesIO(data)) as r:
-        table = r.read_all()
-    meta = table.schema.metadata or {}
+
+def _table_to_batch(table, meta) -> Batch:
     kh = None
-    if meta.get(b"has_key_hash") == b"1":
+    if (meta or {}).get(b"has_key_hash") == b"1":
         kh = table.column("__key_hash").combine_chunks().to_numpy(
             zero_copy_only=False).astype(np.uint64)
         table = table.drop_columns(["__key_hash"])
     batch = Batch.from_arrow(table)
-    key_cols = meta.get(b"key_cols", b"").decode()
+    key_cols = (meta or {}).get(b"key_cols", b"").decode()
     batch.key_hash = kh
     batch.key_cols = tuple(key_cols.split(",")) if key_cols else ()
     return batch
+
+
+def _decode_batch_full(data: bytes):
+    """(Batch, schema) from a full KIND_DATA stream payload; the schema
+    is what continuation frames on the same edge decode against."""
+    import pyarrow as pa
+
+    with pa.ipc.open_stream(io.BytesIO(data)) as r:
+        table = r.read_all()
+    schema = table.schema
+    return _table_to_batch(table, schema.metadata), schema
+
+
+def _decode_batch(data: bytes) -> Batch:
+    return _decode_batch_full(data)[0]
+
+
+def _decode_batch_continuation(data: bytes, schema) -> Batch:
+    import pyarrow as pa
+
+    rb = pa.ipc.read_record_batch(pa.py_buffer(data), schema)
+    return _table_to_batch(pa.Table.from_batches([rb], schema=schema),
+                           schema.metadata)
 
 
 def encode_message(msg: Message) -> Tuple[int, bytes]:
@@ -157,9 +196,12 @@ class NetworkManager:
         self.server: Optional[asyncio.AbstractServer] = None
         self.port: Optional[int] = None
         self._out_writers: Dict[str, asyncio.StreamWriter] = {}
-        self._out_locks: Dict[str, asyncio.Lock] = {}
         self._in_writers: list = []  # accepted connections, closed on close()
         self._pending: Dict[Quad, list] = {}  # frames ahead of registration
+        # receive side of the encode fast path: per-edge Arrow schema
+        # from the last full (KIND_DATA) frame, which KIND_DATA_BATCH
+        # continuations decode against
+        self._edge_schemas: Dict[Quad, Any] = {}
         # labeled prometheus children resolved once per quad, off hot path
         self._metric_children: Dict[Tuple[str, str, int], Any] = {}
 
@@ -197,6 +239,22 @@ class NetworkManager:
         for msg in self._pending.pop(quad, []):
             queue.put_nowait(msg)
 
+    def _decode_frame(self, quad: Quad, kind: int, payload: bytes) -> Message:
+        if kind == KIND_DATA:
+            batch, schema = _decode_batch_full(payload)
+            self._edge_schemas[quad] = schema
+            return Message.record(batch)
+        if kind == KIND_DATA_BATCH:
+            schema = self._edge_schemas.get(quad)
+            if schema is None:
+                # cannot happen on an ordered stream (the first data
+                # frame per edge is always a full one) — fail loudly
+                # rather than fabricate a schema
+                raise ValueError(f"continuation frame for {quad} before "
+                                 "any full frame delivered its schema")
+            return Message.record(_decode_batch_continuation(payload, schema))
+        return decode_message(kind, payload)
+
     async def open_listener(self, host: str = "0.0.0.0", port: int = 0) -> int:
         async def on_conn(reader, writer):
             self._in_writers.append(writer)
@@ -207,13 +265,13 @@ class NetworkManager:
                 quad, kind, payload = frame
                 self._bytes_counter(BYTES_RECV, quad[2], quad[3]).inc(
                     len(payload))
+                msg = self._decode_frame(quad, kind, payload)
                 q = self.senders.get(quad)
                 if q is None:
                     # receiver engine not built yet: park the frame
-                    self._pending.setdefault(quad, []).append(
-                        decode_message(kind, payload))
+                    self._pending.setdefault(quad, []).append(msg)
                     continue
-                await q.put(decode_message(kind, payload))
+                await q.put(msg)
             writer.close()
 
         self.server = await asyncio.start_server(on_conn, host, port)
@@ -235,31 +293,57 @@ class NetworkManager:
         else:
             raise ConnectionError(f"cannot reach worker data plane at {addr}")
         self._out_writers[addr] = writer
-        self._out_locks[addr] = asyncio.Lock()
 
     def remote_sender(self, addr: str, quad: Quad
                       ) -> Callable[[Message], Awaitable[None]]:
-        """An OutQueue-compatible async send fn for a remote edge."""
+        """An OutQueue-compatible async send fn for a remote edge.
+
+        Encode fast path: the Arrow IPC schema is written ONCE per edge
+        stream — the first record frame (and any frame after a schema
+        change) is a full stream, every other one a schema-less
+        KIND_DATA_BATCH continuation the receiver decodes against its
+        cached schema.  ``drain()`` is awaited only when the transport
+        buffer crossed its high-water mark: ``StreamWriter.write`` is
+        synchronous and hands bytes to the transport immediately, so
+        draining under the mark was pure per-frame overhead (an await +
+        lock round-trip) with no flow-control effect."""
 
         sent_counter = self._bytes_counter(BYTES_SENT, quad[0], quad[1])
         frame_bytes = self._frame_histogram(
             FRAME_BYTES, "serialized payload bytes per data-plane frame",
             quad[0], quad[1])
         flush_latency = self._frame_histogram(
-            FLUSH_LATENCY, "writer lock wait + socket drain seconds per "
-            "frame", quad[0], quad[1])
+            FLUSH_LATENCY, "socket drain seconds per high-water flush",
+            quad[0], quad[1])
+        state: Dict[str, Any] = {"schema": None}
 
         async def send(msg: Message) -> None:
             writer = self._out_writers[addr]
-            kind, payload = encode_message(msg)
+            if msg.kind == MessageKind.RECORD:
+                schema, rb = _arrow_parts(msg.batch)
+                prev = state["schema"]
+                if prev is not None and schema.equals(prev,
+                                                      check_metadata=True):
+                    kind = KIND_DATA_BATCH
+                    payload = rb.serialize().to_pybytes()
+                else:
+                    state["schema"] = schema
+                    kind, payload = KIND_DATA, _stream_bytes(rb)
+            else:
+                kind, payload = encode_message(msg)
             sent_counter.inc(len(payload))
             frame_bytes.observe(len(payload))
-            t0 = _time.perf_counter()
-            async with self._out_locks[addr]:
-                _write_frame(writer, quad, kind, payload)
-                await writer.drain()
-            # lock wait + socket drain: the network half of backpressure
-            flush_latency.observe(_time.perf_counter() - t0)
+            # frames never interleave: _write_frame is one synchronous
+            # writer.write call, so no lock is needed for atomicity
+            _write_frame(writer, quad, kind, payload)
+            transport = writer.transport
+            if transport is not None:
+                high = transport.get_write_buffer_limits()[1]
+                if transport.get_write_buffer_size() >= high:
+                    t0 = _time.perf_counter()
+                    await writer.drain()
+                    # socket drain: the network half of backpressure
+                    flush_latency.observe(_time.perf_counter() - t0)
 
         return send
 

@@ -34,6 +34,10 @@ CHECKPOINT_DURATION = "arroyo_worker_checkpoint_duration_seconds"
 CHECKPOINT_BYTES = "arroyo_worker_checkpoint_bytes"
 FRAME_BYTES = "arroyo_worker_frame_bytes"
 FLUSH_LATENCY = "arroyo_worker_flush_seconds"
+# chaining/coalescing (PR 4): fused-task size per head operator, and the
+# number of record batches merged per coalesced flush at a task's input
+CHAIN_MEMBERS = "arroyo_chain_members"
+COALESCE_BATCHES = "arroyo_worker_coalesce_batches"
 
 LABELS = ("job_id", "operator_id", "subtask_idx", "operator_name")
 
@@ -57,6 +61,9 @@ _BUCKETS = {
     CHECKPOINT_BYTES: BYTES_BUCKETS,
     FRAME_BYTES: BYTES_BUCKETS,
     FLUSH_LATENCY: LATENCY_BUCKETS,
+    # batches-per-flush is a small count: 1 = passthrough (no merge)
+    COALESCE_BATCHES: (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0,
+                       32.0, 64.0),
 }
 
 # one registry per process (worker); the admin server renders it
@@ -162,6 +169,10 @@ class TaskMetrics:
         self.checkpoint_bytes = histogram_for_task(
             task_info, CHECKPOINT_BYTES,
             "bytes written per subtask checkpoint")
+        self.coalesce_batches = histogram_for_task(
+            task_info, COALESCE_BATCHES,
+            "record batches merged per coalesced flush at this task's "
+            "input (1 = passed through unmerged)")
 
 
 def render_metrics(registry: Optional[CollectorRegistry] = None) -> bytes:

@@ -88,8 +88,15 @@ def reset() -> None:
     _NOTES.clear()
 
 
-def counter_ns(key: str) -> int:
+def counter(key: str) -> int:
+    """Counter read (ns-valued keys like ``device_ns``, and plain counts
+    like ``kernel_dispatches`` — the number of device-kernel dispatches
+    made through :func:`timed_device`, which bench.py turns into
+    dispatches-per-event)."""
     return _COUNTERS.get(key, 0)
+
+
+counter_ns = counter  # legacy name for the ns-valued keys
 
 
 def note(key: str, value: Any) -> None:
@@ -109,6 +116,8 @@ def timed_device(call, *args):
     acc = _ACTIVE_TASK.get()
     if not blocking and acc is None:
         return call(*args)
+    _COUNTERS["kernel_dispatches"] = _COUNTERS.get(
+        "kernel_dispatches", 0) + 1
     t0 = time.perf_counter_ns()
     out = call(*args)
     if blocking:

@@ -485,7 +485,12 @@ class Planner:
             planned = Planned(
                 planned.stream.udf(rename, name=f"to_{ins.table}"),
                 planned.schema)
+        # single_file appends to ONE local path: parallel subtasks would
+        # open/truncate the same file over each other — pin to one
+        # subtask (across rescales too)
+        par = 1 if sink_table.connector == "single_file" else None
         planned.stream.sink(sink_table.connector, sink_table.config,
+                            parallelism=par, max_parallelism=par,
                             name=f"{ins.table}_sink")
 
     # -- FROM --------------------------------------------------------------

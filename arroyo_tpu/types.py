@@ -204,13 +204,21 @@ class Batch:
         return {k: v.dtype for k, v in self.columns.items()}
 
     # Arrow interop (used by parquet sinks / checkpoints / network IPC).
-    def to_arrow(self):
+    def arrow_arrays(self) -> Dict[str, Any]:
+        """Column name -> pyarrow array, the single home of the
+        numpy->arrow conversion rules (checkpoints and the wire encoder
+        must never diverge on them)."""
         import pyarrow as pa
 
         arrays = {"__timestamp": pa.array(self.timestamp, type=pa.int64())}
         for k, v in self.columns.items():
             arrays[k] = pa.array(v.tolist() if v.dtype == object else v)
-        return pa.table(arrays)
+        return arrays
+
+    def to_arrow(self):
+        import pyarrow as pa
+
+        return pa.table(self.arrow_arrays())
 
     @staticmethod
     def from_arrow(table) -> "Batch":

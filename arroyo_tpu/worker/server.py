@@ -281,7 +281,10 @@ class WorkerServer:
         if self.running is not None:
             await self.running.load_compacted(
                 req.get("operator_id", ""),
-                {"epoch": req.get("epoch"), "files": req.get("files", []),
+                # operator_id rides in the payload so a chained task can
+                # route the hot-swap to the right member
+                {"operator_id": req.get("operator_id", ""),
+                 "epoch": req.get("epoch"), "files": req.get("files", []),
                  "dropped": req.get("dropped", [])})
         return {}
 

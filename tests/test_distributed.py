@@ -86,6 +86,51 @@ def test_network_loopback(run_async):
     assert m2.watermark.time == 7
 
 
+def test_network_schema_written_once_per_edge(run_async):
+    """Encode fast path: after the first full frame per edge, record
+    frames are schema-less continuations decoded against the receiver's
+    cached schema; a schema change mid-stream re-sends a full frame."""
+
+    async def scenario():
+        nm_in = NetworkManager()
+        q: asyncio.Queue = asyncio.Queue()
+        quad = ("opA", 0, "opB", 0)
+        nm_in.register_in_edge(quad, q)
+        port = await nm_in.open_listener("127.0.0.1")
+
+        nm_out = NetworkManager()
+        await nm_out.connect(f"127.0.0.1:{port}")
+        send = nm_out.remote_sender(f"127.0.0.1:{port}", quad)
+
+        def mk(vals, keyed=True):
+            b = Batch(np.arange(len(vals), dtype=np.int64),
+                      {"v": np.asarray(vals, dtype=np.int64)})
+            return b.with_key(["v"]) if keyed else b
+
+        batches = [mk([1, 2, 3]), mk([4, 5]), mk([6])]
+        for b in batches:
+            await send(Message.record(b))
+        # schema change (no key hash column): full frame again, then a
+        # continuation under the NEW schema
+        changed = [mk([7, 8], keyed=False), mk([9], keyed=False)]
+        for b in changed:
+            await send(Message.record(b))
+        got = [await asyncio.wait_for(q.get(), 5) for _ in range(5)]
+        schema_cached = quad in nm_in._edge_schemas
+        await nm_out.close()
+        await nm_in.close()
+        return got, schema_cached
+
+    got, schema_cached = run_async(scenario())
+    assert schema_cached
+    assert [m.batch.columns["v"].tolist() for m in got] == [
+        [1, 2, 3], [4, 5], [6], [7, 8], [9]]
+    # key metadata survives the continuation path
+    assert got[1].batch.key_cols == ("v",)
+    assert got[1].batch.key_hash is not None
+    assert got[3].batch.key_hash is None and got[3].batch.key_cols == ()
+
+
 @pytest.mark.parametrize("n_workers", [1, 2])
 def test_cluster_pipeline(tmp_path, n_workers):
     """Submit a pipeline to a real controller; workers execute it across
@@ -131,9 +176,12 @@ def test_cluster_checkpoint_and_stop(tmp_path):
     ckpt_url = f"file://{tmp_path}/ckpt"
 
     def build():
+        # 3s of rate-limited runway: the stop-with-checkpoint below must
+        # land while the stream is still flowing, and warm compile
+        # caches make the pipeline reach full rate sooner
         return (
             Stream.source("impulse", {"event_rate": 20_000.0,
-                                      "message_count": 30_000,
+                                      "message_count": 60_000,
                                       "event_time_interval_micros": 1000,
                                       "batch_size": 256})
             .watermark(max_lateness_micros=0)
@@ -188,7 +236,7 @@ def test_cluster_checkpoint_and_stop(tmp_path):
 
     assert asyncio.run(run2()) == JobState.FINISHED
     rows = [json.loads(l) for l in open(out_path)]
-    assert sum(r["cnt"] for r in rows) == 30_000
+    assert sum(r["cnt"] for r in rows) == 60_000
 
 
 def test_live_rescale_exactly_once(tmp_path):

@@ -226,9 +226,13 @@ def test_join_with_expiration():
     assert int(out.columns["lv"][0]) == 100 and int(out.columns["rv"][0]) == 200
 
 
-def test_non_window_aggregate(rng):
+def test_non_window_aggregate(rng, monkeypatch):
     from arroyo_tpu.types import UPDATE_OP_COLUMN
 
+    # refinement granularity is per input batch: input coalescing would
+    # legitimately merge the two fragments into one create — disable it
+    # so this test keeps pinning the create-then-update sequence
+    monkeypatch.setenv("ARROYO_COALESCE", "0")
     ev1 = Batch(np.array([100, 200], dtype=np.int64),
                 {"k": np.array([1, 1], dtype=np.int64),
                  "v": np.array([10, 20], dtype=np.int64)})

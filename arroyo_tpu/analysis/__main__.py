@@ -42,6 +42,10 @@ def main(argv=None) -> int:
                          "the baseline file")
     ap.add_argument("--pass", dest="passes",
                     help="comma-separated pass ids to run")
+    ap.add_argument("--max-baseline", type=int, default=None,
+                    help="fail if the baseline file holds more than N "
+                         "accepted findings (the adoption ratchet: the "
+                         "baseline may only shrink)")
     ap.add_argument("--json", action="store_true",
                     help="emit findings as JSON")
     ap.add_argument("--all", action="store_true",
@@ -59,6 +63,17 @@ def main(argv=None) -> int:
         n = write_baseline(findings, args.baseline)
         print(f"arroyolint: wrote {n} finding(s) to {args.baseline}")
         return 0
+
+    if args.max_baseline is not None:
+        from .core import load_baseline
+
+        n = len(load_baseline(args.baseline))
+        if n > args.max_baseline:
+            print(f"arroyolint: baseline grew to {n} accepted "
+                  f"finding(s) (ratchet allows {args.max_baseline}) — "
+                  "fix new findings or waive them inline with a "
+                  "reason; the baseline must only shrink")
+            return 1
 
     gate = unwaived(findings)
     shown = findings if args.all else gate

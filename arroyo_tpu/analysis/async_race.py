@@ -1,0 +1,464 @@
+"""arroyosan static half 1: interprocedural await-point race detector.
+
+The bugs that have cost this repo the most were asyncio concurrency
+bugs, not kernel math — the PR 3 mid-rescale disable toggle that could
+strand a job in RESCALING was caught only by hand review.  This pass
+automates that review: it builds a per-class field-access model over
+the runtime packages and flags the two shapes that bite:
+
+**cross-task-race** — a ``self.<field>`` mutated from two or more
+*task entry points* (coroutines handed to ``asyncio.create_task`` /
+``ensure_future`` / ``gather`` / ``loop.create_task``) where at least
+one access sequence on the field *crosses an await* outside any
+``async with`` lock.  Two tasks interleave at every await point; a
+read-modify-write window spanning one is a lost-update/torn-state race
+exactly like a data race under threads.
+
+**cancel-window** — the PR 3 class: a task entry whose ``asyncio.Task``
+handle is stored on the instance and ``.cancel()``-ed elsewhere in the
+class, reaching (through un-``shield``-ed call edges) a method that
+writes a field before an await and touches it again after.
+Cancellation lands *at* the await, so the post-await access never runs
+and the field is stranded mid-update — unless the await is wrapped in
+``asyncio.shield`` (the call edge is then excluded), the post-await
+access sits in a ``finally`` (cancellation still runs it), or a lock
+serializes the window.
+
+Facts collected per method: (entry-point reachability, field
+read/write order, await points with shield/lock context, self-call
+edges).  Reachability is the transitive closure of ``self.<m>()`` call
+edges within the class; spawn sites anywhere in the scanned packages
+nominate entry points by method name (``ensure_future(runner.start())``
+marks every scoped class's async ``start`` as an entry).
+
+Scope: ``engine/``, ``controller/``, ``autoscale/``, ``worker/``,
+``network/`` — the asyncio runtime.  Ops/kernels are pure-ish batch
+functions with no task concurrency and stay out.
+
+False-positive escape: the standard inline waiver
+(``# arroyolint: disable=async-race -- reason``) on the flagged write.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, call_name
+
+PASS_ID = "async-race"
+
+_SCOPE_RE = re.compile(
+    r"(^|/)arroyo_tpu/(engine|controller|autoscale|worker|network)/"
+    r"[^/]+\.py$")
+
+_SPAWN_CALLS = {"asyncio.create_task", "asyncio.ensure_future",
+                "ensure_future", "create_task"}
+_LOCK_NAME_RE = re.compile(r"lock|mutex|sem", re.I)
+
+
+def in_scope(path: str) -> bool:
+    return bool(_SCOPE_RE.search(path.replace("\\", "/")))
+
+
+# -- per-method fact extraction ---------------------------------------------
+
+# ordered event kinds recorded while walking a method body:
+#   ('read'|'write', field, line, in_finally)
+#   ('await', shielded, locked, line)
+@dataclass
+class MethodFacts:
+    name: str
+    is_async: bool
+    lineno: int
+    events: List[tuple] = field(default_factory=list)
+    # self.<m>() call edges: (callee, shield-wrapped)
+    calls: List[Tuple[str, bool]] = field(default_factory=list)
+    # self-methods spawned as tasks from this method
+    spawns_self: Set[str] = field(default_factory=set)
+    # self.<f> fields assigned a spawn result: field -> entry method
+    task_fields: Dict[str, str] = field(default_factory=dict)
+    # self.<f>.cancel() targets
+    cancels: Set[str] = field(default_factory=set)
+
+    def fields_written(self) -> Set[str]:
+        return {e[1] for e in self.events if e[0] == "write"}
+
+    def fields_read(self) -> Set[str]:
+        return {e[1] for e in self.events if e[0] == "read"}
+
+
+def _spawned_methods(call: ast.Call) -> List[Tuple[bool, str]]:
+    """For ``create_task/ensure_future/gather`` spawn sites, every
+    coroutine-factory method being spawned: (receiver_is_self, name).
+    ``gather`` takes several coroutines — all are task entries."""
+    name = call_name(call)
+    base = name.split(".")[-1]
+    if base not in ("create_task", "ensure_future", "gather"):
+        return []
+    out: List[Tuple[bool, str]] = []
+    for arg in call.args:
+        if isinstance(arg, ast.Call) and isinstance(arg.func,
+                                                    ast.Attribute):
+            recv_self = (isinstance(arg.func.value, ast.Name)
+                         and arg.func.value.id == "self")
+            out.append((recv_self, arg.func.attr))
+    return out
+
+
+class _MethodScan(ast.NodeVisitor):
+    """One method body -> ordered access/await events + call edges.
+
+    Nested function defs are skipped (they are separate coroutines /
+    executor helpers); ``async with`` on a lock-ish context raises the
+    lock depth; ``asyncio.shield(...)`` marks both the await point and
+    the call edges under it."""
+
+    def __init__(self, facts: MethodFacts):
+        self.f = facts
+        self.lock_depth = 0
+        self.shield_depth = 0
+        self.finally_depth = 0
+
+    # -- structure ---------------------------------------------------------
+
+    def visit_FunctionDef(self, node):  # nested defs: separate coroutines
+        pass
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        pass
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        lockish = any(
+            _LOCK_NAME_RE.search(ast.unparse(item.context_expr))
+            for item in node.items)
+        for item in node.items:
+            self.visit(item.context_expr)
+        # __aenter__ suspends either way: a lock acquisition awaits
+        # *locked* (while held, no peer enters the same section); any
+        # other async context (streams, sessions) is a genuine await
+        # point that opens a race/cancellation window
+        self.f.events.append(
+            ("await", self.shield_depth > 0,
+             lockish or self.lock_depth > 0, node.lineno))
+        if lockish:
+            self.lock_depth += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if lockish:
+            self.lock_depth -= 1
+            # __aexit__ releases and suspends again, outside the lock
+            self.f.events.append(
+                ("await", self.shield_depth > 0, self.lock_depth > 0,
+                 node.lineno))
+
+    def visit_Try(self, node: ast.Try) -> None:
+        for stmt in node.body:
+            self.visit(stmt)
+        for handler in node.handlers:
+            self.visit(handler)
+        for stmt in node.orelse:
+            self.visit(stmt)
+        self.finally_depth += 1
+        for stmt in node.finalbody:
+            self.visit(stmt)
+        self.finally_depth -= 1
+
+    # -- events ------------------------------------------------------------
+
+    def visit_Await(self, node: ast.Await) -> None:
+        shielded = self.shield_depth > 0
+        inner = node.value
+        if isinstance(inner, ast.Call) \
+                and call_name(inner).endswith("shield"):
+            shielded = True
+            self.shield_depth += 1
+            self.generic_visit(node)
+            self.shield_depth -= 1
+        else:
+            self.generic_visit(node)
+        self.f.events.append(
+            ("await", shielded, self.lock_depth > 0, node.lineno))
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = call_name(node)
+        if name.endswith("shield") and name.split(".")[0] in (
+                "asyncio", "shield"):
+            self.shield_depth += 1
+            self.generic_visit(node)
+            self.shield_depth -= 1
+        else:
+            self.generic_visit(node)
+        # self.<m>() call edge
+        if isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self":
+            self.f.calls.append((node.func.attr, self.shield_depth > 0))
+        # self.<f>.cancel()
+        if isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "cancel" \
+                and isinstance(node.func.value, ast.Attribute) \
+                and isinstance(node.func.value.value, ast.Name) \
+                and node.func.value.value.id == "self":
+            self.f.cancels.add(node.func.value.attr)
+        for recv_self, meth in _spawned_methods(node):
+            if recv_self:
+                self.f.spawns_self.add(meth)
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # rhs first (reads happen before the store)
+        self.visit(node.value)
+        # task-handle fields: self.F = asyncio.ensure_future(self.M())
+        if isinstance(node.value, ast.Call):
+            spawned = [m for recv_self, m
+                       in _spawned_methods(node.value) if recv_self]
+            if spawned:
+                for tgt in node.targets:
+                    if self._self_field(tgt) is not None:
+                        self.f.task_fields[self._self_field(tgt)] = \
+                            spawned[0]
+        for tgt in node.targets:
+            self.visit(tgt)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        # self.x += y is a read-modify-write
+        fld = self._self_field(node.target)
+        if fld is not None:
+            self.f.events.append(("read", fld, node.lineno,
+                                  self.finally_depth > 0))
+        self.visit(node.value)
+        if fld is not None:
+            self.f.events.append(("write", fld, node.lineno,
+                                  self.finally_depth > 0))
+        else:
+            self.visit(node.target)
+
+    @staticmethod
+    def _self_field(node) -> Optional[str]:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr
+        return None
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        fld = self._self_field(node)
+        if fld is not None:
+            kind = ("write" if isinstance(node.ctx, (ast.Store, ast.Del))
+                    else "read")
+            self.f.events.append((kind, fld, node.lineno,
+                                  self.finally_depth > 0))
+        self.generic_visit(node)
+
+
+# -- per-class model --------------------------------------------------------
+
+
+@dataclass
+class ClassModel:
+    name: str
+    path: str
+    lineno: int
+    methods: Dict[str, MethodFacts] = field(default_factory=dict)
+    init_fields: Set[str] = field(default_factory=set)
+
+    def reachable(self, entry: str, unshielded_only: bool = False
+                  ) -> Set[str]:
+        """Methods reachable from ``entry`` via self-call edges
+        (optionally excluding edges wrapped in asyncio.shield)."""
+        seen: Set[str] = set()
+        stack = [entry]
+        while stack:
+            m = stack.pop()
+            if m in seen or m not in self.methods:
+                continue
+            seen.add(m)
+            for callee, shielded in self.methods[m].calls:
+                if unshielded_only and shielded:
+                    continue
+                stack.append(callee)
+        return seen
+
+    def cancelled_entries(self) -> Dict[str, str]:
+        """entry method -> cancelling method, for task-handle fields
+        that some method of this class ``.cancel()``s."""
+        fields_to_entry: Dict[str, str] = {}
+        for mf in self.methods.values():
+            fields_to_entry.update(mf.task_fields)
+        out: Dict[str, str] = {}
+        for mf in self.methods.values():
+            for fld in mf.cancels:
+                if fld in fields_to_entry:
+                    out[fields_to_entry[fld]] = mf.name
+        return out
+
+
+def _collect_classes(files: Dict[str, tuple]) -> List[ClassModel]:
+    models: List[ClassModel] = []
+    for path, (tree, _lines) in sorted(files.items()):
+        if not in_scope(path):
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cm = ClassModel(node.name, path, node.lineno)
+            for item in node.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                mf = MethodFacts(item.name,
+                                 isinstance(item, ast.AsyncFunctionDef),
+                                 item.lineno)
+                scan = _MethodScan(mf)
+                for stmt in item.body:
+                    scan.visit(stmt)
+                cm.methods[item.name] = mf
+                if item.name == "__init__":
+                    cm.init_fields |= mf.fields_written()
+            models.append(cm)
+    return models
+
+
+def _global_spawned_names(files: Dict[str, tuple]) -> Set[str]:
+    """Method names spawned as tasks anywhere in scope (the
+    cross-class half of entry-point discovery: the engine spawns
+    ``runner.start()``, a runner spawns ``pump.run()``)."""
+    names: Set[str] = set()
+    for path, (tree, _lines) in files.items():
+        if not in_scope(path):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                for _recv_self, meth in _spawned_methods(node):
+                    names.add(meth)
+    return names
+
+
+# -- the two race rules -----------------------------------------------------
+
+
+def _crossing_window(mf: MethodFacts, fld: str,
+                     need_unshielded: bool = False,
+                     write_before: bool = False) -> Optional[tuple]:
+    """An access sequence on ``fld`` that crosses an await point:
+    (pre_line, await_line, post_line, post_in_finally) or None.
+
+    ``need_unshielded`` restricts to awaits outside asyncio.shield
+    (cancellation analysis); locked awaits never open a window.
+    ``write_before`` requires the pre-await access to be a write (the
+    stranded-mid-update shape)."""
+    pre: Optional[tuple] = None
+    awaited: Optional[tuple] = None
+    for ev in mf.events:
+        if ev[0] == "await":
+            _, shielded, locked, line = ev
+            if locked or (need_unshielded and shielded):
+                continue
+            if pre is not None:
+                awaited = ev
+            continue
+        kind, f, line, in_finally = ev
+        if f != fld:
+            continue
+        if awaited is not None and pre is not None:
+            return (pre[2], awaited[3], line, in_finally)
+        if kind == "write" or not write_before:
+            pre = ev
+    return None
+
+
+def _field_has_write(model: ClassModel, methods: Set[str],
+                     fld: str) -> Optional[tuple]:
+    for m in methods:
+        mf = model.methods.get(m)
+        if mf is None:
+            continue
+        for ev in mf.events:
+            if ev[0] == "write" and ev[1] == fld:
+                return (m, ev[2])
+    return None
+
+
+def check_project(files: Dict[str, tuple]) -> List[Finding]:
+    findings: List[Finding] = []
+    global_spawns = _global_spawned_names(files)
+    for model in _collect_classes(files):
+        entries = sorted(
+            m for m, mf in model.methods.items()
+            if mf.is_async and m != "__init__"
+            and (m in global_spawns
+                 or any(m in other.spawns_self
+                        for other in model.methods.values())))
+        if not entries:
+            continue
+        reach = {e: model.reachable(e) for e in entries}
+
+        # rule 1: cross-task field race
+        for fld in sorted({f for mf in model.methods.values()
+                           for f in mf.fields_written()}):
+            writers = [e for e in entries
+                       if _field_has_write(model, reach[e], fld)]
+            if len(writers) < 2:
+                continue
+            # a window crossing an await in any involved entry makes the
+            # interleaving observable; all-locked access sets are safe
+            window = None
+            for e in writers:
+                for m in reach[e]:
+                    mf = model.methods.get(m)
+                    if mf is None:
+                        continue
+                    w = _crossing_window(mf, fld)
+                    if w is not None:
+                        window = (m, w)
+                        break
+                if window:
+                    break
+            if window is None:
+                continue
+            meth, (pre, aw, post, _fin) = window
+            wm, wline = _field_has_write(model, reach[writers[0]], fld)
+            findings.append(Finding(
+                PASS_ID, "cross-task-race", model.path, wline,
+                f"{model.name}.{fld} is mutated from {len(writers)} task "
+                f"entry points ({', '.join(writers)}) and "
+                f"{model.name}.{meth}() holds an access window across an "
+                f"await (lines {pre}->{aw}->{post}) with no asyncio.Lock "
+                "— concurrent tasks interleave at every await point"))
+
+        # rule 2: cancellation strands an await-crossing mutation
+        for entry, canceller in sorted(model.cancelled_entries().items()):
+            for m in sorted(model.reachable(entry,
+                                            unshielded_only=True)):
+                mf = model.methods.get(m)
+                if mf is None:
+                    continue
+                for fld in sorted(mf.fields_written()):
+                    w = _crossing_window(mf, fld, need_unshielded=True,
+                                         write_before=True)
+                    if w is None:
+                        continue
+                    pre, aw, post, post_in_finally = w
+                    if post_in_finally:
+                        continue  # cancellation still runs finally
+                    findings.append(Finding(
+                        PASS_ID, "cancel-window", model.path, pre,
+                        f"{model.name}.{m}() (task entry "
+                        f"{model.name}.{entry}, cancelled by "
+                        f"{canceller}()) writes self.{fld} before the "
+                        f"await at line {aw} and touches it at line "
+                        f"{post}; cancellation lands at the await and "
+                        f"strands self.{fld} mid-update — wrap the "
+                        "await in asyncio.shield or move the recovery "
+                        "into a finally"))
+    return findings
+
+
+def check(tree: ast.AST, lines: Sequence[str], path: str
+          ) -> List[Finding]:
+    """Single-file convenience wrapper (tests, ad-hoc runs)."""
+    return check_project({path: (tree, list(lines))})

@@ -1,9 +1,13 @@
 """arroyolint core: finding model, waivers, baseline, and the runner.
 
-A *pass* is a module exposing ``PASS_ID`` and either
+A *pass* is a module exposing ``PASS_ID`` and one of
 
 - ``check(tree, lines, path) -> List[Finding]`` — an AST pass run per
-  file, or
+  file,
+- ``check_project(files) -> List[Finding]`` — an interprocedural pass
+  run once over every parsed file (``files`` maps path -> (tree,
+  lines)); its findings are file-anchored, so inline waivers and the
+  baseline apply exactly as for AST passes (async-race), or
 - ``check_repo(root) -> List[Finding]`` — a repo-level pass run once
   (e.g. proto drift).
 
@@ -208,10 +212,18 @@ def _ast_passes():
         async_blocking,
         checkpoint_arity,
         host_sync,
+        protocol,
         trace_purity,
     )
 
-    return [checkpoint_arity, async_blocking, host_sync, trace_purity]
+    return [checkpoint_arity, async_blocking, host_sync, trace_purity,
+            protocol]
+
+
+def _project_passes():
+    from . import async_race
+
+    return [async_race]
 
 
 def _repo_passes():
@@ -229,6 +241,8 @@ def run_analysis(paths: Optional[Sequence[str]] = None,
     paths = list(paths) if paths else [PKG_ROOT]
     findings: List[Finding] = []
     lines_by_path: Dict[str, Sequence[str]] = {}
+    trees_by_path: Dict[str, ast.AST] = {}
+    waivers_by_path: Dict[str, Dict[int, Waiver]] = {}
     for path in _iter_py_files(paths):
         try:
             with open(path, encoding="utf-8") as fh:
@@ -241,7 +255,9 @@ def run_analysis(paths: Optional[Sequence[str]] = None,
             continue
         lines = src.splitlines()
         lines_by_path[path] = lines
+        trees_by_path[path] = tree
         waivers, problems = parse_waivers(lines, path)
+        waivers_by_path[path] = waivers
         file_findings: List[Finding] = list(problems)
         for mod in _ast_passes():
             if passes and mod.PASS_ID not in passes:
@@ -249,6 +265,17 @@ def run_analysis(paths: Optional[Sequence[str]] = None,
             file_findings.extend(mod.check(tree, lines, path))
         apply_waivers(file_findings, waivers)
         findings.extend(file_findings)
+    # interprocedural passes see every parsed file at once; their
+    # findings are file-anchored, so per-file waivers apply the same way
+    for mod in _project_passes():
+        if passes and mod.PASS_ID not in passes:
+            continue
+        proj = mod.check_project(
+            {p: (trees_by_path[p], lines_by_path[p])
+             for p in trees_by_path})
+        for f in proj:
+            apply_waivers([f], waivers_by_path.get(f.path, {}))
+        findings.extend(proj)
     for mod in _repo_passes():
         if passes and mod.PASS_ID not in passes:
             continue

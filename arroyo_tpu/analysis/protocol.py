@@ -1,0 +1,164 @@
+"""arroyosan static half 2: barrier/watermark protocol checker.
+
+The streaming runtime's control-event contract (the state machine the
+runtime sanitizer asserts dynamically):
+
+    BUFFERED --flush--> FLUSHED --handle/forward--> (next message)
+
+A handler that buffers record fragments (the input coalescer, a chain
+buffer — anything with ``.flush_all()`` / ``.pending``) must drain that
+buffer **before** handling or forwarding a Watermark, Barrier or
+Stop/EndOfData: a buffered batch that is reordered past a watermark can
+make a window fire without it, past a barrier it lands in the wrong
+epoch, past end-of-stream it is silently dropped.  PR 4's coalescer
+pinned this ordering with tests; this pass pins it structurally so a
+refactor of the task loop can't quietly reorder the flush.
+
+Model: inside any function that manages a flushable buffer, find the
+branches dispatching on a control-message kind
+(``msg.kind == MessageKind.WATERMARK`` / ``BARRIER`` / ``STOP`` /
+``END_OF_DATA`` / ``msg.is_end``) and walk each branch's statements in
+order with the BUFFERED→FLUSHED state machine: reaching a
+control-handling call (``observe_watermark``, ``run_checkpoint``,
+``counter.observe``, ``mark_closed``, ``handle_watermark``,
+``broadcast``) while no flush has appeared earlier in the branch is a
+finding.
+
+Scope: ``engine/*.py`` — the task loop, chained execution and the
+coalescer live there.  The flush itself is usually conditional
+(``if coal.pending: ... flush_all()``); any statement *containing* a
+flush call counts as the flush step, since the guard is exactly
+"pending implies flush".
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Sequence, Set
+
+from .core import Finding, call_name
+
+PASS_ID = "protocol"
+
+_SCOPE_RE = re.compile(r"(^|/)engine/[^/]+\.py$")
+
+_FLUSH_ATTRS = {"flush_all"}
+_BUFFER_ATTRS = {"flush_all", "pending"}
+
+# calls that consume/forward the control event — reaching one of these
+# while buffered data may still sit in the coalescer breaks ordering
+_HANDLE_ATTRS = {
+    "observe_watermark",  # watermark advancement
+    "run_checkpoint",  # barrier -> snapshot
+    "observe",  # CheckpointCounter.observe (alignment bookkeeping)
+    "mark_closed",  # end-of-input alignment re-check
+    "handle_watermark",
+    "_advance_watermark",
+    "broadcast",  # forwarding control downstream
+}
+
+_CONTROL_KINDS = {"WATERMARK", "BARRIER", "STOP", "END_OF_DATA"}
+
+
+def in_scope(path: str) -> bool:
+    return bool(_SCOPE_RE.search(path.replace("\\", "/")))
+
+
+def _control_kind_of(test: ast.expr) -> Optional[str]:
+    """'watermark'/'barrier'/'end' when ``test`` dispatches on a control
+    message kind, else None."""
+    for node in ast.walk(test):
+        if isinstance(node, ast.Attribute):
+            if node.attr == "is_end":
+                return "end"
+            if node.attr in _CONTROL_KINDS and isinstance(
+                    node.value, ast.Name) \
+                    and node.value.id == "MessageKind":
+                return node.attr.lower()
+    return None
+
+
+def _contains_attr_call(node: ast.AST, attrs: Set[str]) -> Optional[ast.Call]:
+    """First ``<x>.<attr>()`` call under ``node``, not descending into
+    nested function defs (separate scopes, scanned on their own)."""
+    stack: List[ast.AST] = [node]
+    while stack:
+        sub = stack.pop()
+        if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)) and sub is not node:
+            continue
+        if isinstance(sub, ast.Call) \
+                and isinstance(sub.func, ast.Attribute) \
+                and sub.func.attr in attrs:
+            return sub
+        stack.extend(ast.iter_child_nodes(sub))
+    return None
+
+
+def _own_nodes(fn) -> List[ast.AST]:
+    """Nodes belonging to ``fn``'s own body — nested function defs are
+    separate scopes (they get their own _FnScan) and must not be
+    evaluated against the enclosing function's flush state machine."""
+    out: List[ast.AST] = []
+    stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        out.append(node)
+        stack.extend(ast.iter_child_nodes(node))
+    return out
+
+
+class _FnScan:
+    def __init__(self, path: str, fn) -> None:
+        self.path = path
+        self.fn = fn
+        self.findings: List[Finding] = []
+
+    def run(self) -> List[Finding]:
+        own = _own_nodes(self.fn)
+        # only functions that actually manage a flushable buffer (in
+        # their OWN body) are bound by the ordering contract
+        if not any(isinstance(n, ast.Call)
+                   and isinstance(n.func, ast.Attribute)
+                   and n.func.attr in _FLUSH_ATTRS for n in own):
+            return []
+        for node in own:
+            if isinstance(node, ast.If):
+                kind = _control_kind_of(node.test)
+                if kind is not None:
+                    self._check_branch(kind, node)
+        return self.findings
+
+    def _check_branch(self, kind: str, branch: ast.If) -> None:
+        """BUFFERED -> FLUSHED state machine over the branch body."""
+        flushed = False
+        for stmt in branch.body:
+            if _contains_attr_call(stmt, _FLUSH_ATTRS) is not None:
+                flushed = True
+                continue
+            handle = _contains_attr_call(stmt, _HANDLE_ATTRS)
+            if handle is not None and not flushed:
+                self.findings.append(Finding(
+                    PASS_ID, "control-before-flush", self.path,
+                    handle.lineno,
+                    f"{self.fn.name}(): {kind} handled via "
+                    f".{handle.func.attr}() before the buffered records "
+                    "were flushed — a fragment still in the coalescer "
+                    f"would be reordered past the {kind} "
+                    "(flush-before-control ordering)"))
+                return  # one finding per branch is enough signal
+
+
+def check(tree: ast.AST, lines: Sequence[str], path: str,
+          force: bool = False) -> List[Finding]:
+    if not force and not in_scope(path):
+        return []
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            findings.extend(_FnScan(path, node).run())
+    return findings

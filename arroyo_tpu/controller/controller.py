@@ -115,6 +115,12 @@ class ControllerServer:
         self.scheduler = scheduler
         self.host = host
         self.rpc = RpcServer()
+        # arroyosan: the controller-side half of checkpoint-completeness
+        # (workers check their own runners; only the controller sees the
+        # whole job).  None unless ARROYO_SANITIZE is armed.
+        from ..analysis.sanitizer import maybe_sanitizer
+
+        self.sanitizer = maybe_sanitizer("controller")
         self.jobs: Dict[str, Job] = {}
         # per-job autoscalers (arroyo_tpu/autoscale): one per accepted
         # job so the decision ledger + REST surface always exist; the
@@ -828,6 +834,19 @@ class ControllerServer:
         if tracker is None:
             tracker = job.trackers.setdefault(
                 req["epoch"], CheckpointTracker(req["epoch"], job.n_subtasks))
+        san = getattr(self, "sanitizer", None)  # doubles skip __init__
+        if san is not None:
+            key = (req["operator_id"], req["subtask"])
+            san.event("ckpt-done", f"{key[0]}-{key[1]}",
+                      {"epoch": req["epoch"], "via": "controller"})
+            if key in tracker.completed:
+                # trackers are cleared on restart/rescale, so a
+                # duplicate inside one tracker's life means two
+                # snapshots raced for the same (member, subtask, epoch)
+                san.violation(
+                    "duplicate-checkpoint",
+                    f"{key[0]}-{key[1]} reported checkpoint epoch "
+                    f"{req['epoch']} twice within one job run")
         tracker.completed.add((req["operator_id"], req["subtask"]))
         tracker.has_committing |= bool(req.get("has_committing_data"))
         if tracker.done:

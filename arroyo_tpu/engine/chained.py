@@ -230,6 +230,12 @@ class ChainedOperator(Operator):
 
     async def _feed(self, start: int, batch: Batch, side: int = 0) -> None:
         step_op, idxs, ectx_idx = self._step_by_start[start]
+        if self.sanitizer is not None and start > 0:
+            # interior chain edges keep the same per-edge schema
+            # stability contract as real queues (the head edge is
+            # checked by the runner)
+            self.sanitizer.on_record(
+                (self.infos[start].task_id, "chain"), batch)
         n = len(batch)
         ts = int(np.max(batch.timestamp)) if n else 0
         now = now_micros()
@@ -296,6 +302,9 @@ class ChainedOperator(Operator):
         observe, fire that member's timers, then its handle_watermark
         (whose default broadcast continues down the chain)."""
         mctx = self.ctxs[i]
+        if self.sanitizer is not None:
+            self.sanitizer.on_watermark((self.infos[i].task_id, "chain"),
+                                        wm)
         advanced = mctx.observe_watermark(0, wm)
         if advanced is not None:
             if (mctx.metrics is not None

@@ -109,6 +109,7 @@ class Engine:
         self.worker_data_addrs = worker_data_addrs or {}
         self.network = network
         self.control_resp: asyncio.Queue = asyncio.Queue()
+        self.sanitizer: Optional[Any] = None  # set by start()
         self.subtasks: Dict[Tuple[str, int], SubtaskHandle] = {}
         self.resps: List[ControlResp] = []  # responses drained so far
 
@@ -138,6 +139,13 @@ class Engine:
     def start(self) -> "RunningEngine":
         """Build the physical graph and spawn all subtask loops."""
         _enable_compile_cache()
+        # arroyosan runtime sanitizer: one instance per engine run (so a
+        # rescale restore starts from fresh invariant state); None unless
+        # ARROYO_SANITIZE armed it — the hook sites then cost nothing
+        from ..analysis.sanitizer import maybe_sanitizer
+
+        sanitizer = maybe_sanitizer(self.job_id)
+        self.sanitizer = sanitizer
         g = self.program.graph
         # operator chaining (graph/chaining.py): maximal linear runs of
         # same-parallelism forward-edge operators execute inside ONE
@@ -240,6 +248,8 @@ class Engine:
             metrics_list = [TaskMetrics(ti) for ti in infos]
             stores = [StateStore(ti, self.backend, self.restore_epoch)
                       for ti in infos]
+            for st in stores:
+                st.sanitizer = sanitizer
             collector = Collector(edge_groups, metrics_list[-1])
             if len(ms) == 1:
                 operator = build_operator(head_node.operator)
@@ -274,7 +284,8 @@ class Engine:
                            "operators fused into this task").set(len(ms))
             control_rx: asyncio.Queue = asyncio.Queue()
             runner = TaskRunner(infos[0], operator, ctx, inputs,
-                                control_rx, self.control_resp)
+                                control_rx, self.control_resp,
+                                sanitizer=sanitizer)
             ctx._runner = runner  # sources poll control via the runner
             self.subtasks[(head_id, idx)] = SubtaskHandle(
                 infos[0], runner, control_rx,

@@ -38,6 +38,11 @@ class Operator:
     # double-counting.
     own_batch_metrics = False
 
+    # arroyosan runtime sanitizer (analysis/sanitizer.py); the
+    # TaskRunner installs the engine's instance here, None when
+    # ARROYO_SANITIZE is off — hook sites guard on `is not None`
+    sanitizer: Optional[Any] = None
+
     def __init__(self, name: str):
         self.name = name
 

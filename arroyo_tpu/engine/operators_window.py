@@ -29,7 +29,7 @@ Maps the reference's window operator suite onto batched device kernels:
 
 from __future__ import annotations
 
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import asyncio
 
@@ -285,9 +285,9 @@ def _apply_top_n(batch: Batch, partition_cols: Tuple[str, ...],
 
             keep = segment_top_k(part, sort_val, max_elements)
         else:
-            order = np.lexsort((-np.asarray(sort_val, dtype=np.float64),
+            order = np.lexsort((-np.asarray(sort_val, dtype=np.float64),  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
                                 part))
-            part_sorted = np.asarray(part)[order]
+            part_sorted = np.asarray(part)[order]  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
             is_start = np.ones(len(order), dtype=bool)
             is_start[1:] = part_sorted[1:] != part_sorted[:-1]
             seg_id = np.cumsum(is_start) - 1
@@ -298,12 +298,12 @@ def _apply_top_n(batch: Batch, partition_cols: Tuple[str, ...],
         batch = batch.select(keep)
         if rank_column is None:
             return batch
-        part = np.asarray(part)[keep]
+        part = np.asarray(part)[keep]  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
         sort_val = batch.columns[sort_column]
     if rank_column is None:
         return batch
-    order = np.lexsort((-np.asarray(sort_val, dtype=np.float64), part))
-    part_sorted = np.asarray(part)[order]
+    order = np.lexsort((-np.asarray(sort_val, dtype=np.float64), part))  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
+    part_sorted = np.asarray(part)[order]  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
     is_start = np.ones(len(order), dtype=bool)
     is_start[1:] = part_sorted[1:] != part_sorted[:-1]
     seg_start = is_start.nonzero()[0]
@@ -564,9 +564,9 @@ class SessionWindowOperator(Operator):
         # it — valid iff that session shares the row's key and the row
         # precedes its end.  No per-key python, no buffer argsort.
         m = len(fires)
-        fk = np.array([k for k, _, _ in fires], dtype=np.uint64)
-        fs = np.array([s for _, s, _ in fires], dtype=np.int64)
-        fe = np.array([e for _, _, e in fires], dtype=np.int64)
+        fk = np.array([k for k, _, _ in fires], dtype=np.uint64)  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
+        fs = np.array([s for _, s, _ in fires], dtype=np.int64)  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
+        fe = np.array([e for _, _, e in fires], dtype=np.int64)  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
         fo = np.lexsort((fs, fk))
         fk, fs, fe = fk[fo], fs[fo], fe[fo]
         n = len(rows)
@@ -714,6 +714,62 @@ def _join_name_maps(l_names, r_names, l_prefix: str = "",
         rmap[c] = name
         taken.add(name)
     return lmap, rmap
+
+
+def _internal_join_col(name: str) -> bool:
+    """Planner-internal join key columns: ``__jk<i>`` + ``__jknonce``."""
+    return name.startswith("__jk")
+
+
+def _stable_join_part(left_cols: Dict[str, np.ndarray],
+                      right_cols: Dict[str, np.ndarray], n: int,
+                      key_names: Sequence[str],
+                      l_prefix: str = "", r_prefix: str = ""
+                      ) -> Dict[str, np.ndarray]:
+    """One joined-output column layout per join, regardless of which
+    side a row came from or whether a side is a null pad (arroyosan's
+    schema-stability invariant surfaced that matched pairs carried the
+    buffered batch's internal ``__jk*`` columns through the ``r_``
+    mapping while spec-template pads did not — the edge layout then
+    flipped with arrival order, forcing a coalescer flush and a full
+    data-plane frame on every flip).
+
+    The rule: the right role never carries internal join-key columns
+    (duplicates for matched rows, meaningless nulls for pads); the left
+    role always carries them — filled when the left role is itself a
+    pad — in the planner's layout (keys first, ``__jknonce`` last).
+    Pad fills for the key columns use same-dtype zeros (witnessed from
+    the right role's dropped internals) so the key dtype never flips
+    between emission paths: an f64 NaN fill would flip the Arrow edge
+    schema per path and concat-promote u64 keys past 2^53."""
+    witness = {c: v for c, v in right_cols.items()
+               if _internal_join_col(c)}
+    right_cols = {c: v for c, v in right_cols.items()
+                  if not _internal_join_col(c)}
+
+    def _key_fill(c: str) -> np.ndarray:
+        w = witness.get(c)
+        if w is not None:
+            return np.zeros(n, dtype=w.dtype)
+        return _null_column(n)
+
+    ordered: Dict[str, Optional[np.ndarray]] = {}
+    for c in key_names:
+        if c != "__jknonce":
+            ordered[c] = left_cols.get(c)
+    for c, v in left_cols.items():
+        if c not in ordered and c != "__jknonce":
+            ordered[c] = v
+    if "__jknonce" in key_names:
+        ordered["__jknonce"] = left_cols.get("__jknonce")
+    cols = {c: (v if v is not None else _key_fill(c))
+            for c, v in ordered.items()}
+    lmap, rmap = _join_name_maps(list(cols), list(right_cols),
+                                 l_prefix, r_prefix)
+    out = {lmap[c]: v for c, v in cols.items()}
+    for c, v in right_cols.items():
+        out[rmap[c]] = v
+    return out
 
 
 class _SideTemplate:
@@ -892,8 +948,8 @@ class WindowArgmaxOperator(Operator):
         running extremum drop (the extremum only tightens, so a
         dominated row can never tie the final answer; ties at the
         current extremum must stay).  Returns the batch to buffer."""
-        ends = np.asarray(batch.columns["window_end"], dtype=np.int64)
-        vals = np.asarray(batch.columns[self.value_col])
+        ends = np.asarray(batch.columns["window_end"], dtype=np.int64)  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
+        vals = np.asarray(batch.columns[self.value_col])  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
         keep = (~np.isnan(vals) if vals.dtype.kind == "f"
                 else np.ones(len(vals), dtype=bool))
         # lateness keys off the operator's CURRENT input watermark: any
@@ -953,7 +1009,7 @@ class WindowArgmaxOperator(Operator):
         # one timer per distinct window end; aggregate rows stamp
         # timestamp = window_end - 1 (operator _emit convention)
         for e in np.unique(
-                np.asarray(batch.columns["window_end"],
+                np.asarray(batch.columns["window_end"],  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
                            dtype=np.int64)).tolist():
             ctx.timers.schedule(int(e), ("am", int(e)))
 
@@ -967,7 +1023,7 @@ class WindowArgmaxOperator(Operator):
                              else max(self._released_wm, end))
         if rows is None or not len(rows):
             return
-        vals = np.asarray(rows.columns[self.value_col])
+        vals = np.asarray(rows.columns[self.value_col])  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
         # SQL NULL values (NaN — e.g. SUM over an all-null pane) never
         # equal the max in the join this operator replaces; a plain
         # vals.max() would let one NaN poison the extremum and drop the
@@ -1032,26 +1088,24 @@ def join_batches(l: Batch, r: Batch, end: int,
 
     l_rows = l.select(lo[lidx])
     r_rows = r.select(ro[ridx])
-    lmap, rmap = _join_name_maps(l_rows.columns, r_rows.columns,
-                                 l_prefix, r_prefix)
+    key_names = tuple(l.key_cols)
 
+    # every part goes through the same layout normalization so matched,
+    # left-padded and right-padded rows of one join share ONE column
+    # layout (and so do successive fires on the same edge)
     parts: List[Tuple[Dict[str, np.ndarray], np.ndarray]] = []  # (cols, kh)
-    matched_cols: Dict[str, np.ndarray] = {}
-    for c, v in l_rows.columns.items():
-        matched_cols[lmap[c]] = v
-    for c, v in r_rows.columns.items():
-        matched_cols[rmap[c]] = v
-    parts.append((matched_cols, l_rows.key_hash))
+    parts.append((_stable_join_part(
+        dict(l_rows.columns), dict(r_rows.columns), len(l_rows),
+        key_names, l_prefix, r_prefix), l_rows.key_hash))
 
     if how in (JoinType.LEFT, JoinType.FULL) and (counts == 0).any():
         un = l.select(lo[counts == 0])
         pad = ((tmpl[1].null_cols(len(un))) if tmpl is not None
                else {c: _null_column(len(un), like=v)
                      for c, v in r.columns.items()})
-        cols = {lmap[c]: v for c, v in un.columns.items()}
-        for c, v in pad.items():
-            cols[rmap.get(c, c)] = v
-        parts.append((cols, un.key_hash))
+        parts.append((_stable_join_part(
+            dict(un.columns), pad, len(un), key_names,
+            l_prefix, r_prefix), un.key_hash))
     if how in (JoinType.RIGHT, JoinType.FULL):
         r_matched = np.zeros(len(r.key_hash), dtype=bool)
         if len(ridx):
@@ -1061,10 +1115,9 @@ def join_batches(l: Batch, r: Batch, end: int,
             pad = ((tmpl[0].null_cols(len(un))) if tmpl is not None
                    else {c: _null_column(len(un), like=v)
                          for c, v in l.columns.items()})
-            cols = {lmap.get(c, c): v for c, v in pad.items()}
-            for c, v in un.columns.items():
-                cols[rmap[c]] = v
-            parts.append((cols, un.key_hash))
+            parts.append((_stable_join_part(
+                pad, dict(un.columns), len(un), key_names,
+                l_prefix, r_prefix), un.key_hash))
 
     if len(parts) == 1:
         cols, kh = parts[0]
@@ -1112,22 +1165,22 @@ class JoinWithExpirationOperator(Operator):
                 side: int, end: int, op: Optional[int],
                 kh: Optional[np.ndarray] = None) -> Batch:
         """Build an output batch from rows of MY side joined against
-        already-named opposite-side columns, in left-right orientation."""
-        my_tmpl_names = list(mine_rows.columns)
-        opp_names = list(opp_cols)
+        already-named opposite-side columns, in left-right orientation.
+        All four emission paths (matched, padded, retraction, either
+        arrival side) route through ``_stable_join_part`` so the edge
+        carries one column layout for the life of the join."""
+        n = len(mine_rows)
+        key_names = tuple(mine_rows.key_cols)
         if side == 0:
-            lmap, rmap = _join_name_maps(my_tmpl_names, opp_names)
-            cols = {lmap[c]: v for c, v in mine_rows.columns.items()}
-            for c, v in opp_cols.items():
-                cols[rmap[c]] = v
+            cols = _stable_join_part(dict(mine_rows.columns),
+                                     dict(opp_cols), n, key_names)
         else:
-            lmap, rmap = _join_name_maps(opp_names, my_tmpl_names)
-            cols = {lmap[c]: v for c, v in opp_cols.items()}
-            for c, v in mine_rows.columns.items():
-                cols[rmap[c]] = v
+            cols = _stable_join_part(dict(opp_cols),
+                                     dict(mine_rows.columns), n,
+                                     key_names)
         if op is not None:
-            cols[UPDATE_OP_COLUMN] = np.full(len(mine_rows), op, np.int8)
-        ts = np.full(len(mine_rows), end - 1, dtype=np.int64)
+            cols[UPDATE_OP_COLUMN] = np.full(n, op, np.int8)
+        ts = np.full(n, end - 1, dtype=np.int64)
         return Batch(ts, cols,
                      mine_rows.key_hash if kh is None else kh,
                      mine_rows.key_cols)
@@ -1236,7 +1289,7 @@ class SemiJoinOperator(Operator):
 
     def _right_has(self, kh: np.ndarray) -> np.ndarray:
         uniq = np.unique(kh)
-        known = np.array([self.rkeys.get(int(k)) is not None
+        known = np.array([self.rkeys.get(int(k)) is not None  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
                           for k in uniq])
         return known[np.searchsorted(uniq, kh)]
 
@@ -1253,7 +1306,7 @@ class SemiJoinOperator(Operator):
         # must not expire off its FIRST sighting; a LATE re-sighting must
         # not move it backward); first sightings release waiting left rows
         uniq, first = np.unique(batch.key_hash, return_index=True)
-        fresh = np.array([self.rkeys.get(int(k)) is None for k in uniq])
+        fresh = np.array([self.rkeys.get(int(k)) is None for k in uniq])  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
         for k, i in zip(uniq.tolist(), first.tolist()):
             prev_t = self.rkeys.get_time(int(k))
             t = int(batch.timestamp[i])
@@ -1377,7 +1430,7 @@ class NonWindowAggOperator(Operator):
             return  # emission happens at watermark passage
         cols = dict(key_cols)
         for a in self.aggs:
-            arr = np.asarray(out_cols[a.output])
+            arr = np.asarray(out_cols[a.output])  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
             if a.kind == AggKind.COUNT:
                 arr = arr.astype(np.int64)
             cols[a.output] = arr
@@ -1419,15 +1472,15 @@ class NonWindowAggOperator(Operator):
                              else max(self._released_wm, watermark))
         if not ready:
             return
-        ts = np.array([t for t, _, _ in ready], dtype=np.int64)
-        kh = np.array([k for _, k, _ in ready], dtype=np.uint64)
+        ts = np.array([t for t, _, _ in ready], dtype=np.int64)  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
+        kh = np.array([k for _, k, _ in ready], dtype=np.uint64)  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
         kc_names = [n[len("__kc::"):] for n in ready[0][2]
                     if n.startswith("__kc::")]
         cols: Dict[str, np.ndarray] = {}
         for c in kc_names:
-            cols[c] = np.asarray([rec[f"__kc::{c}"] for _, _, rec in ready])
+            cols[c] = np.asarray([rec[f"__kc::{c}"] for _, _, rec in ready])  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
         for a in self.aggs:
-            arr = np.asarray([rec[a.output] for _, _, rec in ready])
+            arr = np.asarray([rec[a.output] for _, _, rec in ready])  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
             if a.kind == AggKind.COUNT:
                 arr = arr.astype(np.int64)
             cols[a.output] = arr

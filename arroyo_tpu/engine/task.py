@@ -78,9 +78,15 @@ class TaskRunner:
         inputs: List[Tuple[int, asyncio.Queue]],  # (side, queue)
         control_rx: asyncio.Queue,  # ControlMessage from worker
         control_tx: Optional[asyncio.Queue] = None,  # ControlResp to worker
+        sanitizer: Optional[Any] = None,  # arroyosan runtime hooks
     ):
         self.task_info = task_info
         self.operator = operator
+        # arroyosan (analysis/sanitizer.py): None unless ARROYO_SANITIZE
+        # armed it at engine build — every hook site below guards on a
+        # local `is not None`, so the disabled path costs nothing
+        self.sanitizer = sanitizer
+        operator.sanitizer = sanitizer
         self.ctx = ctx
         # a ChainedOperator's runner ctx is the HEAD member's (input
         # alignment, timers); downstream broadcasts (barriers, stop/eod,
@@ -201,6 +207,8 @@ class TaskRunner:
         get_control: Optional[asyncio.Future] = None
         metrics = self.ctx.metrics
         coal = self._make_coalescer()
+        san = self.sanitizer
+        tid = self.task_info.task_id
         try:
             while ended < n_inputs:
                 if get_merged is None or get_merged.done():
@@ -245,6 +253,10 @@ class TaskRunner:
                 idx, side, msg = get_merged.result()
 
                 if msg.kind == MessageKind.RECORD:
+                    if san is not None:
+                        san.on_record((tid, idx), msg.batch)
+                        san.on_record_during_alignment(tid, idx,
+                                                       self.ctx.counter)
                     if metrics is not None:
                         metrics.messages_recv.inc(len(msg.batch))
                     if coal is not None:
@@ -259,6 +271,9 @@ class TaskRunner:
                     if coal is not None and coal.pending:
                         for cside, cbatch in coal.flush_all():
                             await self._process_record(cbatch, cside)
+                    if san is not None:
+                        san.before_control(tid, "watermark", coal)
+                        san.on_watermark((tid, idx), msg.watermark)
                     advanced = self.ctx.observe_watermark(idx, msg.watermark)
                     if advanced is not None:
                         await self._advance_watermark(advanced)
@@ -273,6 +288,9 @@ class TaskRunner:
                         for cside, cbatch in coal.flush_all():
                             await self._process_record(cbatch, cside)
                     b = msg.barrier
+                    if san is not None:
+                        san.before_control(tid, "barrier", coal)
+                        san.on_barrier(tid, idx, b.epoch)
                     pending_barriers[b.epoch] = b
                     self._align_start.setdefault(b.epoch, tracing.now_us())
                     await self._report_event(b, CheckpointEventType.STARTED_ALIGNMENT)
@@ -288,6 +306,8 @@ class TaskRunner:
                     if coal is not None and coal.pending:
                         for cside, cbatch in coal.flush_all():
                             await self._process_record(cbatch, cside)
+                    if san is not None:
+                        san.before_control(tid, "end", coal)
                     ended += 1
                     if msg.kind == MessageKind.STOP:
                         stop_mode = StopMode.GRACEFUL
@@ -420,6 +440,13 @@ class TaskRunner:
         # (operator, subtask), and per-member metadata keeps chained
         # checkpoints restorable un-chained and vice versa)
         metadatas = await self.operator.checkpoint_state(barrier, self.ctx)
+        if self.sanitizer is not None:
+            # completeness: exactly one completion per distinct
+            # (member, subtask) per epoch — a duplicate means two
+            # snapshots raced for the same slot
+            for md in metadatas:
+                self.sanitizer.on_checkpoint_completed(
+                    md.operator_id, md.subtask_index, md.epoch)
         await self._report_event(barrier, CheckpointEventType.FINISHED_SYNC)
         for metadata in metadatas:
             await self.ctx.report(ControlResp(

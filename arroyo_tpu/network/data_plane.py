@@ -191,7 +191,12 @@ class NetworkManager:
     start}, network_manager.rs:221-307)."""
 
     def __init__(self, job_id: str = "") -> None:
+        from ..analysis.sanitizer import maybe_sanitizer
+
         self.job_id = job_id
+        # arroyosan: decode-side invariants (per-quad schema stability +
+        # watermark monotonicity); None unless ARROYO_SANITIZE armed it
+        self.sanitizer = maybe_sanitizer("data-plane")
         self.senders: Dict[Quad, asyncio.Queue] = {}
         self.server: Optional[asyncio.AbstractServer] = None
         self.port: Optional[int] = None
@@ -240,9 +245,17 @@ class NetworkManager:
             queue.put_nowait(msg)
 
     def _decode_frame(self, quad: Quad, kind: int, payload: bytes) -> Message:
+        san = self.sanitizer
         if kind == KIND_DATA:
             batch, schema = _decode_batch_full(payload)
+            if san is not None and quad in self._edge_schemas:
+                # a full frame mid-stream is legal only on a declared
+                # schema change: re-seed the stability tracker so the
+                # cached-schema continuation contract stays checkable
+                san.reset_edge(quad)
             self._edge_schemas[quad] = schema
+            if san is not None:
+                san.on_record(quad, batch)
             return Message.record(batch)
         if kind == KIND_DATA_BATCH:
             schema = self._edge_schemas.get(quad)
@@ -252,27 +265,45 @@ class NetworkManager:
                 # rather than fabricate a schema
                 raise ValueError(f"continuation frame for {quad} before "
                                  "any full frame delivered its schema")
-            return Message.record(_decode_batch_continuation(payload, schema))
-        return decode_message(kind, payload)
+            batch = _decode_batch_continuation(payload, schema)
+            if san is not None:
+                # continuation batches decode against the cached schema:
+                # any layout drift here is wire corruption
+                san.on_record(quad, batch)
+            return Message.record(batch)
+        msg = decode_message(kind, payload)
+        if san is not None and msg.kind == MessageKind.WATERMARK:
+            san.on_watermark(quad, msg.watermark)
+        return msg
 
     async def open_listener(self, host: str = "0.0.0.0", port: int = 0) -> int:
         async def on_conn(reader, writer):
             self._in_writers.append(writer)
-            while True:
-                frame = await _read_frame(reader)
-                if frame is None:
-                    break
-                quad, kind, payload = frame
-                self._bytes_counter(BYTES_RECV, quad[2], quad[3]).inc(
-                    len(payload))
-                msg = self._decode_frame(quad, kind, payload)
-                q = self.senders.get(quad)
-                if q is None:
-                    # receiver engine not built yet: park the frame
-                    self._pending.setdefault(quad, []).append(msg)
-                    continue
-                await q.put(msg)
-            writer.close()
+            try:
+                while True:
+                    frame = await _read_frame(reader)
+                    if frame is None:
+                        break
+                    quad, kind, payload = frame
+                    self._bytes_counter(BYTES_RECV, quad[2], quad[3]).inc(
+                        len(payload))
+                    msg = self._decode_frame(quad, kind, payload)
+                    q = self.senders.get(quad)
+                    if q is None:
+                        # receiver engine not built yet: park the frame
+                        self._pending.setdefault(quad, []).append(msg)
+                        continue
+                    await q.put(msg)
+            except AssertionError as e:
+                # a decode-side sanitizer violation (SanitizerError is an
+                # AssertionError) must not die as an unretrieved task
+                # exception: log it loudly — it also stays visible on the
+                # admin /sanitizer endpoint and in the violations counter
+                # — and drop the connection so the peer sees the break
+                logger.error("data-plane decode violation on %s: %s",
+                             writer.get_extra_info("peername"), e)
+            finally:
+                writer.close()
 
         self.server = await asyncio.start_server(on_conn, host, port)
         self.port = self.server.sockets[0].getsockname()[1]

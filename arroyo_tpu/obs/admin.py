@@ -57,6 +57,24 @@ class AdminServer:
                     "pid": os.getpid(),
                     "details": self.details_fn()}
 
+        # arroyosan triage surface: whether the runtime sanitizer is
+        # armed, and the tail of its protocol event ring (the same ring
+        # a SanitizerError snapshots) — the first stop after a
+        # task_failed carrying an arroyosan[...] message
+        @router.get("/sanitizer")
+        async def sanitizer(req: Request):
+            from ..analysis.sanitizer import (recent_events,
+                                              sanitize_enabled)
+
+            limit = int(req.query.get("limit") or 64)
+            return {
+                "enabled": sanitize_enabled(),
+                "events": [
+                    {"t": round(ts, 6), "kind": kind, "task": task,
+                     "detail": detail}
+                    for ts, kind, task, detail in recent_events(limit)],
+            }
+
         # continuous-profiling hooks: the pyroscope analog
         # (arroyo-server-common/src/lib.rs:12-15, try_profile_start) is the
         # jax profiler — one POST captures a Perfetto/XPlane trace of every

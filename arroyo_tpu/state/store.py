@@ -32,6 +32,10 @@ class StateStore:
         self.task_info = task_info
         self.backend = backend
         self.restore_epoch = restore_epoch
+        # arroyosan runtime sanitizer (analysis/sanitizer.py), installed
+        # by the engine when ARROYO_SANITIZE is armed: checkpoint() then
+        # verifies no table mutates between snapshot and persistence
+        self.sanitizer: Optional[Any] = None
         self.descriptors: Dict[str, TableDescriptor] = {}
         self.tables: Dict[str, Any] = {}
         self._restored: Optional[Dict[str, TableSnapshot]] = None
@@ -173,8 +177,15 @@ class StateStore:
                     deletes=self._pending_deletes.get(name))
         self._pending_deletes.clear()
         self._update_size_gauges(snaps)
+        san = self.sanitizer
+        fp = (san.checkpoint_begin(self.task_info.task_id, self.tables)
+              if san is not None else None)
         meta = self.backend.write_subtask_checkpoint(
             self.task_info, epoch, snaps, watermark)
+        if san is not None:
+            # the epoch on disk must reflect exactly the snapshot taken
+            # above: any table mutated while persisting is a torn epoch
+            san.checkpoint_end(self.task_info.task_id, self.tables, fp)
         # Tables with CommitWrites behavior surface their snapshot to the
         # controller so it can drive the second commit phase
         # (arroyo-controller/src/job_controller/checkpointer.rs:83-110).

@@ -12,6 +12,14 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # tests always run on the CPU mesh
+
+# arroyosan runtime sanitizer: tier-1 runs with the streaming-invariant
+# assertions armed (watermark monotonicity, barrier alignment, coalescer
+# flush-before-control, snapshot/upload atomicity, checkpoint
+# completeness) — a violation fails the offending test with the event
+# ring instead of passing on corrupted output.  setdefault so a test or
+# dev run can still opt out with ARROYO_SANITIZE=0.
+os.environ.setdefault("ARROYO_SANITIZE", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

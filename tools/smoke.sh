@@ -17,7 +17,12 @@
 #   4. the autoscaler: a deterministic ramp trace through the policy
 #      simulator must scale up the bottleneck (and only it), and the
 #      REST GET/PUT /v1/jobs/{id}/autoscaler surface must round-trip;
-#   5. tests/test_obs.py — the observability contract suite.
+#   5. arroyosan: a sanitized tiny-Nexmark run (ARROYO_SANITIZE=1,
+#      chaining on, periodic checkpoints) must complete with zero
+#      invariant violations — the runtime protocol contract;
+#   6. tests/test_obs.py — the observability contract suite.
+#
+# Budget: the whole gate stays under ~90s.
 #
 # Usage: tools/smoke.sh   (from anywhere; runs on CPU for determinism)
 set -euo pipefail
@@ -114,6 +119,52 @@ if tasks_on >= tasks_off:
              f"({tasks_on} tasks with chains vs {tasks_off} without)")
 print(f"smoke: chain equivalence ok ({len(rows_on)} rows; "
       f"{tasks_on} tasks chained vs {tasks_off} unchained)")
+PY
+
+python - <<'PY'
+# arroyosan gate: the SAME tiny Nexmark pipeline, chained, with the
+# runtime sanitizer armed and periodic checkpoints driving the barrier
+# protocol — it must complete with output and ZERO invariant violations
+import os
+import sys
+
+os.environ["ARROYO_SANITIZE"] = "1"
+os.environ["ARROYO_CHAIN"] = "1"
+
+from arroyo_tpu.connectors.memory import clear_sink, sink_output
+from arroyo_tpu.engine.engine import LocalRunner
+from arroyo_tpu.sql import plan_sql
+
+SQL = """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark', event_rate = '1000000', num_events = '30000',
+  rate_limited = 'false', batch_size = '2048',
+  base_time_micros = '1700000000000000'
+);
+SELECT bid.auction as auction,
+       TUMBLE(INTERVAL '2' SECOND) as window,
+       count(*) AS num
+FROM nexmark WHERE bid is not null GROUP BY 1, 2
+"""
+
+clear_sink("results")
+runner = LocalRunner(plan_sql(SQL))
+runner.run(checkpoint_interval_secs=0.3)
+rows = sum(len(b) for b in sink_output("results"))
+if rows <= 0:
+    sys.exit("smoke: sanitized nexmark produced no output")
+san = runner.engine.sanitizer
+if san is None:
+    sys.exit("smoke: ARROYO_SANITIZE=1 did not arm the sanitizer")
+if san.violations:
+    sys.exit(f"smoke: sanitized run recorded {san.violations} "
+             "invariant violation(s)")
+from arroyo_tpu.analysis.sanitizer import recent_events
+
+if not recent_events(1):
+    sys.exit("smoke: sanitizer recorded no protocol events — the "
+             "hook sites are not wired")
+print(f"smoke: sanitized nexmark ok ({rows} rows, 0 violations)")
 PY
 
 python - <<'PY'

@@ -34,7 +34,7 @@ Checks (codes):
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import TYPE_CHECKING, Dict, List, Optional
+from typing import TYPE_CHECKING, Any, Dict, List, Optional
 
 if TYPE_CHECKING:  # graph.logical imports networkx only — cheap, but
     from ..graph.logical import Program  # keep import-time layering clean
@@ -71,6 +71,7 @@ def _keyed_state_kinds():
         OpKind.SLIDING_AGGREGATING_TOP_N, OpKind.WINDOW_JOIN,
         OpKind.JOIN_WITH_EXPIRATION, OpKind.NON_WINDOW_AGGREGATOR,
         OpKind.COUNT, OpKind.AGGREGATE, OpKind.WINDOW_ARGMAX,
+        OpKind.MULTI_WAY_JOIN,
     }
 
 
@@ -158,6 +159,34 @@ def validate_program(program: "Program") -> List[PlanDiagnostic]:
                     f"different key arities ({left[0].key_schema!r} vs "
                     f"{right[0].key_schema!r}); rows for the same join "
                     "key would land on different subtasks", node=op_id))
+
+        if kind == OpKind.MULTI_WAY_JOIN:
+            n_sides = len(getattr(node.operator.spec, "side_cols", ()) or ())
+            by_side: Dict[int, List[Any]] = {}
+            for _, _, d in in_edges:
+                s = d["edge"].typ.join_side
+                if s is None:
+                    diags.append(PlanDiagnostic(
+                        "join-sides", "error",
+                        f"{node.operator.name} has a non-join input edge "
+                        f"({d['edge'].typ.value})", node=op_id))
+                else:
+                    by_side.setdefault(s, []).append(d["edge"])
+            if n_sides and (sorted(by_side) != list(range(n_sides))
+                            or any(len(v) != 1 for v in by_side.values())):
+                diags.append(PlanDiagnostic(
+                    "join-sides", "error",
+                    f"{node.operator.name} declares {n_sides} sides but "
+                    f"has inputs for sides {sorted(by_side)}",
+                    node=op_id))
+            arities = {_key_arity(es[0].key_schema)
+                       for es in by_side.values()}
+            if len(arities) > 1:
+                diags.append(PlanDiagnostic(
+                    "key-schema-mismatch", "error",
+                    f"{node.operator.name} joins sides shuffled on "
+                    "different key arities; rows for the same join key "
+                    "would land on different subtasks", node=op_id))
 
         if kind in program.WINDOWED_KINDS:
             if not any(program.node(anc).operator.kind == OpKind.WATERMARK

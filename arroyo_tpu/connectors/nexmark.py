@@ -471,7 +471,7 @@ class NexmarkSource(SourceOperator):
         # Exactly-once stays intact because the checkpointed count is
         # captured WITH each batch at generation time — a barrier between
         # emit and prefetch never records the in-flight batch's events.
-        loop = asyncio.get_event_loop()
+        loop = asyncio.get_running_loop()
 
         def gen_next():
             b, nums = gen.next_batch(batch_size)

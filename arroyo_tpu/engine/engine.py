@@ -224,7 +224,7 @@ class Engine:
                     in_edges, key=lambda e: e[2]["edge"].typ.value):
                 src_par = self.program.node(src).parallelism
                 typ = data["edge"].typ
-                side = 1 if typ == EdgeType.SHUFFLE_JOIN_RIGHT else 0
+                side = typ.join_side or 0  # shuffle_join_N carries N
                 if typ == EdgeType.FORWARD:
                     if parallelism > src_par:
                         inputs.append((side, in_queue(

@@ -200,7 +200,7 @@ class BinAggOperator(Operator):
             from ..obs import perf
 
             await perf.run_offloaded(
-                asyncio.get_event_loop(), self.state.update,
+                asyncio.get_running_loop(), self.state.update,
                 batch.key_hash, batch.timestamp, batch.columns)
         else:
             self.state.update(batch.key_hash, batch.timestamp, batch.columns)
@@ -221,7 +221,7 @@ class BinAggOperator(Operator):
                 from ..obs import perf
 
                 fired = await perf.run_offloaded(
-                    asyncio.get_event_loop(),
+                    asyncio.get_running_loop(),
                     lambda: self.state.fire_panes(watermark, final=final))
             else:
                 fired = self.state.fire_panes(watermark, final=final)
@@ -721,6 +721,24 @@ def _internal_join_col(name: str) -> bool:
     return name.startswith("__jk")
 
 
+def _drop_null_keyed(batch: Batch) -> Optional[Batch]:
+    """Strip rows whose ``__jknonce`` is nonzero — SQL-NULL join keys
+    hashed to a unique nonce, so they can never match ANY row on any
+    side.  The one home of the nonce-drop rule: buffering such rows on
+    a side that cannot emit them padded is pure state growth until TTL
+    (the round-4 deferral, retired).  Returns None when nothing
+    survives."""
+    nonce = batch.columns.get("__jknonce")
+    if nonce is None:
+        return batch
+    keep = np.asarray(nonce) == 0  # arroyolint: disable=host-sync -- nonce is a host-resident key column (null-key routing never enters jit)
+    if keep.all():
+        return batch
+    if not keep.any():
+        return None
+    return batch.select(keep)
+
+
 def _stable_join_part(left_cols: Dict[str, np.ndarray],
                       right_cols: Dict[str, np.ndarray], n: int,
                       key_names: Sequence[str],
@@ -822,13 +840,31 @@ class WindowJoinOperator(Operator):
         ]
 
     async def on_start(self, ctx: Context) -> None:
-        self.left = ctx.state.get_batch_buffer("l")
-        self.right = ctx.state.get_batch_buffer("r")
+        from ..state.join_state import PartitionedJoinBuffer
+
+        self.left = ctx.state.get_join_buffer("l")
+        self.right = ctx.state.get_join_buffer("r")
+        self._partitioned = isinstance(self.left, PartitionedJoinBuffer) \
+            and isinstance(self.right, PartitionedJoinBuffer)
+
+    def _drop_never_emitting(self, batch: Batch,
+                             side: int) -> Optional[Batch]:
+        """Null-keyed rows stay ONLY when this side's unmatched rows
+        null-pad at fire; otherwise they can never emit
+        (:func:`_drop_null_keyed`)."""
+        padded = self.join_type in (
+            (JoinType.LEFT, JoinType.FULL) if side == 0
+            else (JoinType.RIGHT, JoinType.FULL))
+        if padded:
+            return batch
+        return _drop_null_keyed(batch)
 
     async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
         assert batch.key_hash is not None, "window join requires keyed inputs"
         self._tmpl[side].observe(batch)
-        (self.left if side == 0 else self.right).append(batch)
+        buffered = self._drop_never_emitting(batch, side)
+        if buffered is not None and len(buffered):
+            (self.left if side == 0 else self.right).append(buffered)
         first_end = (batch.timestamp // self.slide + 1) * self.slide
         if isinstance(self.typ, SlidingWindow):
             ends = np.unique(np.concatenate([
@@ -843,22 +879,56 @@ class WindowJoinOperator(Operator):
                            ctx: Context) -> None:
         end = key[1]
         start = end - self.width
-        l = self.left.query_range(start, end)
-        r = self.right.query_range(start, end)
         how = self.join_type
-        have_l, have_r = (l is not None and len(l)), (r is not None and len(r))
-        fire = ((have_l and have_r)
-                or (have_l and how in (JoinType.LEFT, JoinType.FULL))
-                or (have_r and how in (JoinType.RIGHT, JoinType.FULL)))
-        if fire:
-            if not have_l:
-                l = _empty_like_side(self._tmpl[0], r)
-            if not have_r:
-                r = _empty_like_side(self._tmpl[1], l)
-            out = join_batches(l, r, end, how=how,
-                               tmpl=(self._tmpl[0], self._tmpl[1]))
-            if len(out):
-                await ctx.collect(out)
+        if self._partitioned:
+            # sorted-run fire: mask-compress each partition's resident
+            # run to the window range (stays key-sorted — no sort) and
+            # merge-probe; only matched/unmatched rows materialize
+            lg, rg, lu, ru = self.left.range_join(self.right, start, end)
+            have_l = bool(len(lg) or len(lu))
+            have_r = bool(len(rg) or len(ru))
+            fire = ((have_l and have_r)
+                    or (have_l and how in (JoinType.LEFT, JoinType.FULL))
+                    or (have_r and how in (JoinType.RIGHT, JoinType.FULL)))
+            if fire:
+                l_rows = self.left.gather(lg)
+                r_rows = self.right.gather(rg)
+                if not len(l_rows.columns):
+                    l_rows = _empty_like_side(self._tmpl[0], r_rows)
+                if not len(r_rows.columns):
+                    r_rows = _empty_like_side(self._tmpl[1], l_rows)
+                key_cols = (self.left.key_cols or self.right.key_cols
+                            or l_rows.key_cols)
+                # unmatched rows only materialize on the side that pads
+                # them — an INNER fire's cost scales with matches, not
+                # window size
+                l_un = (self.left.gather(lu)
+                        if how in (JoinType.LEFT, JoinType.FULL) else None)
+                r_un = (self.right.gather(ru)
+                        if how in (JoinType.RIGHT, JoinType.FULL)
+                        else None)
+                out = _assemble_join_output(
+                    l_rows, r_rows, l_un, r_un, end, how, key_cols,
+                    tmpl=(self._tmpl[0], self._tmpl[1]))
+                if len(out):
+                    await ctx.collect(out)
+        else:
+            l = self.left.query_range(start, end)
+            r = self.right.query_range(start, end)
+            have_l = l is not None and len(l)
+            have_r = r is not None and len(r)
+            fire = ((have_l and have_r)
+                    or (have_l and how in (JoinType.LEFT, JoinType.FULL))
+                    or (have_r and how in (JoinType.RIGHT, JoinType.FULL)))
+            if fire:
+                if not have_l:
+                    l = _empty_like_side(self._tmpl[0], r)
+                if not have_r:
+                    r = _empty_like_side(self._tmpl[1], l)
+                out = join_batches(l, r, end, how=how,
+                                   tmpl=(self._tmpl[0], self._tmpl[1]))
+                if len(out):
+                    await ctx.collect(out)
         evict_to = end - self.width + self.slide
         self.left.evict_before(evict_to)
         self.right.evict_before(evict_to)
@@ -1071,6 +1141,54 @@ def _concat_col(parts: List[np.ndarray]) -> np.ndarray:
     return np.concatenate(parts)
 
 
+def _assemble_join_output(l_rows: Batch, r_rows: Batch,
+                          l_un: Optional[Batch], r_un: Optional[Batch],
+                          end: int, how: JoinType, key_cols,
+                          l_prefix: str = "", r_prefix: str = "",
+                          tmpl: Optional[Tuple["_SideTemplate",
+                                               "_SideTemplate"]] = None,
+                          r_fallback: Optional[Batch] = None,
+                          l_fallback: Optional[Batch] = None) -> Batch:
+    """Build one join-output batch from aligned matched rows plus the
+    per-side unmatched rows — the single emission home for BOTH the
+    legacy re-sort path and the partitioned sorted-run path.  Every part
+    goes through the same layout normalization so matched, left-padded
+    and right-padded rows of one join share ONE column layout (and so do
+    successive fires on the same edge)."""
+    key_names = tuple(key_cols)
+    parts: List[Tuple[Dict[str, np.ndarray], np.ndarray]] = []  # (cols, kh)
+    parts.append((_stable_join_part(
+        dict(l_rows.columns), dict(r_rows.columns), len(l_rows),
+        key_names, l_prefix, r_prefix), l_rows.key_hash))
+
+    if how in (JoinType.LEFT, JoinType.FULL) and l_un is not None \
+            and len(l_un):
+        pad = ((tmpl[1].null_cols(len(l_un))) if tmpl is not None
+               else {c: _null_column(len(l_un), like=v)
+                     for c, v in (r_fallback or r_rows).columns.items()})
+        parts.append((_stable_join_part(
+            dict(l_un.columns), pad, len(l_un), key_names,
+            l_prefix, r_prefix), l_un.key_hash))
+    if how in (JoinType.RIGHT, JoinType.FULL) and r_un is not None \
+            and len(r_un):
+        pad = ((tmpl[0].null_cols(len(r_un))) if tmpl is not None
+               else {c: _null_column(len(r_un), like=v)
+                     for c, v in (l_fallback or l_rows).columns.items()})
+        parts.append((_stable_join_part(
+            pad, dict(r_un.columns), len(r_un), key_names,
+            l_prefix, r_prefix), r_un.key_hash))
+
+    if len(parts) == 1:
+        cols, kh = parts[0]
+        ts = np.full(len(kh), end - 1, dtype=np.int64)
+        return Batch(ts, cols, kh, key_names)
+    names = list(parts[0][0])
+    out_cols = {c: _concat_col([p[0][c] for p in parts]) for c in names}
+    kh = np.concatenate([p[1] for p in parts])
+    ts = np.full(len(kh), end - 1, dtype=np.int64)
+    return Batch(ts, out_cols, kh, key_names)
+
+
 def join_batches(l: Batch, r: Batch, end: int,
                  l_prefix: str = "", r_prefix: str = "",
                  how: JoinType = JoinType.INNER,
@@ -1080,54 +1198,25 @@ def join_batches(l: Batch, r: Batch, end: int,
     LEFT/RIGHT/FULL null-padding of unmatched rows (the reference's
     windowed list-merge, arroyo-sql/src/expressions.rs:134-230).
 
-    Sort/probe/prefix-sum/pair-expansion run as device kernels for large
-    windows (ops/join.py, SURVEY "Core TPU kernel #3"); the host only
-    materializes the output batch by the computed indices, so every
-    payload dtype (strings, exact int64) survives untouched."""
+    This is the legacy full re-sort path (both key arrays argsorted per
+    call); the partitioned sorted-run fire path computes the same four
+    row groups from incrementally maintained state (state/join_state.py)
+    and shares the assembly/normalization above."""
     lo, ro, lidx, ridx, counts = join_pairs(l.key_hash, r.key_hash)
 
     l_rows = l.select(lo[lidx])
     r_rows = r.select(ro[ridx])
-    key_names = tuple(l.key_cols)
-
-    # every part goes through the same layout normalization so matched,
-    # left-padded and right-padded rows of one join share ONE column
-    # layout (and so do successive fires on the same edge)
-    parts: List[Tuple[Dict[str, np.ndarray], np.ndarray]] = []  # (cols, kh)
-    parts.append((_stable_join_part(
-        dict(l_rows.columns), dict(r_rows.columns), len(l_rows),
-        key_names, l_prefix, r_prefix), l_rows.key_hash))
-
-    if how in (JoinType.LEFT, JoinType.FULL) and (counts == 0).any():
-        un = l.select(lo[counts == 0])
-        pad = ((tmpl[1].null_cols(len(un))) if tmpl is not None
-               else {c: _null_column(len(un), like=v)
-                     for c, v in r.columns.items()})
-        parts.append((_stable_join_part(
-            dict(un.columns), pad, len(un), key_names,
-            l_prefix, r_prefix), un.key_hash))
+    l_un = (l.select(lo[counts == 0])
+            if how in (JoinType.LEFT, JoinType.FULL) else None)
+    r_un = None
     if how in (JoinType.RIGHT, JoinType.FULL):
         r_matched = np.zeros(len(r.key_hash), dtype=bool)
         if len(ridx):
             r_matched[ro[ridx]] = True
-        if not r_matched.all():
-            un = r.select(~r_matched)
-            pad = ((tmpl[0].null_cols(len(un))) if tmpl is not None
-                   else {c: _null_column(len(un), like=v)
-                         for c, v in l.columns.items()})
-            parts.append((_stable_join_part(
-                pad, dict(un.columns), len(un), key_names,
-                l_prefix, r_prefix), un.key_hash))
-
-    if len(parts) == 1:
-        cols, kh = parts[0]
-        ts = np.full(len(kh), end - 1, dtype=np.int64)
-        return Batch(ts, cols, kh, l.key_cols)
-    names = list(parts[0][0])
-    out_cols = {c: _concat_col([p[0][c] for p in parts]) for c in names}
-    kh = np.concatenate([p[1] for p in parts])
-    ts = np.full(len(kh), end - 1, dtype=np.int64)
-    return Batch(ts, out_cols, kh, l.key_cols)
+        r_un = r.select(~r_matched)
+    return _assemble_join_output(l_rows, r_rows, l_un, r_un, end, how,
+                                 l.key_cols, l_prefix, r_prefix, tmpl,
+                                 r_fallback=r, l_fallback=l)
 
 
 class JoinWithExpirationOperator(Operator):
@@ -1158,8 +1247,12 @@ class JoinWithExpirationOperator(Operator):
         ]
 
     async def on_start(self, ctx: Context) -> None:
-        self.left = ctx.state.get_batch_buffer("l")
-        self.right = ctx.state.get_batch_buffer("r")
+        from ..state.join_state import PartitionedJoinBuffer
+
+        self.left = ctx.state.get_join_buffer("l")
+        self.right = ctx.state.get_join_buffer("r")
+        self._partitioned = isinstance(self.left, PartitionedJoinBuffer) \
+            and isinstance(self.right, PartitionedJoinBuffer)
 
     def _orient(self, mine_rows: Batch, opp_cols: Dict[str, np.ndarray],
                 side: int, end: int, op: Optional[int],
@@ -1202,8 +1295,11 @@ class JoinWithExpirationOperator(Operator):
         updating = how != JoinType.INNER
         op_create = UpdateOp.CREATE.value if updating else None
 
-        opp = other.all()
-        have_opp = opp is not None and len(opp)
+        # emptiness check must stay O(P): len() counts LIVE rows with a
+        # full timestamp scan; resident-but-dead rows are fine here (the
+        # probe filters them), so non-empty partitions suffice
+        have_opp = (any(part.n for part in other.parts)
+                    if self._partitioned else len(other) > 0)
         end = int(batch.timestamp.max()) + 1
 
         # 1. retract padded opposite rows: keys NEW to my buffer that
@@ -1219,29 +1315,47 @@ class JoinWithExpirationOperator(Operator):
             batch_keys = np.unique(batch.key_hash)
             new_keys = batch_keys[~mine.contains_keys(batch_keys)]
             if len(new_keys):
-                hit = np.isin(opp.key_hash, new_keys)
-                if hit.any():
+                if self._partitioned:
+                    # sorted-run probe for exactly the hit rows — the
+                    # opposite buffer is never materialized or re-sorted
+                    padded = other.rows_with_keys(new_keys)
+                else:
+                    opp_all = other.all()
+                    padded = opp_all.select(
+                        np.isin(opp_all.key_hash, new_keys))
+                if len(padded):
                     # the hit rows are OPPOSITE-side rows whose padded
                     # (null, row) emission is now stale; my side is the pad
-                    padded = opp.select(hit)
                     pad = my_tmpl.null_cols(len(padded))
                     out = self._orient(padded, pad, 1 - side, end,
                                        UpdateOp.DELETE.value)
                     await ctx.collect(out)
 
-        # 2. joined CREATEs for matched pairs (device sort/probe/expand
-        #    kernels for large states — ops/join.py)
+        # 2. joined CREATEs for matched pairs.  Partitioned state probes
+        #    the arriving batch against each partition's resident sorted
+        #    run (only the batch's delta gets sorted); the legacy path
+        #    re-sorts both sides per call (ops/join.py kernels).
         if have_opp:
-            lo, ro, lidx, ridx, counts = join_pairs(batch.key_hash,
-                                                    opp.key_hash)
-            if len(lidx):
-                my_rows = batch.select(lo[lidx])
-                opp_rows = opp.select(ro[ridx])
-                out = self._orient(my_rows, dict(opp_rows.columns), side,
-                                   end, op_create)
-                await ctx.collect(out)
-            unmatched = np.zeros(len(batch), dtype=bool)
-            unmatched[lo[counts == 0]] = True  # back to original order
+            if self._partitioned:
+                bsel, opp_rows, counts = other.probe_batch(batch)
+                if len(bsel):
+                    my_rows = batch.select(bsel)
+                    out = self._orient(my_rows, dict(opp_rows.columns),
+                                       side, end, op_create)
+                    await ctx.collect(out)
+                unmatched = counts == 0
+            else:
+                opp = other.all()
+                lo, ro, lidx, ridx, counts = join_pairs(batch.key_hash,
+                                                        opp.key_hash)
+                if len(lidx):
+                    my_rows = batch.select(lo[lidx])
+                    opp_rows = opp.select(ro[ridx])
+                    out = self._orient(my_rows, dict(opp_rows.columns),
+                                       side, end, op_create)
+                    await ctx.collect(out)
+                unmatched = np.zeros(len(batch), dtype=bool)
+                unmatched[lo[counts == 0]] = True  # back to original order
         else:
             unmatched = np.ones(len(batch), dtype=bool)
 
@@ -1252,11 +1366,201 @@ class JoinWithExpirationOperator(Operator):
             out = self._orient(un, pad, side, end, op_create)
             await ctx.collect(out)
 
-        mine.append(batch)
+        # 4. buffer — EXCEPT null-keyed rows: their pad (if any) was
+        #    emitted above and can never be matched or retracted
+        #    (no opposite row shares the nonce), so they never enter
+        #    state (_drop_null_keyed)
+        batch = _drop_null_keyed(batch)
+        if batch is not None and len(batch):
+            mine.append(batch)
 
     async def handle_watermark(self, watermark: int, ctx: Context) -> None:
         self.left.evict_before(watermark - self.left_ttl)
         self.right.evict_before(watermark - self.right_ttl)
+        await ctx.broadcast(Message.wm(Watermark.event_time(watermark)))
+
+
+class MultiWayJoinOperator(Operator):
+    """N-ary INNER equi-join over sides sharing one key (the planner's
+    cascaded-join rewrite; MultiWayJoinSpec).  Per fire (windowed mode)
+    or per arriving batch (TTL mode), the per-key cross product across
+    ALL sides expands directly from the sides' sorted runs — no pairwise
+    intermediate is ever materialized, re-keyed, or re-buffered."""
+
+    def __init__(self, name: str, typ, ttl_micros: int, n_sides: int):
+        super().__init__(name)
+        self.typ = typ
+        self.ttl = ttl_micros
+        self.n_sides = n_sides
+        if typ is not None:
+            self.width, self.slide = _window_params(typ)
+        else:
+            self.width = self.slide = 0
+
+    def tables(self) -> List[TableDescriptor]:
+        retention = self.width if self.typ is not None else self.ttl
+        return [TableDescriptor(f"j{i}", TableType.BATCH_BUFFER,
+                                f"join side {i}",
+                                retention_micros=retention)
+                for i in range(self.n_sides)]
+
+    async def on_start(self, ctx: Context) -> None:
+        # always partitioned: the N-ary probe needs sorted runs (the
+        # checkpoint form is the same BATCH_BUFFER batch either way)
+        self.bufs = [ctx.state.get_join_buffer(f"j{i}",
+                                               force_partitioned=True)
+                     for i in range(self.n_sides)]
+
+    # -- shared expansion --------------------------------------------------
+
+    @staticmethod
+    def _expand(counts: List[np.ndarray]
+                ) -> Tuple[np.ndarray, List[np.ndarray]]:
+        """Cross-product expansion: for groups g with per-side match
+        counts ``counts[i][g]``, return (group_id per output row, per-side
+        offset within the group's side-i match list)."""
+        from ..ops.join import expand_counts
+
+        S = len(counts)
+        m = counts[0].astype(np.int64).copy()
+        for c in counts[1:]:
+            m *= c
+        gid, within = expand_counts(m)
+        offs: List[np.ndarray] = [np.zeros(0, np.int64)] * S
+        stride = np.ones(len(m), dtype=np.int64)
+        for i in range(S - 1, -1, -1):
+            ci = np.maximum(counts[i].astype(np.int64), 1)
+            offs[i] = (within // stride[gid]) % ci[gid]
+            stride = stride * ci
+        return gid, offs
+
+    def _emit_sides(self, side_rows: List[Batch], end: int,
+                    ctx: Context) -> Batch:
+        """Assemble the joined output left-to-right: side 0 plays the
+        left role (carries the internal join-key columns), every later
+        side folds in through the same layout normalization the pairwise
+        join uses — one stable column layout per edge."""
+        key_names = tuple(side_rows[0].key_cols)
+        cols = dict(side_rows[0].columns)
+        n = len(side_rows[0])
+        for rows in side_rows[1:]:
+            cols = _stable_join_part(cols, dict(rows.columns), n,
+                                     key_names)
+        ts = np.full(n, end - 1, dtype=np.int64)
+        return Batch(ts, cols, side_rows[0].key_hash, key_names)
+
+    # -- windowed mode -----------------------------------------------------
+
+    async def process_batch(self, batch: Batch, ctx: Context,
+                            side: int = 0) -> None:
+        assert batch.key_hash is not None, "multi-way join requires keys"
+        if not len(batch):
+            return
+        # inner-only: null-keyed rows can never match any side — never
+        # buffered (_drop_null_keyed)
+        batch = _drop_null_keyed(batch)
+        if batch is None or not len(batch):
+            return
+        if self.typ is None:
+            await self._probe_ttl(batch, side, ctx)
+            self.bufs[side].append(batch)
+            return
+        self.bufs[side].append(batch)
+        first_end = (batch.timestamp // self.slide + 1) * self.slide
+        if isinstance(self.typ, SlidingWindow):
+            ends = np.unique(np.concatenate([
+                first_end + i * self.slide
+                for i in range(self.width // self.slide)]))
+        else:
+            ends = np.unique(first_end - self.slide + self.width)
+        for e in ends.tolist():
+            ctx.timers.schedule(int(e), ("mw", int(e)))
+
+    async def handle_timer(self, time: int, key: Any, payload: Any,
+                           ctx: Context) -> None:
+        end = key[1]
+        start = end - self.width
+        P = self.bufs[0].P
+        out_parts: List[Batch] = []
+        for p in range(P):
+            views = [b.parts[p].range_view(start, end) for b in self.bufs]
+            if any(len(k) == 0 for k, _pos in views):
+                continue
+            # keys present on EVERY side (all views key-sorted)
+            uk = np.unique(views[0][0])
+            for k, _pos in views[1:]:
+                idx = np.searchsorted(k, uk)
+                ok = idx < len(k)
+                ok[ok] = k[idx[ok]] == uk[ok]
+                uk = uk[ok]
+                if not len(uk):
+                    break
+            if not len(uk):
+                continue
+            starts: List[np.ndarray] = []
+            cnts: List[np.ndarray] = []
+            for k, _pos in views:
+                s = np.searchsorted(k, uk, side="left")
+                e = np.searchsorted(k, uk, side="right")
+                starts.append(s)
+                cnts.append(e - s)
+            gid, offs = self._expand(cnts)
+            if not len(gid):
+                continue
+            side_rows = []
+            for i, (k, pos) in enumerate(views):
+                rows = starts[i][gid] + offs[i]
+                side_rows.append(self.bufs[i].gather(
+                    p * (1 << 48) + pos[rows]))
+            out_parts.append(self._emit_sides(side_rows, end, ctx))
+        if out_parts:
+            out = (out_parts[0] if len(out_parts) == 1
+                   else Batch.concat(out_parts))
+            if len(out):
+                await ctx.collect(out)
+        evict_to = end - self.width + self.slide
+        for b in self.bufs:
+            b.evict_before(evict_to)
+
+    # -- TTL mode ----------------------------------------------------------
+
+    async def _probe_ttl(self, batch: Batch, side: int,
+                         ctx: Context) -> None:
+        n = len(batch)
+        kh = batch.key_hash
+        sorter = np.argsort(kh, kind="stable")
+        counts: List[np.ndarray] = []
+        groups: List[Optional[Tuple[np.ndarray, np.ndarray]]] = []
+        for i, buf in enumerate(self.bufs):
+            if i == side:
+                counts.append(np.ones(n, dtype=np.int64))
+                groups.append(None)
+                continue
+            qidx, gpos = buf.probe_positions(kh[sorter], pre_sorted=True)
+            order = np.argsort(qidx, kind="stable")
+            qidx, gpos = qidx[order], gpos[order]
+            c = np.bincount(qidx, minlength=n)
+            counts.append(c)
+            groups.append((np.cumsum(c) - c, gpos))
+        gid, offs = self._expand(counts)
+        if not len(gid):
+            return
+        end = int(batch.timestamp.max()) + 1
+        side_rows = []
+        for i, buf in enumerate(self.bufs):
+            if i == side:
+                side_rows.append(batch.select(sorter[gid]))
+            else:
+                starts, gpos = groups[i]
+                side_rows.append(buf.gather(gpos[starts[gid] + offs[i]]))
+        out = self._emit_sides(side_rows, end, ctx)
+        if len(out):
+            await ctx.collect(out)
+
+    async def handle_watermark(self, watermark: int, ctx: Context) -> None:
+        if self.typ is None:
+            for b in self.bufs:
+                b.evict_before(watermark - self.ttl)
         await ctx.broadcast(Message.wm(Watermark.event_time(watermark)))
 
 
@@ -1565,6 +1869,13 @@ def _build_join_exp(op: LogicalOperator) -> Operator:
     return JoinWithExpirationOperator(op.name, s.left_expiration_micros,
                                       s.right_expiration_micros, s.join_type,
                                       s.left_cols, s.right_cols)
+
+
+@register_builder(OpKind.MULTI_WAY_JOIN)
+def _build_multi_way_join(op: LogicalOperator) -> Operator:
+    s = op.spec
+    return MultiWayJoinOperator(op.name, s.typ, s.ttl_micros,
+                                len(s.side_cols))
 
 
 @register_builder(OpKind.NON_WINDOW_AGGREGATOR)

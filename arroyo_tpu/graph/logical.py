@@ -157,6 +157,7 @@ class OpKind(Enum):
     UPDATING_KEY = "updating_key"
     UNION = "union"  # N-ary stream merge (the reference bails on unions)
     WINDOW_ARGMAX = "window_argmax"  # fused self-join-on-window-max
+    MULTI_WAY_JOIN = "multi_way_join"  # N-ary shared-key equi-join
 
 
 class JoinType(Enum):
@@ -299,6 +300,25 @@ class WindowJoinSpec:
 
 
 @dataclass
+class MultiWayJoinSpec:
+    """Operator::MultiWayJoin — one N-ary INNER equi-join over sides that
+    share one join key (the planner's cascaded-join rewrite, after
+    "Streaming SQL Multi-Way Join Method for Long State Streams",
+    PAPERS.md).  All sides are keyed identically; per fire the operator
+    intersects the sides' sorted runs and expands the per-key cross
+    product directly — the pairwise intermediates a nested join plan
+    would materialize (|A⋈B| rows re-buffered, re-keyed, re-probed
+    against C) never exist.
+
+    ``typ`` set: windowed fire (each side buffered for one window span);
+    ``typ`` None: TTL'd state probed on every arriving batch."""
+
+    typ: Optional[WindowType] = None
+    ttl_micros: int = 0
+    side_cols: Tuple[Tuple[Tuple[str, str], ...], ...] = ()
+
+
+@dataclass
 class NonWindowAggregatorSpec:
     """Operator::NonWindowAggregator — updating aggregate with TTL
     (updating_aggregate.rs; datastream lib.rs:264-273)."""
@@ -357,10 +377,30 @@ class EdgeType(Enum):
     SHUFFLE = "shuffle"
     SHUFFLE_JOIN_LEFT = "shuffle_join_0"
     SHUFFLE_JOIN_RIGHT = "shuffle_join_1"
+    # additional multi-way join sides (the planner's cascaded-equi-join
+    # rewrite feeds one N-ary operator instead of nesting pairwise joins)
+    SHUFFLE_JOIN_2 = "shuffle_join_2"
+    SHUFFLE_JOIN_3 = "shuffle_join_3"
+    SHUFFLE_JOIN_4 = "shuffle_join_4"
+    SHUFFLE_JOIN_5 = "shuffle_join_5"
+    SHUFFLE_JOIN_6 = "shuffle_join_6"
+    SHUFFLE_JOIN_7 = "shuffle_join_7"
 
     @property
     def is_shuffle(self) -> bool:
         return self is not EdgeType.FORWARD
+
+    @property
+    def join_side(self) -> Optional[int]:
+        """Input-side index carried by shuffle_join_N edges, else None."""
+        if self.value.startswith("shuffle_join_"):
+            return int(self.value.rsplit("_", 1)[1])
+        return None
+
+
+def join_side_edge(i: int) -> EdgeType:
+    """The shuffle_join edge type for side ``i`` (0-based)."""
+    return EdgeType(f"shuffle_join_{i}")
 
 
 @dataclass
@@ -839,6 +879,39 @@ class Stream:
         ks = ",".join(self.keyed) if self.keyed else "()"
         self.program.add_edge(self.tail, nid, EdgeType.SHUFFLE_JOIN_LEFT, key_schema=ks)
         self.program.add_edge(other.tail, nid, EdgeType.SHUFFLE_JOIN_RIGHT, key_schema=ks)
+        return Stream(self.program, nid, self.keyed)
+
+    def multi_way_join(self, others: Sequence["Stream"],
+                       typ: Optional[WindowType] = None,
+                       ttl_micros: int = 0,
+                       side_cols: Tuple[Tuple[Tuple[str, str], ...], ...] = (),
+                       name: str = "multi_way_join",
+                       parallelism: Optional[int] = None) -> "Stream":
+        """N-ary INNER equi-join over sides keyed by the same columns
+        (``self`` is side 0).  See :class:`MultiWayJoinSpec`."""
+        sides = [self] + list(others)
+        assert 2 <= len(sides) <= 8, "multi-way join supports 2..8 sides"
+        assert len({s.tail for s in sides}) == len(sides), \
+            "multi-way join sides must be distinct nodes (a DiGraph " \
+            "would collapse duplicate edges)"
+        for o in sides[1:]:
+            assert self.program is o.program, \
+                "join streams must share a Program"
+        # side_cols doubles as the side-count record the physical builder
+        # and plan validator read — synthesize empty per-side specs when
+        # the caller has none (Stream-API inner joins need no pads)
+        if not side_cols:
+            side_cols = tuple(() for _ in sides)
+        assert len(side_cols) == len(sides), \
+            "side_cols must have one entry per join side"
+        spec = MultiWayJoinSpec(typ, ttl_micros, tuple(side_cols))
+        op = LogicalOperator(OpKind.MULTI_WAY_JOIN, name, spec=spec)
+        par = parallelism or self.program.node(self.tail).parallelism
+        nid = self.program.add_node(op, par)
+        ks = ",".join(self.keyed) if self.keyed else "()"
+        for i, s in enumerate(sides):
+            self.program.add_edge(s.tail, nid, join_side_edge(i),
+                                  key_schema=ks)
         return Stream(self.program, nid, self.keyed)
 
     def window_argmax(self, value_col: str, minmax: str,

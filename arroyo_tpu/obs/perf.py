@@ -99,6 +99,12 @@ def counter(key: str) -> int:
 counter_ns = counter  # legacy name for the ns-valued keys
 
 
+def count(key: str, n: int = 1) -> None:
+    """Increment a plain process-wide counter (join-state merge/spill
+    accounting, bench attribution).  Cheap: one dict update."""
+    _COUNTERS[key] = _COUNTERS.get(key, 0) + n
+
+
 def note(key: str, value: Any) -> None:
     _NOTES[key] = value
 

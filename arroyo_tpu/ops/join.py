@@ -22,7 +22,7 @@ from __future__ import annotations
 
 import functools
 import os
-from typing import Tuple
+from typing import Any, Tuple
 
 import numpy as np
 
@@ -134,15 +134,24 @@ def _expand_kernel(nl: int, m: int):
     return run
 
 
+def expand_counts(counts: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    """Flatten per-group match counts into (group_idx, within_offset)
+    pairs — the one home of the repeat/cumsum expansion idiom shared by
+    the host join fallback and the partitioned sorted-run probes."""
+    total = int(counts.sum())
+    gidx = np.repeat(np.arange(len(counts)), counts)
+    offs = np.arange(total) - np.repeat(np.cumsum(counts) - counts,
+                                        counts)
+    return gidx, offs
+
+
 def _host_pairs(lk_sorted: np.ndarray, rk_sorted: np.ndarray
                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Host fallback: identical contract, numpy end to end."""
     left_start = np.searchsorted(rk_sorted, lk_sorted, side="left")
     left_end = np.searchsorted(rk_sorted, lk_sorted, side="right")
     counts = left_end - left_start
-    lidx = np.repeat(np.arange(len(lk_sorted)), counts)
-    offs = np.arange(len(lidx)) - np.repeat(
-        np.cumsum(counts) - counts, counts)
+    lidx, offs = expand_counts(counts)
     ridx = np.repeat(left_start, counts) + offs
     return lidx, ridx, counts
 
@@ -162,6 +171,68 @@ def device_join_enabled(n_rows: int) -> bool:
     return n_rows >= int(os.environ.get("ARROYO_DEVICE_JOIN_MIN", 2048))
 
 
+# -- partition-adaptive resident rings (state/join_state.py) -----------------
+#
+# Hot join-state partitions keep their sorted key run device-resident in a
+# preallocated power-of-two ring (SENTINEL-padded).  Maintenance is ONE
+# scatter-merge dispatch per arriving delta (positions computed on the host
+# mirror — the delta was already sorted there) and probes run against the
+# resident ring without re-uploading state.
+
+
+@functools.lru_cache(maxsize=64)
+def _merge_ring_kernel(cap: int, db: int):
+    @jax.jit
+    def run(ring, res_pos, delta, delta_pos):
+        out = jnp.full(cap, SENTINEL, jnp.uint64)
+        out = out.at[res_pos].set(ring, mode="drop")
+        out = out.at[delta_pos].set(delta, mode="drop")
+        return out
+
+    return run
+
+
+def stage_ring(sorted_keys: np.ndarray) -> Tuple[Any, int]:
+    """Upload a sorted key run into a fresh power-of-two SENTINEL-padded
+    device ring; returns (device array, capacity)."""
+    cap = _bucket(max(len(sorted_keys), 1))
+    padded = np.full(cap, SENTINEL, np.uint64)
+    padded[: len(sorted_keys)] = sorted_keys
+    return jax.device_put(padded), cap
+
+
+def merge_ring(ring: Any, cap: int, res_pos: np.ndarray,
+               delta_sorted: np.ndarray, delta_pos: np.ndarray) -> Any:
+    """One scatter-merge dispatch: resident entries move to ``res_pos``,
+    the (already sorted) delta lands at ``delta_pos``.  Positions beyond
+    the caller-tracked valid length are padded to >= cap and dropped."""
+    n_res = len(res_pos)
+    db = _bucket(max(len(delta_sorted), 1))
+    rp = np.full(cap, cap, np.int64)
+    rp[:n_res] = res_pos
+    dk = np.full(db, SENTINEL, np.uint64)
+    dk[: len(delta_sorted)] = delta_sorted
+    dp = np.full(db, cap, np.int64)
+    dp[: len(delta_pos)] = delta_pos
+    return timed_device(_merge_ring_kernel(cap, db), ring, rp, dk, dp)
+
+
+def probe_ring(ring: Any, cap: int, qkeys_sorted: np.ndarray, n_valid: int
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """(start, counts) of sorted query keys against a resident ring —
+    bit-identical to the host searchsorted probe (parity-tested)."""
+    mq = _bucket(max(len(qkeys_sorted), 1))
+    qp = np.full(mq, SENTINEL, np.uint64)
+    qp[: len(qkeys_sorted)] = qkeys_sorted
+    m = len(qkeys_sorted)
+    # reuse the pairwise probe kernel (query = left, ring = right); the
+    # merged-rank variant keeps TPU off searchsorted's sequential scan
+    start_d, counts_d, _cum = timed_device(
+        _probe_kernel(mq, cap, _merged_probe()), qp, ring, m, n_valid)
+    return (np.asarray(start_d)[:m].astype(np.int64),  # arroyolint: disable=host-sync -- intentional probe readback: match ranges drive host-side pair expansion/gather
+            np.asarray(counts_d)[:m].astype(np.int64))  # arroyolint: disable=host-sync -- intentional probe readback: match ranges drive host-side pair expansion/gather
+
+
 def join_pairs(lk: np.ndarray, rk: np.ndarray
                ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
                           np.ndarray, np.ndarray]:
@@ -169,6 +240,10 @@ def join_pairs(lk: np.ndarray, rk: np.ndarray
     arrays: ``lo``/``ro`` sort each side, ``lidx``/``ridx`` index pairs
     into the sorted orders, ``counts`` is per-sorted-left-row match
     count (for outer-join unmatched masks)."""
+    from ..obs import perf
+
+    perf.count("join_state_resorts")  # full re-sort of both sides (the
+    # legacy path the partitioned sorted runs exist to avoid)
     nl, nr = len(lk), len(rk)
     if not device_join_enabled(nl + nr) or nl == 0 or nr == 0 \
             or (lk == SENTINEL).any() or (rk == SENTINEL).any():

@@ -341,6 +341,12 @@ class Planned:
     # the visible output column, and the inner window's width — the
     # join planner fuses a self-join against this into WindowArgmax
     max_of: Optional[Dict[str, Any]] = None
+    # set when this plan ends in an INNER equi-join: the already-keyed
+    # side streams, their visible specs, and per-key-slot sets of
+    # joined-schema column names carrying the key's value — a following
+    # cascaded join on the same key extends into ONE multi-way join
+    # operator instead of nesting (no pairwise intermediates)
+    multi_join: Optional[Dict[str, Any]] = None
 
 
 class Planner:
@@ -1675,6 +1681,12 @@ class Planner:
         if out is None and not window_join and kind == JoinType.INNER:
             out = self._try_raw_argmax_fusion(left, right, pairs, rcols,
                                               where)
+        mw_sides: Optional[Dict[str, Any]] = None  # cascade metadata
+        if out is None and kind == JoinType.INNER:
+            mw = self._try_multiway_extend(left, right, pairs, rcols,
+                                           window_join)
+            if mw is not None:
+                out, mw_sides = mw
         if out is None:
             # numeric join keys normalize to float32 so that e.g. an
             # int64 COUNT equi-joins against a float aggregate (both
@@ -1729,6 +1741,8 @@ class Planner:
                 out = lstream.join_with_expiration(
                     rstream, DEFAULT_JOIN_TTL, DEFAULT_JOIN_TTL, kind,
                     lspec, rspec, name=f"join_{self._next_id()}")
+            if kind == JoinType.INNER and self._multiway_enabled():
+                mw_sides = {"sides": [(lstream, lspec), (rstream, rspec)]}
 
         schema = Schema(aliases=left.schema.aliases | right.schema.aliases)
         for c in lcols:
@@ -1770,7 +1784,142 @@ class Planner:
         # TTL'd outer joins emit __op retraction rows (windowed outer joins
         # are append-only: each window fires once, so no retractions)
         outer = kind in (JoinType.LEFT, JoinType.RIGHT, JoinType.FULL)
-        return Planned(out, schema, updating=(outer and not window_join))
+        planned = Planned(out, schema, updating=(outer and not window_join))
+        if mw_sides is not None:
+            # record cascade metadata: per key slot, the joined-schema
+            # column names whose value equals that key (either side's
+            # source column when it is a plain reference) — a later
+            # `... JOIN C ON <one of these> = C.x` extends in place
+            base = mw_sides.get("base_equiv")
+            equiv: List[Any] = ([set(s) if s != "__window__" else s
+                                 for s in base] if base is not None
+                                else [set() for _ in pairs])
+            slot_of = mw_sides.get("slot_of") or {
+                j: j for j in range(len(pairs))}
+            for j, (le, re_) in enumerate(pairs):
+                i = slot_of[j]
+                if (self._is_window_ref(le, left.schema)
+                        and self._is_window_ref(re_, right.schema)):
+                    equiv[i] = "__window__"
+                    continue
+                if equiv[i] == "__window__":
+                    continue
+                if isinstance(le, ColumnRef):
+                    try:
+                        tag, phys = left.schema.resolve(le, record=False)
+                        if tag == "col":
+                            equiv[i].add(phys)
+                    except SqlCompileError:
+                        pass
+                if isinstance(re_, ColumnRef):
+                    try:
+                        tag, phys = right.schema.resolve(re_, record=False)
+                        if tag == "col":
+                            equiv[i].add(rename.get(phys, phys))
+                    except SqlCompileError:
+                        pass
+            planned.multi_join = {
+                "sides": mw_sides["sides"],
+                "window": window_join,
+                "equiv": equiv,
+                "n_keys": len(equiv),
+            }
+        return planned
+
+    @staticmethod
+    def _multiway_enabled() -> bool:
+        import os
+
+        return os.environ.get("ARROYO_MULTIWAY", "1") not in (
+            "0", "off", "false")
+
+    def _try_multiway_extend(self, left: Planned, right: Planned,
+                             pairs: List[Tuple[Expr, Expr]],
+                             rcols: List[str], window_join: bool):
+        """Rewrite ``(A JOIN B ON k) JOIN C ON k`` — a cascade of INNER
+        equi-joins sharing one key — into ONE multi-way join operator
+        that probes every side per fire ("Streaming SQL Multi-Way Join
+        Method for Long State Streams", PAPERS.md).  The nested plan
+        materializes |A⋈B| intermediate rows, re-keys and re-buffers
+        them, and probes C against that; the N-ary operator expands the
+        per-key cross product across all sides directly, so the pairwise
+        intermediate never exists.
+
+        Extends only a directly nested join whose Planned carries
+        ``multi_join`` metadata, when every ON pair's left expr is a
+        plain reference to a recorded key-equivalent column (same key,
+        same windowing).  Every bail returns None — a missed
+        optimization, never a wrong plan."""
+        if not self._multiway_enabled():
+            return None
+        mj = left.multi_join
+        if mj is None or mj["window"] != window_join or right.updating:
+            return None
+        if len(pairs) != mj["n_keys"] or len(mj["sides"]) >= 8:
+            return None
+        equiv = mj["equiv"]
+        slot_of: Dict[int, int] = {}
+        used: set = set()
+        rexpr_by_slot: Dict[int, Expr] = {}
+        for j, (le, re_) in enumerate(pairs):
+            win = (self._is_window_ref(le, left.schema)
+                   and self._is_window_ref(re_, right.schema))
+            target = None
+            if win:
+                for i, eq in enumerate(equiv):
+                    if eq == "__window__" and i not in used:
+                        target = i
+                        break
+            elif isinstance(le, ColumnRef):
+                try:
+                    tag, phys = left.schema.resolve(le, record=False)
+                except SqlCompileError:
+                    return None
+                if tag != "col":
+                    return None
+                for i, eq in enumerate(equiv):
+                    if eq != "__window__" and phys in eq \
+                            and i not in used:
+                        target = i
+                        break
+            if target is None:
+                return None
+            used.add(target)
+            slot_of[j] = target
+            rexpr_by_slot[target] = (ColumnRef("window_end") if win
+                                     else re_)
+        if len(used) != len(equiv):
+            return None
+        # the new side gets its own key map (slot order) + keying, same
+        # as the pairwise path would have built
+        n_keys = len(equiv)
+        try:
+            rpre = [(f"__jk{i}", self._normalize_key(
+                compile_scalar(rexpr_by_slot[i], right.schema)))
+                for i in range(n_keys)]
+        except SqlCompileError:
+            return None
+        jks = [f"__jk{i}" for i in range(n_keys)]
+        if all(eq == "__window__" for eq in equiv):
+            rstream = right.stream.map(
+                _zero_nonce_fn(_wrap_record(rpre, rcols)),
+                name=f"join_rkey_{self._next_id()}")
+        else:
+            rstream = right.stream.udf(
+                _null_key_nonce_fn(_wrap_record(rpre, rcols), jks),
+                name=f"join_rkey_{self._next_id()}")
+        rstream = rstream.key_by(*(jks + ["__jknonce"]))
+        rspec = tuple((c, right.schema.columns[c]) for c in rcols)
+        sides = list(mj["sides"]) + [(rstream, rspec)]
+        streams = [s for s, _spec in sides]
+        specs = tuple(spec for _s, spec in sides)
+        out = streams[0].multi_way_join(
+            streams[1:],
+            typ=InstantWindow() if window_join else None,
+            ttl_micros=DEFAULT_JOIN_TTL, side_cols=specs,
+            name=f"multi_join_{self._next_id()}")
+        return out, {"sides": sides, "slot_of": slot_of,
+                     "base_equiv": equiv}
 
     def _try_argmax_fusion(self, left: Planned, right: Planned,
                            pairs: List[Tuple[Expr, Expr]],

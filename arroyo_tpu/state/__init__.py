@@ -1,0 +1,44 @@
+"""State layer: typed tables, checkpoint backends, and the
+partition-adaptive join state.
+
+Import surface:
+
+* :class:`~arroyo_tpu.state.store.StateStore` — the operator facade
+* table classes — :mod:`arroyo_tpu.state.tables`
+* :class:`~arroyo_tpu.state.join_state.PartitionedJoinBuffer` — join
+  sides' incrementally sorted, hot/cold-partitioned state (lazy:
+  ``join_state`` pulls in the obs layer, which must not load while
+  ``engine.operator`` is still importing ``state.tables``)
+"""
+
+from .tables import (  # noqa: F401
+    BatchBuffer,
+    DeviceTable,
+    GlobalKeyedState,
+    KeyTimeMultiMap,
+    KeyedState,
+    TableDescriptor,
+    TableType,
+    TimeKeyMap,
+)
+
+_LAZY = {
+    "StateStore": ("arroyo_tpu.state.store", "StateStore"),
+    "PartitionedJoinBuffer": ("arroyo_tpu.state.join_state",
+                              "PartitionedJoinBuffer"),
+    "make_join_buffer": ("arroyo_tpu.state.join_state",
+                         "make_join_buffer"),
+    "join_partitions": ("arroyo_tpu.state.join_state", "join_partitions"),
+    "partitioned_join_enabled": ("arroyo_tpu.state.join_state",
+                                 "partitioned_join_enabled"),
+}
+
+
+def __getattr__(name):
+    entry = _LAZY.get(name)
+    if entry is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(entry[0]), entry[1])

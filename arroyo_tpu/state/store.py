@@ -101,6 +101,42 @@ class StateStore:
         return self.register(TableDescriptor(name, TableType.BATCH_BUFFER, desc,
                                              retention_micros))
 
+    def get_join_buffer(self, name: str, desc: str = "",
+                        retention_micros: int = 0,
+                        force_partitioned: bool = False) -> BatchBuffer:
+        """Join-side buffer: partition-adaptive sorted-run state
+        (state/join_state.py) unless ARROYO_JOIN_STATE=legacy.  Both
+        layouts checkpoint as the same BATCH_BUFFER table form, so
+        epochs restore across layout changes (and across rescale —
+        restore filters the snapshot batch by key range).
+        ``force_partitioned`` is for operators whose probe path requires
+        sorted runs (the multi-way join)."""
+        from .join_state import (
+            PartitionedJoinBuffer,
+            partitioned_join_enabled,
+        )
+
+        want_partitioned = force_partitioned or partitioned_join_enabled()
+        existing = self.tables.get(name)
+        if existing is not None:
+            if want_partitioned and type(existing) is BatchBuffer:
+                # Operator.open() pre-registered (and possibly restored
+                # into) a flat buffer before on_start could choose the
+                # layout: upgrade in place, carrying the restored rows
+                table = PartitionedJoinBuffer()
+                table.restore_batch(existing.snapshot_batch())
+                self.tables[name] = table
+                return table
+            return existing
+        descriptor = TableDescriptor(name, TableType.BATCH_BUFFER, desc,
+                                     retention_micros)
+        self.descriptors[name] = descriptor
+        table = (PartitionedJoinBuffer() if want_partitioned
+                 else BatchBuffer())
+        self.tables[name] = table
+        self._maybe_restore(name, table)
+        return table
+
     def note_delete(self, table: str, key: Any) -> None:
         """Record a key tombstone for the next checkpoint (DataOperation::DeleteKey)."""
         self._pending_deletes.setdefault(table, []).append(key)

@@ -203,8 +203,14 @@ def test_chained_members_keep_flight_recorder_attribution(monkeypatch):
 
 def test_expression_fusion_reduces_dispatches(monkeypatch):
     """map→map→(filter) chains jit-compose: fewer kernel dispatches per
-    run than the unchained topology over identical data."""
+    run than the unchained topology over identical data.  Coalescing is
+    pinned OFF: with it on, both topologies collapse to a handful of
+    merged batches and the margin shrinks to ±1 dispatch — one stray
+    async dispatch from a neighboring test then flips the comparison
+    (observed flake at (6, 5))."""
     from arroyo_tpu.obs import perf
+
+    monkeypatch.setenv("ARROYO_COALESCE", "0")
 
     def dispatches(chain):
         monkeypatch.setenv("ARROYO_CHAIN", chain)

@@ -267,6 +267,7 @@ function renderDag(g, overlay) {
       <text x="10" y="40" fill="#7a8794">${esc(n.description)
         .slice(0, 26)} ×${n.parallelism}</text>`;
     if (overlay) out += `
+      <title id="ov_tt_${k}"></title>
       <text id="ov_rate_${k}" x="${W - 8}" y="16" text-anchor="end"
         fill="#4aa3ff"></text>
       <text id="ov_lag_${k}" x="${W - 8}" y="34" text-anchor="end"
@@ -289,18 +290,45 @@ function fmtLag(s) {
   return 'lag ' + (s * 1000).toFixed(0) + 'ms';
 }
 
-function updateDagOverlay(rows, rollups) {
+function updateDagOverlay(rows, rollups, profiles) {
   // rollups: controller-aggregated per-operator {event_time_lag,
   // watermark_lag, backpressure} — colors each node by the worse of its
-  // backpressure and lag so the hot operator is visible at a glance
+  // backpressure and lag so the hot operator is visible at a glance.
+  // profiles (phase profiler, when armed): node FILL tinted by the
+  // operator's host-time share and the measured phase breakdown on
+  // hover — "where does the time go", per node, at a glance
   const W = 210, H = 54;
   rollups = rollups || {};
+  profiles = profiles || {};
   for (const r_ of rows) {
     const k = opKey(r_.op);
     const rateEl = $('ov_rate_' + k);
     if (!rateEl) continue;
     rateEl.textContent = fmtRate(r_.rate);
     const ru = rollups[r_.op] || {};
+    const pr = profiles[r_.op];
+    const box_ = $('ov_box_' + k);
+    if (pr && box_ && pr.host_share != null) {
+      // host-dominated nodes glow warm (the "kill the host path"
+      // targets); device-dominated ones stay cool
+      const hs = pr.host_share;
+      box_.setAttribute('fill', hs > 0.9 ? '#3a1b1b'
+                              : hs > 0.6 ? '#33241a' : '#16202a');
+      const tt = $('ov_tt_' + k);
+      if (tt) {
+        const ph = Object.entries(pr.phases || {})
+          .sort((a, b) => b[1] - a[1])
+          .map(([n, s]) => `${n}: ${(s * 1e3).toFixed(1)}ms`);
+        const wt = Object.entries(pr.waits || {})
+          .sort((a, b) => b[1] - a[1])
+          .map(([n, s]) => `${n} (wait): ${(s * 1e3).toFixed(1)}ms`);
+        tt.textContent =
+          `host ${(hs * 100).toFixed(0)}% · ` +
+          `${(pr.host_seconds * 1e3).toFixed(1)}ms host / ` +
+          `${(pr.device_seconds * 1e3).toFixed(1)}ms device\\n` +
+          ph.concat(wt).join('\\n');
+      }
+    }
     const bpv = ru.backpressure != null ? ru.backpressure : r_.bp;
     const lag = ru.event_time_lag != null ? ru.event_time_lag
                                           : ru.watermark_lag;
@@ -456,6 +484,9 @@ async function pollJob() {
   const rollupsP = fetch(
     `/v1/pipelines/${pid}/jobs/${jid}/operator_rollups`)
     .catch(() => null);
+  const profilesP = fetch(
+    `/v1/pipelines/${pid}/jobs/${jid}/profile_rollups`)
+    .catch(() => null);
   const r = await fetch(
     `/v1/pipelines/${pid}/jobs/${jid}/operator_metric_groups`);
   if (!r.ok) return;
@@ -506,7 +537,15 @@ async function pollJob() {
     if (ro && ro.ok) for (const g of (await ro.json()).data || [])
       rollups[g.operator_id] = g;
   } catch (e) { /* rollups are best-effort */ }
-  updateDagOverlay(rows, rollups);
+  // phase-profile rollups (only populated with ARROYO_PROFILE armed):
+  // host-time-share node fill + phase breakdown on hover
+  let profiles = {};
+  try {
+    const po = await profilesP;
+    if (po && po.ok) for (const g of (await po.json()).operators || [])
+      profiles[g.operator_id] = g;
+  } catch (e) { /* profiles are best-effort */ }
+  updateDagOverlay(rows, rollups, profiles);
 
   const ck = await fetch(
     `/v1/pipelines/${pid}/jobs/${jid}/checkpoints`);

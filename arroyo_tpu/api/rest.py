@@ -644,6 +644,31 @@ class ApiServer:
                 data, source = ops, "local_registry"
             return {"data": data, "source": source}
 
+        @r.get("/v1/pipelines/{pid}/jobs/{jid}/profile_rollups")
+        async def profile_rollups(req: Request):
+            """Phase-profile rollups (obs/profiler.py): per-operator
+            measured phase/wait seconds, host vs device split, and the
+            worker event-loop watchdog numbers — aggregated from the
+            same heartbeat snapshots as operator_rollups.  Empty unless
+            a worker runs with the profiler armed (ARROYO_PROFILE=1)."""
+            jid = req.params["jid"]
+            data = self.controller.job_profile_rollup(jid)
+            source = "heartbeat"
+            if not data["operators"] and not data["worker"]:
+                # embedded/LocalRunner fallback: shape the in-process
+                # registry + profiler summary the same way
+                from ..obs.metrics import job_operator_summary
+
+                rows = self.controller.rollup_from_summary(
+                    job_operator_summary(jid))
+                data = self.controller.profile_shape(rows)
+                if (not data["operators"]
+                        and jid not in self.controller.jobs):
+                    raise HttpError(404, "no such job")
+                source = "local_registry"
+            data["source"] = source
+            return data
+
         @r.get("/v1/pipelines/{pid}/jobs/{jid}/metrics_history")
         async def metrics_history(req: Request):
             """Persistent per-operator history (the API's sampler writes

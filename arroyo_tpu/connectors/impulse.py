@@ -65,7 +65,12 @@ class ImpulseSource(SourceOperator):
                                  else now_micros()))
 
         runner = getattr(ctx, "_runner", None)
+        from ..obs import profiler
+
+        prof = profiler.active()
         while total is None or self.counter < total:
+            frame = (prof.begin(ctx.task_info.operator_id, "source_decode")
+                     if prof is not None else None)
             n = batch_size if total is None else min(batch_size, total - self.counter)
             counters = np.arange(self.counter, self.counter + n, dtype=np.uint64)
             if interval:
@@ -76,6 +81,8 @@ class ImpulseSource(SourceOperator):
                 "counter": counters,
                 "subtask_index": np.full(n, ctx.task_info.task_index, dtype=np.uint64),
             })
+            if frame is not None:
+                prof.end(frame)
             await ctx.collect(batch)
             self.counter += n
             state.insert(ctx.task_info.task_index,

@@ -393,6 +393,10 @@ class KafkaSource(SourceOperator):
         total = 0
         idle_spins = 0
         bulk = getattr(broker, "fetch_values", None)
+        from ..obs import profiler
+
+        prof = profiler.active()
+        op_id = ctx.task_info.operator_id
         while True:
             got = 0
             for p in my_parts:
@@ -411,7 +415,17 @@ class KafkaSource(SourceOperator):
                 if vals:
                     got += len(vals)
                     total += len(vals)
-                    await ctx.collect(self.fmt.batch(vals))
+                    if prof is None:
+                        b = self.fmt.batch(vals)
+                    else:
+                        # format decode (json -> columns) is the ingest
+                        # host cost the phase table must attribute
+                        frame = prof.begin(op_id, "source_decode")
+                        try:
+                            b = self.fmt.batch(vals)
+                        finally:
+                            prof.end(frame)
+                    await ctx.collect(b)
                     offsets[p] = last + 1
                     state.insert(p, last)
             if runner is not None:

@@ -459,7 +459,10 @@ class NexmarkSource(SourceOperator):
                     "with the current batch size")
         runner = getattr(ctx, "_runner", None)
         wall_base = _time.monotonic() - (gen.inter_event_delay * count) / 1e6
-        from ..obs import perf
+        from ..obs import perf, profiler
+
+        prof = profiler.active()
+        op_id = ctx.task_info.operator_id
 
         # anchors for the bench's end-to-end latency math: event with
         # time T is emitted at wall_base + (T - base_time)/1e6
@@ -474,11 +477,19 @@ class NexmarkSource(SourceOperator):
         loop = asyncio.get_running_loop()
 
         def gen_next():
+            # executor thread: generation/decode cost lands in the
+            # `source_decode` phase directly (no nesting off-loop) —
+            # the measured half of "the host path" on ingest
+            t0 = _time.perf_counter() if prof is not None else 0.0
             b, nums = gen.next_batch(batch_size)
             # RNG states are captured WITH the count at generation time,
             # so a barrier between emit and prefetch checkpoints a
             # consistent (count, stream-position) pair
-            return b, nums, gen.events_so_far, gen.snapshot_rng_state()
+            out = b, nums, gen.events_so_far, gen.snapshot_rng_state()
+            if prof is not None:
+                prof.add(op_id, "source_decode",
+                         _time.perf_counter() - t0)
+            return out
 
         # emission log for the latency bench: (cummax event time, wall) per
         # batch — latency is then measured against when the watermark-

@@ -78,12 +78,21 @@ class SingleFileSource(SourceOperator):
         # while it loads — read it off the event loop
         lines = await asyncio.get_event_loop().run_in_executor(
             None, _read_lines)
+        from ..obs import profiler
+
+        prof = profiler.active()
         i = start_line
         while i < len(lines):
+            frame = (prof.begin(ctx.task_info.operator_id, "source_decode")
+                     if prof is not None else None)
             chunk = lines[i:i + batch_size]
             rows = [json.loads(l) for l in chunk if l.strip()]
-            if rows:
-                await ctx.collect(_rows_to_batch(rows, self.cfg.timestamp_field))
+            batch = (_rows_to_batch(rows, self.cfg.timestamp_field)
+                     if rows else None)
+            if frame is not None:
+                prof.end(frame)
+            if batch is not None:
+                await ctx.collect(batch)
             i += len(chunk)
             state.insert("lines_read", i)
             if runner is not None:
@@ -150,6 +159,11 @@ class SingleFileSink(Operator):
             "offset", self._file.tell())
 
     async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
+        from ..obs import profiler
+
+        prof = profiler.active()
+        frame = (prof.begin(ctx.task_info.operator_id, "emit_encode")
+                 if prof is not None else None)
         names = list(batch.columns)
         cols = [batch.columns[n] for n in names]
         # one write per batch: line buffering then flushes once here, so
@@ -158,6 +172,8 @@ class SingleFileSink(Operator):
             json.dumps({n: c[i] for n, c in zip(names, cols)},
                        default=_json_default) + "\n"
             for i in range(len(batch))))
+        if frame is not None:
+            prof.end(frame)
 
     async def handle_watermark(self, watermark: int, ctx: Context) -> None:
         self._file.flush()

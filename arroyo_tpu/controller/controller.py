@@ -713,6 +713,16 @@ class ControllerServer:
             agg[key] = agg.get(key, 0.0) + get(cur, key)
         for key in ("tx_queue_size", "tx_queue_rem"):
             agg[key] = agg.get(key, 0.0) + cur.get(key, 0.0)
+        for k, v in (cur or {}).items():
+            # phase profiler ride-alongs (obs/profiler.py): phase/wait
+            # seconds and stall counts sum across workers; the event-loop
+            # lag quantile gauges take the worst worker — one stalled
+            # loop is the signal, averaging would hide it
+            if k.startswith(("phase_seconds.", "wait_seconds.")) \
+                    or k.startswith("event_loop_stalls"):
+                agg[k] = agg.get(k, 0.0) + v
+            elif k.startswith("event_loop_lag"):
+                agg[k] = max(agg.get(k, 0.0), v)
         # per-subtask queue pairs → worst-subtask backpressure (same
         # rationale as the lag families below: the summed gauges dilute
         # one saturated subtask among idle siblings)
@@ -819,6 +829,75 @@ class ControllerServer:
             self._finalize_rollup(
                 agg, round(now - oldest, 1) if oldest else None)
         return sorted(ops.values(), key=lambda g: g["operator_id"])
+
+    @staticmethod
+    def profile_shape(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """Reshape job-rollup rows into the profile view the REST
+        ``profile_rollups`` route and the console DAG hover serve:
+        per-operator phase/wait second maps plus host/device seconds
+        (device = the always-on kernel dispatch counter), and the
+        worker-level event-loop watchdog numbers aggregated under the
+        ``__worker__`` pseudo-operator."""
+        ops: List[Dict[str, Any]] = []
+        worker: Dict[str, Any] = {}
+        for row in rows:
+            op = row.get("operator_id", "")
+            phases = {k[len("phase_seconds."):]: round(v, 6)
+                      for k, v in row.items()
+                      if k.startswith("phase_seconds.")}
+            waits = {k[len("wait_seconds."):]: round(v, 6)
+                     for k, v in row.items()
+                     if k.startswith("wait_seconds.")}
+            if op == "__worker__":
+                worker = {
+                    "event_loop_lag_p50_secs": row.get(
+                        "event_loop_lag_seconds_p50", 0.0),
+                    "event_loop_lag_p99_secs": row.get(
+                        "event_loop_lag_seconds_p99", 0.0),
+                    "event_loop_stalls": row.get(
+                        "event_loop_stalls_total",
+                        row.get("event_loop_stalls", 0.0)),
+                }
+                continue
+            if not phases and not waits:
+                continue
+            # host vs device split from the profiler's OWN phase table:
+            # dispatch/device_execute are the kernel-bound spans, every
+            # other phase is pure host envelope.  (kernel_seconds is the
+            # same non-blocking dispatch wall as the `dispatch` phase —
+            # re-reading it as "device" would count that span twice; it
+            # only serves as the fallback when no dispatch phase was
+            # recorded, e.g. a legacy worker without the profiler's
+            # timed_device hook.)
+            device = sum(phases.get(p, 0.0)
+                         for p in ("dispatch", "device_execute"))
+            if device == 0.0:
+                device = row.get("kernel_seconds", 0.0)
+            host = sum(phases.values()) - sum(
+                phases.get(p, 0.0) for p in ("dispatch",
+                                             "device_execute"))
+            ops.append({
+                "operator_id": op,
+                "phases": phases,
+                "waits": waits,
+                "host_seconds": round(host, 6),
+                "device_seconds": round(device, 6),
+                # of this operator's measured time, how much was host
+                # envelope vs kernel-bound dispatch — the per-node
+                # coloring the console DAG uses
+                "host_share": round(host / (host + device), 4)
+                if host + device > 0 else None,
+            })
+        total = sum(o["host_seconds"] for o in ops)
+        for o in ops:
+            o["job_share"] = (round(o["host_seconds"] / total, 4)
+                              if total > 0 else 0.0)
+        return {"operators": ops, "worker": worker}
+
+    def job_profile_rollup(self, job_id: str) -> Dict[str, Any]:
+        """Phase-profile view of one job's heartbeat rollups (empty
+        ``operators`` when no worker has a profiler armed)."""
+        return self.profile_shape(self.job_rollup(job_id))
 
     async def _task_started(self, req: Dict) -> Dict:
         return {}

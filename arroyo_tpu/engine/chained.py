@@ -39,7 +39,7 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..graph.logical import ColumnExpr, ExprReturnType
-from ..obs import perf
+from ..obs import perf, profiler
 from ..types import (
     Batch,
     CheckpointBarrier,
@@ -259,11 +259,18 @@ class ChainedOperator(Operator):
         # members this call recursed into (collect is synchronous)
         self._lat_stack.append(0.0)
         token = perf.set_active_task(self._accs[idxs[0]])
+        prof = profiler.active()
+        frame = (prof.begin(self.infos[idxs[0]].operator_id, "proc")
+                 if prof is not None else None)
         t0 = _time.perf_counter()
         try:
             await step_op.process_batch(
                 batch, self.ctxs[ectx_idx], side if start == 0 else 0)
         finally:
+            if frame is not None:
+                # nested member frames subtract automatically, so each
+                # member's `proc` phase is exclusive like its latency
+                prof.end(frame)
             perf.reset_active_task(token)
             inclusive = _time.perf_counter() - t0
             child = self._lat_stack.pop()
@@ -311,8 +318,15 @@ class ChainedOperator(Operator):
                     and 0 < advanced < int(MAX_TIMESTAMP) - 1):
                 mctx.metrics.watermark_lag.observe(
                     max((now_micros() - advanced) / 1e6, 0.0))
-            for t, key, payload in mctx.timers.fire(advanced):
-                await self.members[i].handle_timer(t, key, payload, mctx)
-            await self.members[i].handle_watermark(advanced, mctx)
+            prof = profiler.active()
+            frame = (prof.begin(self.infos[i].operator_id, "watermark")
+                     if prof is not None else None)
+            try:
+                for t, key, payload in mctx.timers.fire(advanced):
+                    await self.members[i].handle_timer(t, key, payload, mctx)
+                await self.members[i].handle_watermark(advanced, mctx)
+            finally:
+                if frame is not None:
+                    prof.end(frame)
         elif wm.is_idle and mctx.watermarks.all_idle():
             await mctx.broadcast(Message.wm(Watermark.idle()))

@@ -66,10 +66,15 @@ class BatchCoalescer:
     control messages / on linger expiry."""
 
     def __init__(self, target: int, linger_secs: float,
-                 histogram: Optional[Any] = None):
+                 histogram: Optional[Any] = None,
+                 prof: Optional[Any] = None, prof_op: str = ""):
         self.target = max(int(target), 1)
         self.linger = max(float(linger_secs), 0.0)
         self.histogram = histogram  # batches merged per flush
+        # phase profiler (obs/profiler.py): None unless armed — the
+        # merge concat is then charged to the `coalesce_merge` phase
+        self.prof = prof
+        self.prof_op = prof_op
         self._bufs: Dict[int, _SideBuffer] = {}  # side -> buffer (ordered)
         self._deadline: Optional[float] = None
 
@@ -87,7 +92,13 @@ class BatchCoalescer:
             self.histogram.observe(len(buf.batches))
         if len(buf.batches) == 1:
             return buf.batches[0]
-        return Batch.concat(buf.batches)
+        if self.prof is None:
+            return Batch.concat(buf.batches)
+        frame = self.prof.begin(self.prof_op, "coalesce_merge")
+        try:
+            return Batch.concat(buf.batches)
+        finally:
+            self.prof.end(frame)
 
     def add(self, side: int, batch: Batch) -> List[Tuple[int, Batch]]:
         """Buffer one incoming batch; returns ``[(side, merged_batch)]``

@@ -174,9 +174,16 @@ class Collector:
     """
 
     def __init__(self, edge_groups: List[List[OutQueue]],
-                 metrics: Optional[Any] = None):
+                 metrics: Optional[Any] = None, op_id: str = ""):
+        from ..obs import profiler
+
         self.edge_groups = edge_groups
         self.metrics = metrics
+        self.op_id = op_id
+        # phase profiler: None unless armed at engine build — partition/
+        # route CPU is then charged to `shuffle_prep`, enqueue awaits to
+        # the overlapping `send_wait` (backpressure) wait phase
+        self.prof = profiler.active()
         self._rr = [0] * len(edge_groups)  # round-robin cursor per group
         self._local_qs = [q.queue for g in edge_groups for q in g
                           if q.queue is not None]
@@ -196,44 +203,62 @@ class Collector:
             return
         blocked = 0.0
         send = None
-        if self.metrics is not None:
-            self.metrics.messages_sent.inc(len(batch))
-            self._update_queue_gauges()
+        prof = self.prof
+        if self.metrics is not None or prof is not None:
+            if self.metrics is not None:
+                self.metrics.messages_sent.inc(len(batch))
+                self._update_queue_gauges()
 
             async def send(q, msg):
                 # time only the enqueue await: a full downstream queue
                 # parks the coroutine here, so the accumulated wait is
                 # genuine backpressure — the partition/select CPU between
                 # sends is this operator's own fan-out cost, not a
-                # consumer stall.  Metrics-off runs keep the direct
-                # q.send awaits below: no closure, no clock reads
+                # consumer stall.  Metrics-off/profiler-off runs keep
+                # the direct q.send awaits below: no closure, no clocks.
+                # With the profiler armed the await is a `send_wait`
+                # wait child, so the enclosing shuffle_prep/proc work
+                # phases stay exclusive of any task interleaved here
                 nonlocal blocked
+                frame = (prof.begin(self.op_id, "send_wait", wait=True)
+                         if prof is not None else None)
                 t0 = _time.perf_counter()
-                await q.send(msg)
+                try:
+                    await q.send(msg)
+                finally:
+                    if frame is not None:
+                        prof.end(frame)
                 blocked += _time.perf_counter() - t0
 
-        for gi, group in enumerate(self.edge_groups):
-            n = len(group)
-            if n == 1:
-                q, m = group[0], Message.record(batch)
-                await (send(q, m) if send else q.send(m))
-            elif batch.key_hash is None:
-                # unkeyed fan-out (forward rebalance): round-robin whole batches
-                q, m = group[self._rr[gi] % n], Message.record(batch)
-                await (send(q, m) if send else q.send(m))
-                self._rr[gi] += 1
-            else:
-                # one O(n) native pass: dest + stable order + bounds
-                from ..native import partition_route
+        pframe = (prof.begin(self.op_id, "shuffle_prep")
+                  if prof is not None else None)
+        try:
+            for gi, group in enumerate(self.edge_groups):
+                n = len(group)
+                if n == 1:
+                    q, m = group[0], Message.record(batch)
+                    await (send(q, m) if send else q.send(m))
+                elif batch.key_hash is None:
+                    # unkeyed fan-out (forward rebalance): round-robin
+                    # whole batches
+                    q, m = group[self._rr[gi] % n], Message.record(batch)
+                    await (send(q, m) if send else q.send(m))
+                    self._rr[gi] += 1
+                else:
+                    # one O(n) native pass: dest + stable order + bounds
+                    from ..native import partition_route
 
-                _, order, bounds = partition_route(batch.key_hash, n)
-                for i in range(n):
-                    lo, hi = bounds[i], bounds[i + 1]
-                    if hi > lo:
-                        q = group[i]
-                        m = Message.record(batch.select(order[lo:hi]))
-                        await (send(q, m) if send else q.send(m))
-        if blocked > 1e-5:
+                    _, order, bounds = partition_route(batch.key_hash, n)
+                    for i in range(n):
+                        lo, hi = bounds[i], bounds[i + 1]
+                        if hi > lo:
+                            q = group[i]
+                            m = Message.record(batch.select(order[lo:hi]))
+                            await (send(q, m) if send else q.send(m))
+        finally:
+            if pframe is not None:
+                prof.end(pframe)
+        if blocked > 1e-5 and self.metrics is not None:
             self.metrics.backpressure_time.inc(blocked)
 
     async def broadcast(self, msg: Message) -> None:

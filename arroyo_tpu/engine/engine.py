@@ -146,6 +146,13 @@ class Engine:
 
         sanitizer = maybe_sanitizer(self.job_id)
         self.sanitizer = sanitizer
+        # phase profiler (obs/profiler.py): armed by ARROYO_PROFILE=1 or
+        # an explicit profiler.arm() (bench, tests) — must happen before
+        # subtask construction so Collectors/coalescers capture it; the
+        # hook sites cost one `is not None` test when disarmed
+        from ..obs import profiler as _profiler
+
+        prof = _profiler.ensure_armed(self.job_id)
         g = self.program.graph
         # operator chaining (graph/chaining.py): maximal linear runs of
         # same-parallelism forward-edge operators execute inside ONE
@@ -250,7 +257,8 @@ class Engine:
                       for ti in infos]
             for st in stores:
                 st.sanitizer = sanitizer
-            collector = Collector(edge_groups, metrics_list[-1])
+            collector = Collector(edge_groups, metrics_list[-1],
+                                  op_id=tail_id)
             if len(ms) == 1:
                 operator = build_operator(head_node.operator)
                 rwm = (stores[0].restore_watermark()
@@ -314,6 +322,10 @@ class Engine:
 
         for handle in self.subtasks.values():
             handle.task = asyncio.ensure_future(handle.runner.start())
+        if prof is not None:
+            # event-loop stall watchdog: one ticker per loop (idempotent),
+            # sampler thread started lazily; the task dies with its loop
+            prof.watchdog.ensure_ticker()
         return RunningEngine(self)
 
 

@@ -23,7 +23,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..obs import perf, tracing
+from ..obs import perf, profiler, tracing
 from ..state.store import StateStore
 from ..types import (
     CheckpointBarrier,
@@ -96,6 +96,9 @@ class TaskRunner:
         self.control_rx = control_rx
         self.control_tx = control_tx
         self.merged: asyncio.Queue = asyncio.Queue(maxsize=len(inputs) * 4 + 16)
+        # phase profiler (obs/profiler.py): None unless armed at engine
+        # build — every hook site guards on a local `is not None`
+        self._prof = profiler.active()
         self.pumps: List[_Pump] = []
         self.finished = asyncio.Event()
         self.failed: Optional[BaseException] = None
@@ -209,6 +212,8 @@ class TaskRunner:
         coal = self._make_coalescer()
         san = self.sanitizer
         tid = self.task_info.task_id
+        prof = self._prof
+        op_id = self.task_info.operator_id
         try:
             while ended < n_inputs:
                 if get_merged is None or get_merged.done():
@@ -224,10 +229,19 @@ class TaskRunner:
                 done, _ = await asyncio.wait(
                     [get_merged, get_control],
                     return_when=asyncio.FIRST_COMPLETED, timeout=timeout)
-                if metrics is not None:
+                if metrics is not None or prof is not None:
                     # time this loop sat waiting for input (starvation —
                     # the upstream-is-slow half of backpressure analysis)
-                    metrics.queue_wait.observe(_time.perf_counter() - wait_t0)
+                    waited = _time.perf_counter() - wait_t0
+                    if metrics is not None:
+                        metrics.queue_wait.observe(waited)
+                    if prof is not None:
+                        # a wait bounded by the coalescer's linger
+                        # deadline is latency the coalescer added, not
+                        # upstream starvation — attribute it apart
+                        prof.add(op_id, "coalesce_wait" if timeout
+                                 is not None else "queue_wait",
+                                 waited, wait=True)
                 if (coal is not None and coal.pending
                         and _time.monotonic() >= coal.deadline):
                     # linger expired — flush whether or not new input
@@ -359,7 +373,8 @@ class TaskRunner:
         hist = (self.ctx.metrics.coalesce_batches
                 if self.ctx.metrics is not None else None)
         return BatchCoalescer(target, cfg.coalesce_linger_micros / 1e6,
-                              hist)
+                              hist, prof=self._prof,
+                              prof_op=self.task_info.operator_id)
 
     async def _process_record(self, batch, side: int) -> None:
         """Run one (possibly coalesced) record batch through the
@@ -368,8 +383,10 @@ class TaskRunner:
         (ChainedOperator)."""
         metrics = self.ctx.metrics
         if metrics is None or self.operator.own_batch_metrics:
+            # a ChainedOperator opens its own per-member `proc` phases
             await self.operator.process_batch(batch, self.ctx, side)
             return
+        prof = self._prof
         if len(batch):
             # event-time lag at this operator: processing wall clock vs
             # the freshest event in the batch.  Sentinels are excluded by
@@ -381,8 +398,14 @@ class TaskRunner:
             if 0 < ts < int(MAX_TIMESTAMP) - 1:
                 metrics.event_time_lag.observe(
                     max((now_micros() - ts) / 1e6, 0.0))
+        frame = (prof.begin(self.task_info.operator_id, "proc")
+                 if prof is not None else None)
         t0 = _time.perf_counter()
-        await self.operator.process_batch(batch, self.ctx, side)
+        try:
+            await self.operator.process_batch(batch, self.ctx, side)
+        finally:
+            if frame is not None:
+                prof.end(frame)
         metrics.batch_latency.observe(_time.perf_counter() - t0)
 
     async def _await_pending_commit(self, timeout: float = 30.0) -> None:
@@ -421,9 +444,16 @@ class TaskRunner:
             self.ctx.metrics.watermark_lag.observe(
                 max((now_micros() - wm) / 1e6, 0.0))
         # fire expired event-time timers first (macro lib.rs:738-753)
-        for time, key, payload in self.ctx.timers.fire(wm):
-            await self.operator.handle_timer(time, key, payload, self.ctx)
-        await self.operator.handle_watermark(wm, self.ctx)
+        prof = self._prof
+        frame = (prof.begin(self.task_info.operator_id, "watermark")
+                 if prof is not None else None)
+        try:
+            for time, key, payload in self.ctx.timers.fire(wm):
+                await self.operator.handle_timer(time, key, payload, self.ctx)
+            await self.operator.handle_watermark(wm, self.ctx)
+        finally:
+            if frame is not None:
+                prof.end(frame)
 
     # -- checkpoint (macro lib.rs:706-736) -------------------------------
 
@@ -439,7 +469,15 @@ class TaskRunner:
         # controller's epoch tracker expects one completion per logical
         # (operator, subtask), and per-member metadata keeps chained
         # checkpoints restorable un-chained and vice versa)
-        metadatas = await self.operator.checkpoint_state(barrier, self.ctx)
+        prof = self._prof
+        frame = (prof.begin(self.task_info.operator_id, "checkpoint")
+                 if prof is not None else None)
+        try:
+            metadatas = await self.operator.checkpoint_state(barrier,
+                                                             self.ctx)
+        finally:
+            if frame is not None:
+                prof.end(frame)
         if self.sanitizer is not None:
             # completeness: exactly one completion per distinct
             # (member, subtask) per epoch — a duplicate means two

@@ -43,6 +43,7 @@ from ..types import (
 
 logger = logging.getLogger(__name__)
 
+from ..obs import profiler  # noqa: E402
 from ..obs.metrics import (BYTES_RECV, BYTES_SENT, FLUSH_LATENCY,  # noqa: E402
                            FRAME_BYTES)
 
@@ -245,6 +246,19 @@ class NetworkManager:
             queue.put_nowait(msg)
 
     def _decode_frame(self, quad: Quad, kind: int, payload: bytes) -> Message:
+        prof = profiler.active()
+        if prof is None:
+            return self._decode_frame_inner(quad, kind, payload)
+        # receive-side Arrow decode: the egress/ingest host cost of a
+        # cross-worker edge, charged to the DESTINATION operator
+        frame = prof.begin(quad[2], "frame_decode")
+        try:
+            return self._decode_frame_inner(quad, kind, payload)
+        finally:
+            prof.end(frame)
+
+    def _decode_frame_inner(self, quad: Quad, kind: int,
+                            payload: bytes) -> Message:
         san = self.sanitizer
         if kind == KIND_DATA:
             batch, schema = _decode_batch_full(payload)
@@ -350,29 +364,48 @@ class NetworkManager:
 
         async def send(msg: Message) -> None:
             writer = self._out_writers[addr]
-            if msg.kind == MessageKind.RECORD:
-                schema, rb = _arrow_parts(msg.batch)
-                prev = state["schema"]
-                if prev is not None and schema.equals(prev,
-                                                      check_metadata=True):
-                    kind = KIND_DATA_BATCH
-                    payload = rb.serialize().to_pybytes()
+            prof = profiler.active()
+            # Arrow encode + frame write: the data-plane half of the
+            # emission-encode host cost, charged to the SOURCE operator
+            enc = (prof.begin(quad[0], "frame_encode")
+                   if prof is not None else None)
+            try:
+                if msg.kind == MessageKind.RECORD:
+                    schema, rb = _arrow_parts(msg.batch)
+                    prev = state["schema"]
+                    if prev is not None and schema.equals(
+                            prev, check_metadata=True):
+                        kind = KIND_DATA_BATCH
+                        payload = rb.serialize().to_pybytes()
+                    else:
+                        state["schema"] = schema
+                        kind, payload = KIND_DATA, _stream_bytes(rb)
                 else:
-                    state["schema"] = schema
-                    kind, payload = KIND_DATA, _stream_bytes(rb)
-            else:
-                kind, payload = encode_message(msg)
-            sent_counter.inc(len(payload))
-            frame_bytes.observe(len(payload))
-            # frames never interleave: _write_frame is one synchronous
-            # writer.write call, so no lock is needed for atomicity
-            _write_frame(writer, quad, kind, payload)
+                    kind, payload = encode_message(msg)
+                sent_counter.inc(len(payload))
+                frame_bytes.observe(len(payload))
+                # frames never interleave: _write_frame is one
+                # synchronous writer.write call, so no lock is needed
+                # for atomicity
+                _write_frame(writer, quad, kind, payload)
+            finally:
+                # an encode failure must not leak the open frame: an
+                # unclosed frame would absorb every later span on this
+                # task as its "child" and corrupt attribution
+                if enc is not None:
+                    prof.end(enc)
             transport = writer.transport
             if transport is not None:
                 high = transport.get_write_buffer_limits()[1]
                 if transport.get_write_buffer_size() >= high:
                     t0 = _time.perf_counter()
-                    await writer.drain()
+                    wfr = (prof.begin(quad[0], "net_flush", wait=True)
+                           if prof is not None else None)
+                    try:
+                        await writer.drain()
+                    finally:
+                        if wfr is not None:
+                            prof.end(wfr)
                     # socket drain: the network half of backpressure
                     flush_latency.observe(_time.perf_counter() - t0)
 

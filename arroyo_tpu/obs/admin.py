@@ -19,6 +19,8 @@ class AdminServer:
         self.service = service
         self.details_fn = details or (lambda: {})
         self.started = time.time()
+        # in-flight jax-profiler capture (POST /debug/profile start/stop)
+        self._profile_capture: Optional[Dict[str, Any]] = None
         router = Router()
 
         @router.get("/status")
@@ -75,32 +77,113 @@ class AdminServer:
                     for ts, kind, task, detail in recent_events(limit)],
             }
 
+        # phase-profiler export (obs/profiler.py): the measured phase
+        # table as pprof/flamegraph folded stacks (`job;operator;phase
+        # micros` lines — feed to flamegraph.pl / speedscope), or the
+        # full structured snapshot incl. watchdog stall stacks with
+        # ?fmt=json.  Empty/disabled until the profiler is armed
+        # (ARROYO_PROFILE=1 at engine build).
+        @router.get("/profile/phases")
+        async def profile_phases(req: Request):
+            from . import profiler
+
+            prof = profiler.active()
+            if req.query.get("fmt") == "json":
+                if prof is None:
+                    return {"enabled": False}
+                snap = prof.snapshot()
+                snap["enabled"] = True
+                # full stall stacks only here (the heartbeat rollup
+                # ships just the tails)
+                snap["watchdog"]["stall_stacks"] = [
+                    dict(s) for s in list(prof.watchdog.stalls)]
+                return snap
+            body = prof.collapsed_stacks() if prof is not None else ""
+            return Response(body=body.encode(),
+                            content_type="text/plain")
+
         # continuous-profiling hooks: the pyroscope analog
         # (arroyo-server-common/src/lib.rs:12-15, try_profile_start) is the
-        # jax profiler — one POST captures a Perfetto/XPlane trace of every
-        # device kernel + host dispatch in the window
+        # jax profiler — a POST captures a Perfetto/XPlane trace of every
+        # device kernel + host dispatch.  Two modes:
+        #   one-shot: {"seconds": 2}            (trace, sleep, stop)
+        #   start/stop: {"action": "start", "max_seconds": 60} then
+        #               {"action": "stop"}
+        # every start arms a max-duration watchdog, so a forgotten stop
+        # can no longer trace forever; the stop response returns the
+        # capture directory listing.
         @router.post("/debug/profile")
         async def profile(req: Request):
             import asyncio
 
-            import jax
-
             body = req.json() if req.body else {}
-            secs = float(body.get("seconds", 2.0))
+            action = body.get("action")
             out_dir = body.get(
                 "dir", f"/tmp/arroyo_tpu/profiles/{self.service}")
+
+            def listing(d=None):
+                files = []
+                for root, _dirs, fs in os.walk(d or out_dir):
+                    files += [os.path.join(root, f) for f in fs]
+                return sorted(files)
+
+            if action == "stop":
+                cap = self._profile_capture
+                if cap is None:
+                    return {"error": "no capture in progress"}
+                self._profile_capture = None
+                cap["watchdog"].cancel()
+                if not cap["stopped"]:
+                    cap["stopped"] = True
+                    import jax
+
+                    jax.profiler.stop_trace()
+                return {"dir": cap["dir"], "stopped": True,
+                        "auto_stopped": cap["auto_stopped"],
+                        # list where the capture was WRITTEN (its start
+                        # dir), not the stop request's default dir
+                        "files": listing(cap["dir"])[-32:],
+                        "hint": "open in perfetto.dev or tensorboard"}
+
+            if self._profile_capture is not None:
+                return {"error": "capture already in progress",
+                        "dir": self._profile_capture["dir"]}
+            import jax
+
             os.makedirs(out_dir, exist_ok=True)
+            if action == "start":
+                max_secs = min(float(body.get("max_seconds", 60.0)),
+                               600.0)
+                jax.profiler.start_trace(out_dir)
+                cap = {"dir": out_dir, "stopped": False,
+                       "auto_stopped": False}
+
+                async def auto_stop():
+                    # the forgotten-stop watchdog: bound every capture
+                    await asyncio.sleep(max_secs)
+                    if self._profile_capture is cap:
+                        self._profile_capture = None
+                        cap["stopped"] = True
+                        cap["auto_stopped"] = True
+                        jax.profiler.stop_trace()
+
+                cap["watchdog"] = asyncio.ensure_future(auto_stop())
+                self._profile_capture = cap
+                return {"dir": out_dir, "started": True,
+                        "max_seconds": max_secs}
+
+            # legacy one-shot capture (bounded as before)
+            secs = float(body.get("seconds", 2.0))
             jax.profiler.start_trace(out_dir)
             try:
                 await asyncio.sleep(min(secs, 60.0))
             finally:
                 jax.profiler.stop_trace()
-            traces = []
-            for root, _dirs, files in os.walk(out_dir):
-                traces += [os.path.join(root, f) for f in files
-                           if f.endswith((".trace.json.gz", ".xplane.pb"))]
+            traces = [f for f in listing()
+                      if f.endswith((".trace.json.gz", ".xplane.pb"))]
             return {"dir": out_dir, "seconds": secs,
-                    "traces": sorted(traces)[-4:],
+                    "traces": traces[-4:],
+                    "files": listing()[-32:],
                     "hint": "open in perfetto.dev or tensorboard"}
 
         @router.get("/debug/device")

@@ -38,6 +38,11 @@ FLUSH_LATENCY = "arroyo_worker_flush_seconds"
 # number of record batches merged per coalesced flush at a task's input
 CHAIN_MEMBERS = "arroyo_chain_members"
 COALESCE_BATCHES = "arroyo_worker_coalesce_batches"
+# event-loop scheduling lag (obs/profiler.py watchdog): per-worker
+# gauges refreshed ~1/s from the ticker's rolling lag window, plus the
+# count of stalls past the watchdog threshold (blocking-call episodes)
+EVENT_LOOP_LAG = "arroyo_worker_event_loop_lag_seconds"
+EVENT_LOOP_STALLS = "arroyo_worker_event_loop_stalls_total"
 
 LABELS = ("job_id", "operator_id", "subtask_idx", "operator_name")
 
@@ -216,6 +221,43 @@ def table_size_gauge(task_info, table_char: str) -> Gauge:
         table_char=table_char)
 
 
+# -- event-loop watchdog instruments (obs/profiler.py) -----------------------
+
+# worker-level (no operator label — scheduling lag is a property of the
+# process's event loop, every subtask on it shares the number); the
+# quantile label distinguishes the p50/p99 gauges the watchdog refreshes
+_EVENT_LOOP_LABELS = ("job_id", "quantile")
+_event_loop_gauge: Optional[Gauge] = None
+_event_loop_stalls: Optional[Counter] = None
+
+
+def event_loop_lag_gauge(job_id: str, quantile: str) -> Gauge:
+    """Scheduling-lag gauge child (quantile is 'p50' or 'p99') — how
+    late the loop wakes a sleeping coroutine, sampled continuously by
+    the profiler's watchdog ticker."""
+    global _event_loop_gauge
+    with _lock:
+        if _event_loop_gauge is None:
+            _event_loop_gauge = Gauge(
+                EVENT_LOOP_LAG,
+                "event-loop scheduling lag (watchdog ticker wake delay)",
+                _EVENT_LOOP_LABELS, registry=REGISTRY)
+    return _event_loop_gauge.labels(job_id=job_id or "", quantile=quantile)
+
+
+def event_loop_stalls_counter(job_id: str) -> Counter:
+    """Stall episodes past the watchdog threshold — each one had its
+    blocking stack captured (admin /profile/phases?fmt=json)."""
+    global _event_loop_stalls
+    with _lock:
+        if _event_loop_stalls is None:
+            _event_loop_stalls = Counter(
+                EVENT_LOOP_STALLS,
+                "event-loop stalls past the watchdog threshold",
+                ("job_id",), registry=REGISTRY)
+    return _event_loop_stalls.labels(job_id=job_id or "")
+
+
 # -- autoscaler instruments --------------------------------------------------
 
 # controller-side: every policy evaluation lands in decisions (labeled by
@@ -310,7 +352,11 @@ _PER_SUBTASK_FAMS = ("event_time_lag_seconds", "watermark_lag_seconds",
 def job_operator_summary(job_id: str) -> Dict[str, Dict[str, float]]:
     """Compact per-operator rollup of this process's registry for one job
     — what a worker attaches to its heartbeat so the controller can serve
-    job-level aggregation without scraping workers over HTTP."""
+    job-level aggregation without scraping workers over HTTP.  When the
+    phase profiler is armed, its per-operator phase/wait seconds ride
+    along as ``phase_seconds.<phase>`` / ``wait_seconds.<phase>`` keys,
+    and worker-level (operator-less) families — the event-loop lag
+    gauges — land under the pseudo-operator ``__worker__``."""
     out: Dict[str, Dict[str, float]] = {}
     prefix = "arroyo_worker_"
     for fam in REGISTRY.collect():
@@ -321,12 +367,23 @@ def job_operator_summary(job_id: str) -> Dict[str, Dict[str, float]]:
                 continue
             if s.labels.get("job_id") != job_id:
                 continue
-            op = s.labels.get("operator_id", "")
+            op = s.labels.get("operator_id", "") or "__worker__"
             key = s.name[len(prefix):] if s.name.startswith(prefix) else s.name
+            q = s.labels.get("quantile")
+            if q:  # event-loop lag gauges: one key per quantile child
+                key = f"{key}_{q}"
             g = out.setdefault(op, {})
             g[key] = g.get(key, 0.0) + s.value
             sub = s.labels.get("subtask_idx")
             if sub is not None and key.startswith(_PER_SUBTASK_FAMS):
                 sk = f"{key}@{sub}"
                 g[sk] = g.get(sk, 0.0) + s.value
+    from . import profiler as _profiler
+
+    prof = _profiler.active()
+    if prof is not None and (not prof.job_id or prof.job_id == job_id):
+        for (op, phase), secs in prof.work_snapshot().items():
+            out.setdefault(op, {})[f"phase_seconds.{phase}"] = round(secs, 6)
+        for (op, phase), secs in prof.wait_snapshot().items():
+            out.setdefault(op, {})[f"wait_seconds.{phase}"] = round(secs, 6)
     return out

@@ -23,6 +23,8 @@ import time
 from contextvars import ContextVar
 from typing import Any, Dict, Optional
 
+from . import profiler as _profiler
+
 _COUNTERS: Dict[str, int] = {}
 _NOTES: Dict[str, Any] = {}
 
@@ -117,20 +119,34 @@ def timed_device(call, *args):
     """Run a jitted kernel call.  Always: attribute dispatch wall time to
     the active task's kernel accumulator (cheap, non-blocking).  With
     ``ARROYO_TIMING=1``: additionally block until the result is ready and
-    account true device time to the ``device_ns`` counter."""
+    account true device time to the ``device_ns`` counter.  With the
+    phase profiler armed, the span also lands in the phase table — as
+    ``dispatch`` (host-side envelope) normally, as ``device_execute``
+    when blocking — nested so the enclosing ``proc`` phase stays
+    exclusive."""
     blocking = timing_enabled()
     acc = _ACTIVE_TASK.get()
     if not blocking and acc is None:
         return call(*args)
+    prof = _profiler.active()
+    frame = None
+    if prof is not None:
+        frame = prof.begin(
+            acc.operator_id if acc is not None else "kernel",
+            "device_execute" if blocking else "dispatch")
     _COUNTERS["kernel_dispatches"] = _COUNTERS.get(
         "kernel_dispatches", 0) + 1
     t0 = time.perf_counter_ns()
-    out = call(*args)
-    if blocking:
-        import jax
+    try:
+        out = call(*args)
+        if blocking:
+            import jax
 
-        jax.block_until_ready(out)
-    dt = time.perf_counter_ns() - t0
+            jax.block_until_ready(out)
+    finally:
+        dt = time.perf_counter_ns() - t0
+        if frame is not None:
+            prof.end(frame)
     if blocking:
         _COUNTERS["device_ns"] = _COUNTERS.get("device_ns", 0) + dt
     if acc is not None:

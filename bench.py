@@ -456,6 +456,7 @@ def run_query(name: str, sql_template: str) -> dict:
         result["vs_baseline"] = round(
             eps / ctl["control_events_per_sec"], 3)
     result.update(device_share(name, sql_template))
+    result.update(phase_profile(name, sql_template))
     result.update(sanitize_overhead(name, sql_template))
     return result
 
@@ -526,12 +527,83 @@ def device_share(name: str, sql_template: str) -> dict:
     # device_ns sums per-operator timed_device spans; concurrent
     # operators (q8's two parallel aggregates) can overlap, so the share
     # may exceed 1 — report the raw ratio and mark overlap instead of
-    # fabricating a negative host share
+    # fabricating a negative host share.
+    # host_time_share_DERIVED: the old wall-minus-device residual, kept
+    # for continuity with BENCH_r0* history — the MEASURED
+    # host_time_share now comes from phase_profile()'s phase sum
     share = round(dev / dt, 3)
     out = {"device_time_share": share,
-           "host_time_share": round(max(1 - dev / dt, 0.0), 3)}
+           "host_time_share_derived": round(max(1 - dev / dt, 0.0), 3)}
     if share > 1:
         out["device_time_overlapped"] = True
+    return out
+
+
+def phase_profile(name: str, sql_template: str) -> dict:
+    """Measured per-phase host-time table (obs/profiler.py): re-run a
+    slice of the stream with the phase profiler armed and record where
+    every microsecond of the hot path went — source decode, operator
+    host compute, kernel dispatch, shuffle prep, coalesce merge,
+    watermark/window fires, emission encode — plus the share of wall
+    time NO phase accounts for (``unattributed_share``: the
+    falsifiability check that keeps the instrumentation honest as the
+    engine evolves).  ``host_time_share`` is now this measured phase
+    sum over wall time (clamped to 1; executor-offloaded source
+    generation overlaps the event loop, so the raw ``attributed_share``
+    may exceed 1 and is reported alongside, like device_time_share).
+    Profiler overhead is measured as armed-vs-off wall time on the same
+    slice.  BENCH_PHASES=0 skips."""
+    if os.environ.get("BENCH_PHASES", "1") in ("0", "false", "no"):
+        return {}
+    from arroyo_tpu.connectors.memory import clear_sink
+    from arroyo_tpu.engine.engine import LocalRunner
+    from arroyo_tpu.obs import profiler
+    from arroyo_tpu.sql import plan_sql
+
+    n = min(NUM_EVENTS, 500_000)
+    prog = plan_sql(sql_template.format(n=n, b=BATCH),
+                    parallelism=bench_parallelism())
+
+    def timed() -> float:
+        clear_sink("results")
+        t0 = time.perf_counter()
+        LocalRunner(prog).run()
+        return time.perf_counter() - t0
+
+    timed()  # warm (compiles shared by both arms)
+    dt_off = min(timed(), timed())  # best-of-2 on BOTH arms: the
+    # overhead claim must not ride single-run noise
+    prof = profiler.arm("local-job")
+    try:
+        dt_on = None
+        for _ in range(2):
+            prof.reset()
+            dt = timed()
+            if dt_on is None or dt < dt_on:
+                dt_on, snap = dt, prof.snapshot()
+    finally:
+        profiler.disarm()
+    # the snapshot's wall includes arm-to-run slack; use the run wall
+    phases = snap["phases"]
+    attributed = sum(phases.values())
+    out = {
+        "phases": {k: round(v, 4) for k, v in phases.items()},
+        "phase_waits": {k: round(v, 4) for k, v in snap["waits"].items()},
+        "phase_wall_secs": round(dt_on, 4),
+        "attributed_share": round(attributed / dt_on, 4),
+        "unattributed_share": round(
+            max(1.0 - attributed / dt_on, 0.0), 4),
+        "host_time_share": round(min(attributed / dt_on, 1.0), 3),
+        "profile_overhead_pct": round(
+            (dt_on - dt_off) / dt_off * 100.0, 2),
+        "watchdog_stalls": snap["watchdog"]["stalls"],
+        "event_loop_lag_p99_ms": round(
+            snap["watchdog"]["lag_p99_secs"] * 1e3, 3),
+    }
+    if attributed > dt_on:
+        out["phases_overlapped"] = True  # executor-side source decode
+        # runs concurrently with the loop — same caveat as
+        # device_time_overlapped
     return out
 
 

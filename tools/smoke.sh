@@ -20,7 +20,12 @@
 #   5. arroyosan: a sanitized tiny-Nexmark run (ARROYO_SANITIZE=1,
 #      chaining on, periodic checkpoints) must complete with zero
 #      invariant violations — the runtime protocol contract;
-#   6. tests/test_obs.py — the observability contract suite.
+#   6. the phase profiler: an armed tiny-Nexmark run must attribute
+#      >=85% of wall time to named phases with zero event-loop stalls
+#      (unattributed time means the instrumentation drifted off the
+#      hot path);
+#   7. tests/test_obs.py + tests/test_profiler.py — the observability
+#      contract suites.
 #
 # Budget: the whole gate stays under ~90s.
 #
@@ -218,6 +223,57 @@ print(f"smoke: sanitized nexmark ok ({rows} rows, 0 violations)")
 PY
 
 python - <<'PY'
+# phase-profiler gate: a tiny Nexmark run with the profiler armed must
+# account for >=85% of wall time in named phases (unattributed_share <
+# 0.15) with ZERO event-loop stalls — keeps the phase instrumentation
+# honest as the engine evolves (an engine change that moves hot-path
+# work outside the choke points shows up here as unattributed time)
+import sys
+import time
+
+from arroyo_tpu.connectors.memory import clear_sink, sink_output
+from arroyo_tpu.engine.engine import LocalRunner
+from arroyo_tpu.obs import profiler
+from arroyo_tpu.sql import plan_sql
+
+SQL = """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark', event_rate = '1000000', num_events = '50000',
+  rate_limited = 'false', batch_size = '4096'
+);
+SELECT bid.auction as auction,
+       TUMBLE(INTERVAL '2' SECOND) as window,
+       count(*) AS num
+FROM nexmark WHERE bid is not null GROUP BY 1, 2
+"""
+
+prog = plan_sql(SQL)
+clear_sink("results")
+LocalRunner(prog).run()  # warm: compiles stay out of the profiled run
+prof = profiler.arm("local-job")
+prof.reset()
+clear_sink("results")
+t0 = time.perf_counter()
+LocalRunner(prog).run()
+wall = time.perf_counter() - t0
+snap = prof.snapshot()
+profiler.disarm()
+if sum(len(b) for b in sink_output("results")) <= 0:
+    sys.exit("smoke: profiled nexmark produced no output")
+attributed = sum(snap["phases"].values())
+unattributed = max(1.0 - attributed / wall, 0.0)
+if unattributed >= 0.15:
+    sys.exit(f"smoke: profiler left {unattributed:.1%} of wall time "
+             f"unattributed (phases: {snap['phases']})")
+stalls = snap["watchdog"]["stalls"]
+if stalls:
+    sys.exit(f"smoke: watchdog recorded {stalls} event-loop stall(s): "
+             f"{snap['watchdog']['recent_stalls']}")
+print(f"smoke: profiler ok ({attributed / wall:.1%} of wall attributed "
+      f"across {len(snap['phases'])} phases, 0 stalls)")
+PY
+
+python - <<'PY'
 import asyncio
 import sys
 
@@ -278,4 +334,5 @@ asyncio.run(rest_check())
 print("smoke: autoscaler simulator + REST surface ok")
 PY
 
-exec python -m pytest tests/test_obs.py -q -p no:cacheprovider
+exec python -m pytest tests/test_obs.py tests/test_profiler.py -q \
+    -p no:cacheprovider

@@ -213,11 +213,12 @@ def _ast_passes():
         checkpoint_arity,
         host_sync,
         protocol,
+        row_loop,
         trace_purity,
     )
 
     return [checkpoint_arity, async_blocking, host_sync, trace_purity,
-            protocol]
+            protocol, row_loop]
 
 
 def _project_passes():

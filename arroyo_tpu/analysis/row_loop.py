@@ -1,0 +1,151 @@
+"""Per-row Python loop detector for the serde steady state.
+
+Scope: ``connectors/*.py`` and ``formats.py`` — the ingest/egress hot
+paths this PR vectorized.  The decode fast path (pyarrow / bulk-array
+parse into typed columns) and the vectorized JSON egress (one encoded
+cell pass per column + one template substitution per row) only stay
+fast if nobody quietly re-introduces a per-row Python loop next to
+them; this pass is the ratchet that keeps the host path from silently
+regrowing.
+
+Flags, inside steady-state functions:
+
+- ``for``/comprehension iteration over ``range(len(...))`` — the
+  classic per-row index loop;
+- iteration over a row-carrying name (``rows``, ``payloads``,
+  ``lines``, ``recs``, ``records``) — per-payload Python work;
+- any loop or comprehension whose body calls ``json.loads`` /
+  ``json.dumps`` (or a local alias ``loads``/``dumps``) — a parser or
+  encoder invocation per element.
+
+The DESIGNATED legacy row paths are exempt by name: ``deserialize`` /
+``serialize`` (the ``ARROYO_FAST_DECODE=0`` escape the parity gates
+pin), Debezium envelope unwrapping, Avro's per-value binary codec, and
+schema inference — plus the standard checkpoint/restore exemption.
+Anything else per-row needs an inline waiver with a reason, exactly
+like the host-sync pass.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from .core import Finding, call_name
+
+PASS_ID = "row-loop"
+
+_SCOPE_RE = re.compile(r"(^|/)(connectors/[^/]+\.py|formats\.py)$")
+# designated row paths: the legacy serde escape + inherently per-record
+# codecs + non-steady-state lifecycle functions
+_EXEMPT_FN_RE = re.compile(
+    r"(^|_)(de)?serialize$|_unwrap_debezium|_encode_value|_decode_value"
+    r"|schema_for_rows|checkpoint|snapshot|restore|on_start|on_close")
+
+_ROWY_NAMES = {"rows", "payloads", "lines", "recs", "records"}
+_SERDE_CALLS = {"json.loads", "json.dumps", "loads", "dumps"}
+
+
+def in_scope(path: str) -> bool:
+    return bool(_SCOPE_RE.search(path.replace("\\", "/")))
+
+
+def _is_range_len(it: ast.expr) -> bool:
+    return (isinstance(it, ast.Call) and call_name(it) == "range"
+            and len(it.args) == 1 and isinstance(it.args[0], ast.Call)
+            and call_name(it.args[0]) == "len")
+
+
+def _is_rowy(it: ast.expr) -> bool:
+    return isinstance(it, ast.Name) and it.id in _ROWY_NAMES
+
+
+def _serde_call_in(body) -> Optional[str]:
+    for node in body if isinstance(body, list) else [body]:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and \
+                    call_name(sub) in _SERDE_CALLS:
+                return call_name(sub)
+    return None
+
+
+class _Scan(ast.NodeVisitor):
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: List[Finding] = []
+        self.fn_stack: List[str] = []
+
+    def _visit_fn(self, node) -> None:
+        self.fn_stack.append(node.name)
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def _exempt(self) -> bool:
+        return any(_EXEMPT_FN_RE.search(name) for name in self.fn_stack)
+
+    def _flag(self, node, code: str, msg: str) -> None:
+        self.findings.append(
+            Finding(PASS_ID, code, self.path, node.lineno, msg))
+
+    def _check_loop(self, node, it: ast.expr, body,
+                    elementwise: bool) -> None:
+        """``elementwise`` is True for comprehensions, whose body runs
+        exactly once per element — a serde call there is per-row by
+        construction.  ``for`` statements only flag on the iterable
+        itself (a bounded retry loop AROUND one json.loads is not a
+        row loop)."""
+        if self._exempt():
+            return
+        if _is_range_len(it):
+            self._flag(node, "range-len",
+                       "per-row index loop over batch rows in serde "
+                       "steady state — use a vectorized column pass")
+            return
+        if elementwise:
+            serde = _serde_call_in(body)
+            if serde is not None:
+                self._flag(node, "per-row-serde",
+                           f"{serde}() per element — parse/encode the "
+                           "whole batch in one vectorized pass instead")
+                return
+        if _is_rowy(it):
+            self._flag(node, "per-row",
+                       f"per-payload Python loop over '{it.id}' in serde "
+                       "steady state — batch the work into one pass")
+
+    def visit_For(self, node: ast.For) -> None:
+        self._check_loop(node, node.iter, node.body, elementwise=False)
+        self.generic_visit(node)
+
+    def _visit_comp(self, node, elt) -> None:
+        for gen in node.generators:
+            self._check_loop(node, gen.iter, elt, elementwise=True)
+        self.generic_visit(node)
+
+    def visit_ListComp(self, node: ast.ListComp) -> None:
+        self._visit_comp(node, node.elt)
+
+    def visit_SetComp(self, node: ast.SetComp) -> None:
+        self._visit_comp(node, node.elt)
+
+    def visit_GeneratorExp(self, node: ast.GeneratorExp) -> None:
+        self._visit_comp(node, node.elt)
+
+    def visit_DictComp(self, node: ast.DictComp) -> None:
+        for gen in node.generators:
+            self._check_loop(node, gen.iter, [node.key, node.value],
+                             elementwise=True)
+        self.generic_visit(node)
+
+
+def check(tree: ast.AST, lines, path: str,
+          force: bool = False) -> List[Finding]:
+    if not force and not in_scope(path):
+        return []
+    scan = _Scan(path)
+    scan.visit(tree)
+    return scan.findings

@@ -86,15 +86,17 @@ class SseSource(SourceOperator):
         if last_id:
             headers["Last-Event-ID"] = last_id
 
-        pending: List[bytes] = []
-
-        async def flush() -> None:
-            nonlocal pending
-            if pending:
-                await ctx.collect(self.fmt.batch(pending))
-                pending = []
-            if last_id is not None:
-                state.insert("last_id", last_id)
+        # source-side coalescing: SSE events are tiny fragments — the
+        # boundary batcher assembles target-size batches and the
+        # vectorized format decode parses each batch in one pass.  The
+        # last event id is recorded at PARSE time (resume position at
+        # fetch time); the runner flushes buffered events before any
+        # checkpoint snapshots it, so restores never skip a buffered row.
+        # batch_always: SSE buffered events to batch_size itself before
+        # the batcher existed, so ARROYO_COALESCE=0 must keep that
+        # batching (it only drops the linger), not emit per event.
+        batcher = self.make_batcher(ctx, self.fmt.batch, batch_size,
+                                    batch_always=True)
 
         backoff = 0.1
         async with aiohttp.ClientSession() as session:
@@ -113,12 +115,13 @@ class SseSource(SourceOperator):
                             if line == "":  # dispatch event
                                 if ev_data and (events is None
                                                 or ev_type in events):
-                                    pending.append("\n".join(ev_data).encode())
+                                    await batcher.add(
+                                        ["\n".join(ev_data).encode()])
                                 if ev_id is not None:
                                     last_id = ev_id
+                                    state.insert("last_id", last_id)
                                 ev_type, ev_data, ev_id = "message", [], None
-                                if len(pending) >= batch_size:
-                                    await flush()
+                                await batcher.maybe_flush()
                             elif line.startswith("event:"):
                                 ev_type = line[6:].strip()
                             elif line.startswith("data:"):
@@ -128,18 +131,21 @@ class SseSource(SourceOperator):
                             if runner is not None:
                                 cm = await runner.poll_source_control()
                                 if cm is not None and cm.kind == "stop":
-                                    await flush()
+                                    await batcher.flush()
                                     return (SourceFinishType.GRACEFUL
                                             if cm.stop_mode != StopMode.IMMEDIATE
                                             else SourceFinishType.IMMEDIATE)
                 except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
-                    # transport error mid-stream: reconnect with Last-Event-ID
-                    await flush()
+                    # transport error mid-stream: reconnect with
+                    # Last-Event-ID.  Flush first — the backoff sleep
+                    # always overshoots the linger bound, and the
+                    # pre-batcher code flushed here too
+                    await batcher.flush()
                     await asyncio.sleep(backoff)
                     backoff = min(backoff * 2, 5.0)
                     continue
                 break  # clean server EOF ends the stream
-        await flush()
+        await batcher.flush()
         return SourceFinishType.FINAL
 
 
@@ -177,6 +183,12 @@ class PollingHttpSource(SourceOperator):
         last_body: Optional[bytes] = None
         runner = getattr(ctx, "_runner", None)
         headers = _parse_headers(self.cfg.headers)
+        # source-side coalescing: each poll yields ONE payload — without
+        # the boundary batcher every poll paid a full decode + collect +
+        # downstream envelope.  Poll counts are recorded at fetch time;
+        # the runner flushes buffered bodies before checkpoints/stop, so
+        # resume semantics are unchanged.
+        batcher = self.make_batcher(ctx, self.fmt.batch, 0)
 
         async with aiohttp.ClientSession() as session:
             while self.cfg.max_polls is None or polls < self.cfg.max_polls:
@@ -188,7 +200,7 @@ class PollingHttpSource(SourceOperator):
                 polls += 1
                 if self.cfg.emit_behavior == "all" or body != last_body:
                     last_body = body
-                    await ctx.collect(self.fmt.batch([body]))
+                    await batcher.add([body])
                 state.insert("polls", polls)
                 if runner is not None:
                     cm = await runner.poll_source_control()
@@ -196,7 +208,15 @@ class PollingHttpSource(SourceOperator):
                         return (SourceFinishType.GRACEFUL
                                 if cm.stop_mode != StopMode.IMMEDIATE
                                 else SourceFinishType.IMMEDIATE)
-                await asyncio.sleep(self.cfg.poll_interval_ms / 1000)
+                sleep_secs = self.cfg.poll_interval_ms / 1000
+                if sleep_secs >= batcher.linger:
+                    # the next wait would overshoot the linger bound: a
+                    # buffered body must not be delayed a whole poll
+                    # interval (slow polls emit per poll, as pre-batcher)
+                    await batcher.flush()
+                else:
+                    await batcher.maybe_flush()
+                await asyncio.sleep(sleep_secs)
         return SourceFinishType.FINAL
 
 

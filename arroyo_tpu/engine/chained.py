@@ -26,6 +26,23 @@ overhead entirely; composition is row-preserving (RECORD maps are 1:1),
 so interior members' message counters stay exact.
 ``ARROYO_CHAIN_FUSE_EXPR=0`` disables only the jit composition while
 keeping the queue-hop elimination.
+
+**Ingest-spine fusion (this PR):** runs of elementwise members —
+predicates, record/UDF projections, key_bys — execute as ONE host
+step (`_SpineStep`): each member's column fn runs eagerly pinned to
+the CPU backend (ops/expr.py ``CompiledExpr.eval_host``), with no
+padding, no jit and **zero accelerator dispatches**.  The batch on
+both sides of these members is host-resident by construction (sources
+decode to numpy; window state pre-aggregates on host before its
+scatter), so the per-member pad→dispatch→readback round trip was pure
+envelope — Flare's argument applied to the ingest path.  Combined
+with parallelism-1 shuffle chaining (graph/chaining.py), a
+source→project→key_by→window pipeline becomes one task whose
+per-batch work is a single Python step plus the window's (deferred,
+coalesced) state scatter.  ``ARROYO_CHAIN_FUSE_INGEST=0`` restores
+the jitted per-member path bit-for-bit; ``ARROYO_CHAIN_FUSE_EXPR=0``
+(the PR 4 escape) disables BOTH fused forms — jit composition and the
+spine — so one knob always yields plain per-member execution.
 """
 
 from __future__ import annotations
@@ -52,9 +69,17 @@ from ..types import (
 )
 from .context import Context
 from .operator import Operator
-from .operators_basic import ExpressionOperator
+from .operators_basic import ExpressionOperator, KeyByOperator, UdfOperator
 
 logger = logging.getLogger(__name__)
+
+
+def ingest_fusion_enabled() -> bool:
+    """``ARROYO_CHAIN_FUSE_INGEST=0`` disables host-spine fusion (the
+    eager CPU-pinned evaluation of elementwise chain members), keeping
+    the jitted per-member / composed-expr path."""
+    return os.environ.get("ARROYO_CHAIN_FUSE_INGEST", "1") not in (
+        "0", "off", "false")
 
 
 class _ChainLink:
@@ -132,6 +157,95 @@ def _compose_exprs(exprs: List[ColumnExpr]) -> ColumnExpr:
                       sql="; ".join(e.sql for e in exprs if e.sql))
 
 
+def _spineable(op: Operator) -> bool:
+    """Members the host spine can execute: pure elementwise transforms
+    with no state, timers, broadcasts or side effects."""
+    return isinstance(op, (ExpressionOperator, UdfOperator,
+                           KeyByOperator))
+
+
+class _SpineStep(Operator):
+    """One fused execution step running a run of elementwise chain
+    members (predicates / record exprs / UDFs / key_bys) eagerly on the
+    host — semantics member-for-member identical to the unfused path
+    (same column layouts, same row drops, same key hashes), with zero
+    accelerator dispatches.  Does its own per-member recv/sent/lag
+    accounting (``own_member_counts``) because predicates change the
+    row count mid-run."""
+
+    own_member_counts = True
+
+    def __init__(self, chain: "ChainedOperator", idxs: List[int]):
+        members = [chain.members[i] for i in idxs]
+        super().__init__(
+            "spine(" + "+".join(m.name for m in members) + ")")
+        self.chain = chain
+        self.idxs = idxs
+        self._plan: List[Tuple[int, str, Operator]] = []
+        for mi, op in zip(idxs, members):
+            if isinstance(op, KeyByOperator):
+                kind = "key"
+            elif isinstance(op, UdfOperator):
+                kind = "udf"
+            elif op.return_type == ExprReturnType.PREDICATE:
+                kind = "pred"
+            elif op.return_type == ExprReturnType.RECORD:
+                kind = "record"
+            else:
+                kind = "opt"  # OPTIONAL_RECORD: record + __valid select
+            self._plan.append((mi, kind, op))
+
+    def _observe(self, mi: int, batch: Batch) -> None:
+        """Mirror ChainedOperator._feed's per-member bookkeeping."""
+        m = self.chain.ctxs[mi].metrics
+        if m is None:
+            return
+        n = len(batch)
+        if mi != 0:
+            # the head member's recv is counted by the runner
+            m.messages_recv.inc(n)
+        if n:
+            ts = int(np.max(batch.timestamp))
+            if 0 < ts < int(MAX_TIMESTAMP) - 1:
+                m.event_time_lag.observe(
+                    max((now_micros() - ts) / 1e6, 0.0))
+
+    async def process_batch(self, batch: Batch, ctx: Context,
+                            side: int = 0) -> None:
+        from ..ops.expr import (eval_host_expr, eval_predicate,
+                                eval_record_expr)
+
+        b = batch
+        last = self._plan[-1][0]
+        for mi, kind, op in self._plan:
+            self._observe(mi, b)
+            if kind == "pred":
+                mask = eval_predicate(op.compiled, b, host=True)
+                if not mask.any():
+                    return  # legacy predicate: empty results never emit
+                b = b.select(mask)
+            elif kind == "record":
+                b = eval_record_expr(op.compiled, b, host=True)
+            elif kind == "opt":
+                b = eval_record_expr(op.compiled, b, host=True)
+                if "__valid" in b.columns:
+                    vm = b.columns.pop("__valid").astype(bool)
+                    b = b.select(vm)
+            elif kind == "udf":
+                b = eval_host_expr(op.fn, b)
+            else:  # key
+                b = b.with_key(list(op.key_cols))
+            if mi != last:
+                m = self.chain.ctxs[mi].metrics
+                if m is not None:
+                    # interior sent = rows this member emitted; the last
+                    # member's sent is counted by its collector (link or
+                    # tail Collector), exactly as unfused
+                    m.messages_sent.inc(len(b))
+        if len(b):
+            await ctx.collect(b)
+
+
 class ChainedOperator(Operator):
     """Executes chain members in order inside one task (see module
     docstring).  ``bind(ctxs)`` must be called with one Context per
@@ -171,20 +285,37 @@ class ChainedOperator(Operator):
         self._build_steps()
 
     def _build_steps(self) -> None:
+        from ..ops.expr import _host_eval_device
+
         fuse = os.environ.get("ARROYO_CHAIN_FUSE_EXPR", "1") not in (
             "0", "off", "false")
+        # FUSE_EXPR=0 is the "no fused execution of members at all"
+        # escape: it must also force the spine off, or flipping the
+        # documented knob would silently change nothing for spineable
+        # members (they'd still run fused inside _SpineStep)
+        spine = (fuse and ingest_fusion_enabled()
+                 and _host_eval_device() is not None)
         self._steps = []
         i = 0
         while i < len(self.members):
             j = i
-            if fuse and _fusible(self.members[i]):
+            if spine and _spineable(self.members[i]):
+                # host spine: a maximal run of elementwise members runs
+                # as one eager host step — no per-member dispatch at all
+                while (j + 1 < len(self.members)
+                       and _spineable(self.members[j + 1])):
+                    j += 1
+                step_op: Operator = _SpineStep(self, list(range(i, j + 1)))
+            elif fuse and _fusible(self.members[i]):
                 while (j + 1 < len(self.members)
                        and _fusible(self.members[j + 1])):
                     j += 1
-            if j > i:
-                fused = _compose_exprs(
-                    [self.members[k].expr for k in range(i, j + 1)])
-                step_op: Operator = ExpressionOperator(fused.name, fused)
+                if j > i:
+                    fused = _compose_exprs(
+                        [self.members[k].expr for k in range(i, j + 1)])
+                    step_op = ExpressionOperator(fused.name, fused)
+                else:
+                    step_op = self.members[i]
             else:
                 step_op = self.members[i]
             # execute against the LAST covered member's context so
@@ -237,24 +368,27 @@ class ChainedOperator(Operator):
             self.sanitizer.on_record(
                 (self.infos[start].task_id, "chain"), batch)
         n = len(batch)
-        ts = int(np.max(batch.timestamp)) if n else 0
-        now = now_micros()
-        for mi in idxs:
-            m = self.ctxs[mi].metrics
-            if m is None:
-                continue
-            if mi != 0:
-                # the head member's recv is counted by the runner; every
-                # other member counts here (fused interiors included —
-                # RECORD exprs are 1:1, so the pass-through count is
-                # exact)
-                m.messages_recv.inc(n)
-            if 0 < ts < int(MAX_TIMESTAMP) - 1:
-                m.event_time_lag.observe(max((now - ts) / 1e6, 0.0))
-        for mi in idxs[:-1]:
-            m = self.ctxs[mi].metrics
-            if m is not None:
-                m.messages_sent.inc(n)
+        if not getattr(step_op, "own_member_counts", False):
+            # a _SpineStep counts per member itself (predicates change
+            # the row count member to member)
+            ts = int(np.max(batch.timestamp)) if n else 0
+            now = now_micros()
+            for mi in idxs:
+                m = self.ctxs[mi].metrics
+                if m is None:
+                    continue
+                if mi != 0:
+                    # the head member's recv is counted by the runner;
+                    # every other member counts here (fused interiors
+                    # included — RECORD exprs are 1:1, so the
+                    # pass-through count is exact)
+                    m.messages_recv.inc(n)
+                if 0 < ts < int(MAX_TIMESTAMP) - 1:
+                    m.event_time_lag.observe(max((now - ts) / 1e6, 0.0))
+            for mi in idxs[:-1]:
+                m = self.ctxs[mi].metrics
+                if m is not None:
+                    m.messages_sent.inc(n)
         # exclusive latency: inclusive minus time spent in downstream
         # members this call recursed into (collect is synchronous)
         self._lat_stack.append(0.0)

@@ -129,8 +129,37 @@ class SourceOperator(Operator):
     """Base for sources: drives its own loop instead of reacting to inputs
     (``#[source_fn]``, arroyo-macro/src/lib.rs:292-316)."""
 
+    # source-side coalescer (engine/coalesce.py SourceBatcher): None
+    # unless the connector installed one via make_batcher
+    _batcher: Optional[Any] = None
+
     async def run(self, ctx: Context) -> SourceFinishType:
         raise NotImplementedError
+
+    def make_batcher(self, ctx: Context, decode: Any,
+                     target: int = 0, batch_always: bool = False) -> Any:
+        """Install a :class:`~arroyo_tpu.engine.coalesce.SourceBatcher`
+        assembling target-size batches at the source boundary.  The
+        TaskRunner drains it via ``flush_pending`` before checkpoint
+        barriers and stops, so connectors may record resume positions
+        at fetch time without breaking exactly-once.  ``batch_always``
+        is for connectors that assembled target-size batches themselves
+        before the boundary batcher existed: their batching survives
+        ``ARROYO_COALESCE=0`` (only the linger is escape-hatched)."""
+        from .coalesce import SourceBatcher
+
+        self._batcher = SourceBatcher(
+            ctx, decode, target, prof_op=ctx.task_info.operator_id,
+            batch_always=batch_always)
+        return self._batcher
+
+    async def flush_pending(self, ctx: Context) -> None:
+        """Emit any payloads buffered at the source boundary.  Called by
+        the TaskRunner before a checkpoint snapshots source state and
+        when the source loop ends — buffered rows are always downstream
+        of the state that claims them."""
+        if self._batcher is not None:
+            await self._batcher.flush()
 
     async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
         raise RuntimeError("sources have no inputs")

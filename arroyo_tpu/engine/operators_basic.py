@@ -91,7 +91,7 @@ class FlattenOperator(Operator):
             return
         lengths = np.fromiter((len(x) for x in col), dtype=np.int64, count=len(col))
         idx = np.repeat(np.arange(len(col)), lengths)
-        flat = np.concatenate([np.asarray(x) for x in col if len(x)]) if lengths.sum() else np.zeros(0)
+        flat = np.concatenate([np.asarray(x) for x in col if len(x)]) if lengths.sum() else np.zeros(0)  # arroyolint: disable=host-sync -- flatten materializes list-column lengths on host by design (list cols never enter jit)
         out = batch.select(idx)
         out.columns[self.list_col] = flat
         await ctx.collect(out)

@@ -159,6 +159,11 @@ class TaskRunner:
 
     async def _run_source(self) -> None:
         finish = await self.operator.run(self.ctx)
+        # drain the source-side coalescer before any end-of-stream
+        # marker: buffered fragments must precede the final watermark /
+        # stop downstream (and must be emitted at all — their resume
+        # positions are already recorded in source state)
+        await self.operator.flush_pending(self.ctx)
         if finish == SourceFinishType.FINAL:
             # final watermark flushes all windows downstream
             await self.out_ctx.broadcast(Message.wm(Watermark.event_time(int(MAX_TIMESTAMP))))
@@ -177,6 +182,10 @@ class TaskRunner:
         except asyncio.QueueEmpty:
             return None
         if cm.kind == "checkpoint":
+            # source-side coalescer ordering: payloads buffered at the
+            # source boundary carry resume positions the snapshot below
+            # records — they must reach downstream BEFORE the barrier
+            await self.operator.flush_pending(self.ctx)
             await self.run_checkpoint(cm.barrier)
             if cm.barrier.then_stop:
                 # checkpoint-then-stop (arroyo-types lib.rs:746): the source

@@ -15,13 +15,26 @@ back, ready for the jitted device operators.
 
 from __future__ import annotations
 
+import functools
 import json
+import math
+import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 
 from .types import Batch, now_micros
+
+
+def fast_decode_enabled() -> bool:
+    """``ARROYO_FAST_DECODE=0`` disables every vectorized serde fast
+    path — decode *and* encode — so the formats reproduce the
+    row-at-a-time legacy path bit-for-bit (the full escape hatch the
+    fast-vs-legacy smoke gate and parity tests pin).  Read per call so
+    tests can toggle it without rebuilding format instances."""
+    return os.environ.get("ARROYO_FAST_DECODE", "1") not in ("0", "off",
+                                                             "false")
 
 # Debezium operation codes -> our UpdateOp-style ops.  The reference models
 # these as UpdatingData::{Append,Update,Retract} (arroyo-types/src/lib.rs:359-420).
@@ -41,11 +54,13 @@ def rows_to_columns(rows: Sequence[Dict[str, Any]]) -> Dict[str, np.ndarray]:
     mirroring arrow's permissive JSON reader.
     """
     names: Dict[str, None] = {}
+    # arroyolint: disable=row-loop -- THE pinned legacy pivot: the fast decode paths fall back to exactly this on schema drift / ARROYO_FAST_DECODE=0
     for r in rows:
         for k in r:
             names.setdefault(k)
     cols: Dict[str, np.ndarray] = {}
     for k in names:
+        # arroyolint: disable=row-loop -- THE pinned legacy pivot: the fast decode paths fall back to exactly this on schema drift / ARROYO_FAST_DECODE=0
         vs = [r.get(k) for r in rows]
         # Dispatch on the *JSON* types, never by attempted coercion: a column
         # of digit strings ("01234") must stay a string column.
@@ -162,6 +177,7 @@ def coerce_float(arr: np.ndarray, dtype=np.float32) -> np.ndarray:
 def batch_to_rows(batch: Batch) -> List[Dict[str, Any]]:
     names = list(batch.columns)
     cols = [batch.columns[n] for n in names]
+    # arroyolint: disable=row-loop -- the row-path escape: only envelope formats and inexpressible columns reach this materialization
     return [
         {n: _py(c[i]) for n, c in zip(names, cols)}
         for i in range(len(batch))
@@ -181,6 +197,96 @@ def _py(v: Any) -> Any:
     if isinstance(v, bytes):
         return v.decode("utf-8", "replace")
     return v
+
+
+# ---------------------------------------------------------------------------
+# Vectorized JSON egress (the decode fast path's mirror image)
+# ---------------------------------------------------------------------------
+
+
+def _float_cell(v: float, nan_literal: str) -> str:
+    # json.dumps renders floats with float.__repr__ and the non-finite
+    # literals below; NaN is the caller's choice because the two legacy
+    # encoders disagree (JsonFormat nulls it via _py, the single_file
+    # sink's default hook keeps the NaN literal)
+    if v != v:
+        return nan_literal
+    if math.isinf(v):
+        return "Infinity" if v > 0 else "-Infinity"
+    return repr(v)
+
+
+def _json_cells(col: np.ndarray, nan_literal: str) -> Optional[List[str]]:
+    """One JSON-encoded text cell per row for a whole column, dispatched
+    by dtype instead of per value.  ``None`` means the column holds
+    something the vectorized encoders don't express (nested lists,
+    dicts, arbitrary objects) and the caller must take the legacy
+    row-at-a-time path."""
+    kind = col.dtype.kind
+    if kind in "iu":
+        return col.astype(str).tolist()
+    if kind == "f":
+        return [_float_cell(v, nan_literal) for v in col.tolist()]
+    if kind == "b":
+        return np.where(col, "true", "false").tolist()
+    if col.dtype == object or kind == "U":
+        out: List[str] = []
+        dumps = json.dumps
+        # tolist() for BOTH kinds: a 'U' column would otherwise yield
+        # np.str_ cells that walk the whole isinstance chain per cell
+        for v in col.tolist():
+            if v is None:
+                out.append("null")
+            elif type(v) is str:
+                out.append(dumps(v))
+            elif isinstance(v, (bool, np.bool_)):
+                out.append("true" if v else "false")
+            elif isinstance(v, (int, np.integer)):
+                out.append(str(int(v)))
+            elif isinstance(v, float):
+                # a python-float NaN in an object column survives _py
+                # untouched, so legacy json.dumps emits the literal
+                out.append(_float_cell(v, "NaN"))
+            elif isinstance(v, np.floating):
+                out.append(_float_cell(float(v), nan_literal))
+            elif isinstance(v, np.str_):
+                out.append(dumps(str(v)))
+            elif isinstance(v, bytes):
+                out.append(dumps(v.decode("utf-8", "replace")))
+            else:
+                return None  # nested lists/dicts: row path handles them
+        return out
+    return None  # datetimes etc: no vectorized encoder
+
+
+@functools.lru_cache(maxsize=256)
+def _row_template(names: tuple) -> str:
+    """Schema-once render template: the per-row byte layout is fixed by
+    the column names, so the object framing, key quoting and the legacy
+    ``json.dumps`` separators are baked in exactly once per schema."""
+    # arroyolint: disable=row-loop -- iterates column NAMES once per schema (lru_cache), never per row
+    return "{" + ", ".join(
+        json.dumps(n).replace("%", "%%") + ": %s" for n in names) + "}"
+
+
+def encode_json_lines(batch: Batch,
+                      nan_literal: str = "null") -> Optional[List[str]]:
+    """Render a whole Batch to JSON-object text lines with zero per-row
+    Python: one encoded-cell pass per column, one template substitution
+    per row.  Returns ``None`` when a column isn't expressible — the
+    caller falls back to its legacy per-row ``json.dumps`` loop (whose
+    output this function otherwise matches byte for byte)."""
+    names = tuple(batch.columns)
+    if not names:
+        return ["{}"] * len(batch)
+    cells: List[List[str]] = []
+    for n in names:
+        c = _json_cells(batch.columns[n], nan_literal)
+        if c is None:
+            return None
+        cells.append(c)
+    template = _row_template(names)
+    return [template % t for t in zip(*cells)]
 
 
 # ---------------------------------------------------------------------------
@@ -242,52 +348,103 @@ class JsonFormat(Format):
     def batch(self, payloads: Sequence[bytes],
               timestamp_field: Optional[str] = None) -> Batch:
         """Columnar fast path: plain JSON objects parse as one NDJSON
-        block through pyarrow (~9x the per-row json.loads path — the
-        kafka/json hot loop); anything it cannot express (debezium
-        envelopes, unstructured, schema envelopes, arrays, nested
-        objects, mixed types) falls back to the row path."""
-        if not (self.debezium or self.unstructured or self.include_schema) \
-                and getattr(self, "_arrow_ok", True):
+        block through pyarrow (~5x the per-row json.loads path — the
+        kafka/json hot loop) with the stream's Arrow schema locked
+        after the first batch; without pyarrow, one C-level bulk parse
+        of the whole batch feeds the exact legacy pivot (~3x).
+        Structural shapes the columnar reader can't express (debezium
+        envelopes, unstructured, schema envelopes) and
+        ``ARROYO_FAST_DECODE=0`` take the legacy row path."""
+        if (self.debezium or self.unstructured or self.include_schema
+                or not fast_decode_enabled()):
+            return batch_from_rows(self.deserialize(payloads),
+                                   timestamp_field)
+        if getattr(self, "_arrow_ok", True):
             try:
                 return self._batch_arrow(payloads, timestamp_field)
             except ImportError:
                 # no pyarrow in this environment: never retry the import
-                # on the hot path
+                # on the hot path — the bulk path below takes over
                 self._arrow_ok = False
             except _TransientColumnarError:
                 # per-record data glitch (e.g. one payload missing the
                 # timestamp field): row-path THIS batch only, keep the
                 # fast path for the well-formed rest of the stream
-                pass
+                return batch_from_rows(self.deserialize(payloads),
+                                       timestamp_field)
             except Exception:
-                # payload shape the columnar path can't express (nested
-                # objects, arrays, mixed types): stick to the row path
-                # for this stream rather than re-parsing twice per batch
+                # payload shape the arrow reader can't express (nested
+                # objects, arrays, mixed types): the bulk path pivots
+                # through the legacy type rules, which express anything
+                # the row path does — switch to it for this stream
                 self._arrow_ok = False
-        return batch_from_rows(self.deserialize(payloads), timestamp_field)
+        return self._batch_bulk(payloads, timestamp_field)
 
-    def _batch_arrow(self, payloads: Sequence[bytes],
-                     timestamp_field: Optional[str]) -> Batch:
+    def _join_payloads(self, payloads: Sequence[bytes], sep: bytes):
+        """Frame a batch of payloads as ONE buffer for a single parser
+        invocation — the shared framing home of the arrow and bulk fast
+        paths (the two must never drift).  Hot path: a list of bytes
+        with nothing to strip joins directly (a None/str mid-list
+        raises TypeError there and falls to the general path).
+        Returns ``(buf, count)``; ``(None, 0)`` when nothing remains."""
         if not self.confluent_schema_registry and isinstance(
                 payloads, list) and payloads and \
                 isinstance(payloads[0], bytes):
-            # hot path: a list of bytes with nothing to strip — avoid
-            # 200k/s of per-payload isinstance/strip calls.  ONLY the
-            # join is guarded: a None/str mid-list raises TypeError
-            # here; any later error must surface, not silently re-parse
             try:
-                buf = b"\n".join(payloads)
+                return sep.join(payloads), len(payloads)
             except TypeError:
-                buf = None  # mixed payload types: general path below
-            if buf is not None:
-                return self._batch_arrow_raw(buf, len(payloads),
-                                             timestamp_field)
+                pass  # mixed payload types: general path below
+        # arroyolint: disable=row-loop -- mixed-type payload framing fallback; the bytes-only hot path is the single join above
         raw = [self._strip(p if isinstance(p, bytes) else str(p).encode())
                for p in payloads if p is not None]
         if not raw:
+            return None, 0
+        return sep.join(raw), len(raw)
+
+    def _batch_bulk(self, payloads: Sequence[bytes],
+                    timestamp_field: Optional[str]) -> Batch:
+        """Vectorized fallback without pyarrow: ONE ``json.loads`` of
+        the whole batch (payloads joined into a JSON array) replaces
+        len(payloads) parser invocations; the pivot is the same
+        :func:`rows_to_columns`, so null/bool/digit-string semantics
+        are the legacy path's by construction.  After 3 consecutive
+        failures the stream stops paying the doomed join+parse and
+        stays on the row path."""
+        if getattr(self, "_bulk_fails", 0) < 3:
+            try:
+                buf, _ = self._join_payloads(payloads, b",")
+                objs = json.loads(b"[" + buf + b"]") if buf is not None \
+                    else []
+                self._bulk_fails = 0
+                return batch_from_rows(self._normalize_objs(objs),
+                                       timestamp_field)
+            except Exception:
+                # a payload the array join mis-frames (embedded control
+                # chars, truncated docs): the row path is authoritative
+                # — it surfaces the real error or succeeds
+                self._bulk_fails = getattr(self, "_bulk_fails", 0) + 1
+        return batch_from_rows(self.deserialize(payloads), timestamp_field)
+
+    def _normalize_objs(self, objs: List[Any]) -> List[Dict[str, Any]]:
+        """Parsed-object -> row normalization shared by the bulk fast
+        path and (modulo parsing) ``deserialize``: arrays flatten to
+        their dict elements, scalars wrap in a ``value`` column."""
+        rows: List[Dict[str, Any]] = []
+        for obj in objs:
+            if isinstance(obj, dict):
+                rows.append(obj)
+            elif isinstance(obj, list):
+                rows.extend(o for o in obj if isinstance(o, dict))
+            else:
+                rows.append({"value": obj})
+        return rows
+
+    def _batch_arrow(self, payloads: Sequence[bytes],
+                     timestamp_field: Optional[str]) -> Batch:
+        buf, n = self._join_payloads(payloads, b"\n")
+        if buf is None:
             return Batch(np.zeros(0, dtype=np.int64), {})
-        return self._batch_arrow_raw(b"\n".join(raw), len(raw),
-                                     timestamp_field)
+        return self._batch_arrow_raw(buf, n, timestamp_field)
 
     def _batch_arrow_raw(self, buf: bytes, n_rows: int,
                          timestamp_field: Optional[str]) -> Batch:
@@ -295,7 +452,28 @@ class JsonFormat(Format):
 
         import pyarrow as pa
         import pyarrow.json as paj
-        tbl = paj.read_json(io.BytesIO(buf))
+
+        # schema-once: the first batch locks the stream's Arrow schema;
+        # later batches parse against it explicitly (no per-batch type
+        # inference, and the column set stays stable — a field absent
+        # from one batch null-fills instead of vanishing, which keeps
+        # the downstream coalescer/data-plane signatures from flapping).
+        # Genuinely new fields still appear via unexpected-field
+        # inference; a type conflict is schema drift: re-read with
+        # inference and re-lock.
+        locked = getattr(self, "_pa_schema", None)
+        try:
+            if locked is not None:
+                tbl = paj.read_json(io.BytesIO(buf), parse_options=(
+                    paj.ParseOptions(explicit_schema=locked)))
+            else:
+                tbl = paj.read_json(io.BytesIO(buf))
+        except pa.ArrowInvalid:
+            if locked is None:
+                raise
+            self._pa_schema = None
+            tbl = paj.read_json(io.BytesIO(buf))
+        self._pa_schema = tbl.schema
         if len(tbl) != n_rows:
             raise ValueError("row-count mismatch (multi-object payloads)")
         cols: Dict[str, np.ndarray] = {}
@@ -391,6 +569,22 @@ class JsonFormat(Format):
             else:
                 out.append(json.dumps(r, default=_py).encode())
         return out
+
+    def serialize_batch(self, batch: Batch) -> List[bytes]:
+        """Vectorized egress: one encoded-cell pass per column plus a
+        schema-once row template replace the per-row dict build and
+        ``json.dumps`` (~2x, byte-identical output).  Envelope modes
+        (debezium / include_schema) and ``ARROYO_FAST_DECODE=0`` keep
+        the legacy row path; so does any column the cell encoders
+        can't express."""
+        if (self.debezium or self.include_schema
+                or not fast_decode_enabled()):
+            return self.serialize(batch_to_rows(batch))
+        lines = encode_json_lines(batch)
+        if lines is None:
+            return self.serialize(batch_to_rows(batch))
+        # arroyolint: disable=row-loop -- one C-level encode per outgoing payload; the JSON render itself is vectorized (encode_json_lines)
+        return [line.encode() for line in lines]
 
 
 @dataclass

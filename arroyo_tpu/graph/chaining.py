@@ -21,6 +21,20 @@ groups of operators the engine may execute inside a single
 What breaks a chain, therefore: shuffle edges, parallelism changes,
 fan-in/fan-out, and sources/sinks.
 
+One refinement (this PR): a **parallelism-1 SHUFFLE edge is routing-
+trivial** — every row hashes to the single downstream subtask — so the
+edge carries exactly the rows a FORWARD edge would, in the same order.
+Such edges may live *inside* a chain (``ARROYO_CHAIN_SHUFFLE1=0``
+restores the old break), which lets the ingest spine
+(source→project→key_by→window) fuse into one task: the per-batch
+queue hop, envelope and alignment between the keyed map and the window
+vanish.  Keying is unchanged — the KeyByOperator still computes
+``key_hash`` as a chain member, so window state partitioning, rescale
+key-range filtering and checkpoint layouts are identical.  At any
+other parallelism the shuffle routes for real and breaks the chain
+exactly as before (a rescale that widens a chain re-plans and splits
+it at the shuffle).
+
 Chain identity is *per member*: checkpoint state tables, metrics labels
 and rollups keep each member's own operator_id, so a checkpoint taken
 chained restores un-chained and vice versa.  ``ARROYO_CHAIN=0`` disables
@@ -46,6 +60,13 @@ def chaining_enabled() -> bool:
     return os.environ.get("ARROYO_CHAIN", "1") not in ("0", "off", "false")
 
 
+def shuffle1_chaining_enabled() -> bool:
+    """``ARROYO_CHAIN_SHUFFLE1=0`` stops chains from crossing
+    parallelism-1 shuffle edges (the pre-ingest-fusion behavior)."""
+    return os.environ.get("ARROYO_CHAIN_SHUFFLE1", "1") not in (
+        "0", "off", "false")
+
+
 @dataclass
 class ChainPlan:
     """The chaining decision for one Program.
@@ -69,8 +90,16 @@ def _chainable_node(program: Program, op_id: str) -> bool:
 
 def _chainable_edge(program: Program, u: str, v: str) -> bool:
     g = program.graph
-    if program.edge(u, v).typ is not EdgeType.FORWARD:
-        return False
+    typ = program.edge(u, v).typ
+    if typ is not EdgeType.FORWARD:
+        # a parallelism-1 plain SHUFFLE is identity routing: the single
+        # downstream subtask receives every row in order, exactly as a
+        # FORWARD edge would.  Join-side shuffles never qualify (their
+        # side tag carries semantics, and fan-in blocks them below).
+        if not (typ is EdgeType.SHUFFLE and shuffle1_chaining_enabled()
+                and program.node(u).parallelism == 1
+                and program.node(v).parallelism == 1):
+            return False
     if not (_chainable_node(program, u) and _chainable_node(program, v)):
         return False
     if program.node(u).parallelism != program.node(v).parallelism:

@@ -160,13 +160,19 @@ def decode_message(kind: int, data: bytes) -> Message:
 
 
 def _write_frame(writer: asyncio.StreamWriter, quad: Quad, kind: int,
-                 payload: bytes) -> None:
+                 payload) -> None:
+    """``payload`` may be any bytes-like (bytes, memoryview over an
+    Arrow buffer): header and payload go out as two writes so a large
+    batch payload is never copied into a concatenated frame — the
+    transport buffer is the only copy between Arrow memory and the
+    socket."""
     src_op, src_idx, dst_op, dst_idx = quad
     so, do = src_op.encode(), dst_op.encode()
     header = struct.pack(
         f"<IHI{len(so)}sII{len(do)}sIQ",
         MAGIC, kind, len(so), so, src_idx, len(do), do, dst_idx, len(payload))
-    writer.write(header + payload)
+    writer.write(header)
+    writer.write(payload)
 
 
 async def _read_frame(reader: asyncio.StreamReader
@@ -376,7 +382,10 @@ class NetworkManager:
                     if prev is not None and schema.equals(
                             prev, check_metadata=True):
                         kind = KIND_DATA_BATCH
-                        payload = rb.serialize().to_pybytes()
+                        # zero-copy egress: the Arrow buffer feeds the
+                        # socket through a memoryview — no to_pybytes()
+                        # copy of the whole batch per frame
+                        payload = memoryview(rb.serialize())
                     else:
                         state["schema"] = schema
                         kind, payload = KIND_DATA, _stream_bytes(rb)

@@ -60,6 +60,16 @@ def _expr_device():
     return None
 
 
+def _host_eval_device():
+    """CPU device for eager host-side expression evaluation (the chain
+    ingest spine); None when the CPU platform is unavailable — callers
+    must then keep the jitted path."""
+    try:
+        return jax.devices("cpu")[0]
+    except RuntimeError:
+        return None
+
+
 def _looks_stringy(v: np.ndarray) -> bool:
     """First non-None value (of a prefix) is a str: the column would stay
     on the host path rather than coerce to a device dtype."""
@@ -118,9 +128,12 @@ class CompiledExpr:
             self._jitted[schema_key] = f
         return f
 
-    def __call__(self, batch: Batch) -> Any:
-        n = len(batch)
-        padded = bucket_size(n)
+    def _split_cols(self, batch: Batch
+                    ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+        """(numeric env, host passthrough cols) for this expression over
+        one batch — the single definition of which columns enter the fn
+        and which bypass it, shared by the jitted path and the host
+        (ingest-spine) path so the two produce identical layouts."""
         num_cols: Dict[str, np.ndarray] = {"__timestamp": batch.timestamp}
         host_cols: Dict[str, np.ndarray] = {}
         used = self.used_cols
@@ -150,6 +163,29 @@ class CompiledExpr:
                 num_cols[k] = v
             else:
                 host_cols[k] = v
+        return num_cols, host_cols
+
+    def eval_host(self, batch: Batch) -> Any:
+        """Evaluate the expression eagerly on the HOST — no padding, no
+        jit, no accelerator dispatch.  The fn's jnp ops run op-by-op
+        pinned to the CPU backend, so on an accelerator box the batch
+        never crosses the transfer boundary.  Used by the chain ingest
+        spine (engine/chained.py), where the batch is host-resident on
+        both sides of the expression and a per-batch kernel dispatch is
+        pure envelope.  Returns the same ``(out, n, host_cols)``
+        contract as ``__call__``."""
+        n = len(batch)
+        num_cols, host_cols = self._split_cols(batch)
+        dev = _host_eval_device()
+        ctx = jax.default_device(dev) if dev is not None else nullcontext()
+        with ctx:
+            out = self.fn(dict(num_cols))
+        return out, n, host_cols
+
+    def __call__(self, batch: Batch) -> Any:
+        n = len(batch)
+        padded = bucket_size(n)
+        num_cols, host_cols = self._split_cols(batch)
 
         padded_cols = {
             k: np.concatenate([v, np.zeros(padded - n, dtype=v.dtype)])
@@ -165,17 +201,20 @@ class CompiledExpr:
         return out, n, host_cols
 
 
-def eval_record_expr(expr: CompiledExpr, batch: Batch) -> Batch:
-    """Record expression: fn(cols) -> dict of output columns."""
-    out, n, host_cols = expr(batch)
+def eval_record_expr(expr: CompiledExpr, batch: Batch,
+                     host: bool = False) -> Batch:
+    """Record expression: fn(cols) -> dict of output columns.
+    ``host=True`` evaluates eagerly on the CPU backend (ingest spine) —
+    identical output layout, no padding/jit/dispatch."""
+    out, n, host_cols = expr.eval_host(batch) if host else expr(batch)
     assert isinstance(out, dict), f"record expr {expr.name} must return a dict"
     cols: Dict[str, np.ndarray] = {}
     ts = batch.timestamp
     for k, v in out.items():
         if k == "__timestamp":
-            ts = np.asarray(v)[:n]
+            ts = np.asarray(v)[:n]  # arroyolint: disable=host-sync -- record-expr output must materialize as host numpy batch columns
             continue
-        arr = np.asarray(v)
+        arr = np.asarray(v)  # arroyolint: disable=host-sync -- record-expr output must materialize as host numpy batch columns
         cols[k] = arr[:n] if arr.ndim >= 1 and arr.shape[0] >= n else arr
     # host (string) columns referenced in output pass through by name
     for k, v in host_cols.items():
@@ -184,9 +223,10 @@ def eval_record_expr(expr: CompiledExpr, batch: Batch) -> Batch:
     return Batch(ts, cols, batch.key_hash, batch.key_cols)
 
 
-def eval_predicate(expr: CompiledExpr, batch: Batch) -> np.ndarray:
-    out, n, _ = expr(batch)
-    mask = np.asarray(out)
+def eval_predicate(expr: CompiledExpr, batch: Batch,
+                   host: bool = False) -> np.ndarray:
+    out, n, _ = expr.eval_host(batch) if host else expr(batch)
+    mask = np.asarray(out)  # arroyolint: disable=host-sync -- predicate mask materializes on host where batch.select runs
     assert mask.dtype == np.bool_ or np.issubdtype(mask.dtype, np.bool_), (
         f"predicate {expr.name} must return bool")
     if mask.ndim == 0:
@@ -218,6 +258,6 @@ def eval_host_expr(fn: Callable[[Dict[str, np.ndarray]], Any], batch: Batch
         cols = {"__timestamp": batch.timestamp, **batch.columns}
         out = fn(cols)
         assert isinstance(out, dict)
-        ts = np.asarray(out.pop("__timestamp", batch.timestamp))
-        return Batch(ts, {k: np.asarray(v) for k, v in out.items()},
+        ts = np.asarray(out.pop("__timestamp", batch.timestamp))  # arroyolint: disable=host-sync -- host UDF path: outputs are host numpy by contract
+        return Batch(ts, {k: np.asarray(v) for k, v in out.items()},  # arroyolint: disable=host-sync -- host UDF path: outputs are host numpy by contract
                      batch.key_hash, batch.key_cols)

@@ -261,17 +261,17 @@ def join_pairs(lk: np.ndarray, rk: np.ndarray
     ro_d, rks_d = timed_device(_sort_kernel(nrp), rk_p)
     start_d, counts_d, cum_d = timed_device(
         _probe_kernel(nlp, nrp, _merged_probe()), lks_d, rks_d, nl, nr)
-    counts = np.asarray(counts_d)[:nl]
+    counts = np.asarray(counts_d)[:nl]  # arroyolint: disable=host-sync -- intentional join-emission readback: matched pairs must land on host to build output batch
     total = int(counts.sum())
     if total:
         m = _bucket(total)
         lidx_d, ridx_d = timed_device(_expand_kernel(nlp, m),
                                       start_d, cum_d)
-        lidx = np.asarray(lidx_d)[:total]
-        ridx = np.asarray(ridx_d)[:total]
+        lidx = np.asarray(lidx_d)[:total]  # arroyolint: disable=host-sync -- intentional join-emission readback: matched pairs must land on host to build output batch
+        ridx = np.asarray(ridx_d)[:total]  # arroyolint: disable=host-sync -- intentional join-emission readback: matched pairs must land on host to build output batch
     else:
         lidx = np.zeros(0, dtype=np.int64)
         ridx = np.zeros(0, dtype=np.int64)
-    lo = np.asarray(lo_d)[:nl]
-    ro = np.asarray(ro_d)[:nr]
+    lo = np.asarray(lo_d)[:nl]  # arroyolint: disable=host-sync -- intentional join-emission readback: matched pairs must land on host to build output batch
+    ro = np.asarray(ro_d)[:nr]  # arroyolint: disable=host-sync -- intentional join-emission readback: matched pairs must land on host to build output batch
     return lo, ro, lidx, ridx, counts

@@ -24,6 +24,7 @@ recompiles are O(log keys).
 from __future__ import annotations
 
 import functools
+import os
 from typing import Dict, List, Optional, Tuple
 
 import jax
@@ -401,6 +402,47 @@ def preaggregate(kh: np.ndarray, bins: np.ndarray,
     return kh_s[starts], bin_s[starts], rowcnt, out
 
 
+def update_coalescing_enabled() -> bool:
+    """``ARROYO_UPDATE_COALESCE=0`` dispatches every batch's scatter
+    immediately (the pre-deferral behavior, bit-for-bit).  Read per
+    call so tests can toggle without rebuilding state."""
+    return os.environ.get("ARROYO_UPDATE_COALESCE", "1") not in (
+        "0", "off", "false")
+
+
+def _flush_cell_bound() -> int:
+    """Pending-cell count above which buffered updates flush even
+    without a reader (bounds host memory and scatter size)."""
+    return int(os.environ.get("ARROYO_UPDATE_FLUSH_CELLS", 65536))
+
+
+def _merge_cells(slots: np.ndarray, bins: np.ndarray, rowcnt: np.ndarray,
+                 vals: np.ndarray, ch_kinds: Tuple[str, ...]
+                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Reduce duplicate (slot, bin) cells across buffered batch runs —
+    the cross-batch half of :func:`preaggregate`: value channels reduce
+    by their kind, row counts add.  Keeps the flushed scatter no larger
+    than the live cell set."""
+    order = np.lexsort((bins, slots))
+    s, b = slots[order], bins[order]
+    is_first = np.ones(len(s), dtype=bool)
+    is_first[1:] = (s[1:] != s[:-1]) | (b[1:] != b[:-1])
+    starts = is_first.nonzero()[0]
+    if len(starts) == len(s):
+        return slots, bins, rowcnt, vals  # already unique
+    v = vals[:, order]
+    out = np.empty((vals.shape[0], len(starts)), dtype=ACC_DTYPE)
+    for j, kind in enumerate(ch_kinds):
+        if kind == "min":
+            out[j] = np.minimum.reduceat(v[j], starts)
+        elif kind == "max":
+            out[j] = np.maximum.reduceat(v[j], starts)
+        else:  # sum / count channels are additive
+            out[j] = np.add.reduceat(v[j], starts)
+    rc = np.add.reduceat(rowcnt[order], starts)
+    return s[starts], b[starts], rc, out
+
+
 def directory_insert(state, kh: np.ndarray, ensure_capacity) -> np.ndarray:
     """Vectorized key-hash -> slot lookup over the host directory attrs
     (``key_sorted``, ``slot_of_sorted``, ``next_slot``, ``slot_to_key``),
@@ -526,6 +568,16 @@ class KeyedBinState:
         # the tunnel as u16 instead of i32 — per-bin (vs one monotone
         # scalar) keeps the proof live on long-running streams
         self._bin_bound: Dict[int, int] = {}
+        # update coalescing (ARROYO_UPDATE_COALESCE): per-batch
+        # pre-aggregated cell runs buffer HERE and flush to the device in
+        # one merged scatter when a reader needs the planes (pane fire,
+        # snapshot, ring relayout) or the buffer crosses
+        # ARROYO_UPDATE_FLUSH_CELLS — one dispatch + one h2d transfer
+        # amortizes across many batches (the dominant per-batch device
+        # cost once the ingest spine killed the expression dispatches)
+        self._pending: List[Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                  np.ndarray]] = []
+        self._pending_cells = 0
 
     # -- key directory -----------------------------------------------------
 
@@ -630,12 +682,47 @@ class KeyedBinState:
             for b in range(lo, hi + 1):
                 self._bin_bound[b] = self._bin_bound.get(b, 0) + bmax
 
+        # update coalescing: buffer the (already pre-aggregated) cell run
+        # and let one merged scatter carry many batches — the planes are
+        # only read at pane fires / snapshots, and every reader flushes
+        if update_coalescing_enabled():
+            self._pending.append((slots_c, bins_c, rowcnt, vals_c))
+            self._pending_cells += m
+            if self._pending_cells >= _flush_cell_bound():
+                self.flush_updates()
+            return
+        self._dispatch_cells(slots_c, bins_c, rowcnt, vals_c)
+
+    def flush_updates(self) -> None:
+        """Apply every buffered pre-aggregated cell run to the device
+        planes in ONE scatter dispatch.  Called by every plane reader
+        (fire_panes, snapshot, ring relayout) and when the buffer
+        crosses the cell bound, so deferral is invisible to emission,
+        checkpoint and rescale semantics."""
+        if not self._pending:
+            return
+        pend, self._pending = self._pending, []
+        self._pending_cells = 0
+        if len(pend) == 1:
+            slots_c, bins_c, rowcnt, vals_c = pend[0]
+        else:
+            xfer_kinds = tuple(self._ch_kinds[j] for j in self._xfer_ch)
+            slots_c, bins_c, rowcnt, vals_c = _merge_cells(
+                np.concatenate([p[0] for p in pend]),
+                np.concatenate([p[1] for p in pend]),
+                np.concatenate([p[2] for p in pend]),
+                np.concatenate([p[3] for p in pend], axis=1), xfer_kinds)
+        self._dispatch_cells(slots_c, bins_c, rowcnt, vals_c)
+
+    def _dispatch_cells(self, slots_c: np.ndarray, bins_c: np.ndarray,
+                        rowcnt: np.ndarray, vals_c: np.ndarray) -> None:
         # additive aggregates route through the Pallas MXU scatter (one-hot
         # matmul) instead of XLA's serial scatter; min/max stay on XLA
         if self._use_pallas():
             self._update_pallas(slots_c, bins_c, rowcnt, vals_c)
             return
 
+        m = len(slots_c)
         npad = _bucket(m, floor=256)
         idx = np.zeros((2, npad), dtype=np.int32)
         idx[0, :m] = slots_c
@@ -697,6 +784,9 @@ class KeyedBinState:
 
     def _grow_ring(self, needed: int) -> None:
         """Rare: data spans more bins than the ring; re-layout host-side."""
+        # buffered cell runs carry ring indices mod the OLD B — they must
+        # land before the ring re-layout redefines the modulus
+        self.flush_updates()
         newB = self.B
         while newB < needed:
             newB <<= 1
@@ -916,6 +1006,10 @@ class KeyedBinState:
                       else (self.min_bin or 0))
         if last_pane < first_pane:
             return None
+        # panes will actually fire: buffered batch updates must be in the
+        # planes first (the early returns above keep no-op watermark
+        # advances from forcing a flush per batch)
+        self.flush_updates()
         pane_ends = np.arange(first_pane, last_pane + 1, dtype=np.int64)
         k = len(pane_ends)
         kpad = _bucket(k, floor=1)
@@ -1037,6 +1131,7 @@ class KeyedBinState:
     # parquet.rs:194-218 analog).
 
     def snapshot(self) -> Dict[str, np.ndarray]:
+        self.flush_updates()  # buffered cells belong to this epoch
         n = self.next_slot
         _prefetch_host(self.values, self.counts)
         values = np.asarray(jax.device_get(self.values))
@@ -1064,6 +1159,9 @@ class KeyedBinState:
         }
 
     def restore(self, arrays: Dict[str, np.ndarray]) -> None:
+        # buffered updates from a pre-restore life are void
+        self._pending = []
+        self._pending_cells = 0
         meta = arrays["meta"]
         self.next_slot = int(meta[0])
         lo = int(meta[1])

@@ -164,7 +164,7 @@ def scatter_add_channels(slots: np.ndarray, bins: np.ndarray,
     """
     k, n = weights.shape
     assert n % CHUNK == 0 and len(slots) == n
-    w2 = _split_hi_lo(np.asarray(weights, np.float32))
+    w2 = _split_hi_lo(np.asarray(weights, np.float32))  # arroyolint: disable=host-sync -- kernel input packing reads host arrays; no device round-trip on this path
     run = _scatter_multi(2 * k, B, C_act, n // CHUNK, _interpret())
     # every operand is 32-bit; trace under x32 — Mosaic's TPU lowering
     # rejects the 64-bit index types that global x64 mode introduces
@@ -224,7 +224,7 @@ def update_bin_state(values: jnp.ndarray, counts: jnp.ndarray,
     # slot ids ride an f32 row: exact only below 2^24 (same guard as the
     # XLA packing in keyed_bins.update)
     assert C_act <= 1 << 24, "key capacity exceeds f32-exact packing"
-    w2 = _split_hi_lo(np.asarray(weights, np.float32))
+    w2 = _split_hi_lo(np.asarray(weights, np.float32))  # arroyolint: disable=host-sync -- kernel input packing reads host arrays; no device round-trip on this path
     packed = np.empty((2 + w2.shape[0], n), dtype=np.float32)
     packed[0] = slots  # small ints: exact in f32
     packed[1] = bins

@@ -51,14 +51,14 @@ def segment_top_k(part: np.ndarray, values: np.ndarray, k: int
     seg_p = np.full(n_pad, _PAD_SEG, np.int32)
     seg_p[:n] = seg
     val_p = np.zeros(n_pad, np.float64)
-    val_p[:n] = -np.asarray(values, dtype=np.float64)
+    val_p[:n] = -np.asarray(values, dtype=np.float64)  # arroyolint: disable=host-sync -- intentional top-k emission readback: surviving rows must select on host
 
     from ..obs.perf import timed_device
 
     s_idx, keep = timed_device(_topk_kernel(n_pad, k),
                                jnp.asarray(seg_p), jnp.asarray(val_p))
-    s_idx = np.asarray(s_idx)
-    keep = np.asarray(keep)
+    s_idx = np.asarray(s_idx)  # arroyolint: disable=host-sync -- intentional top-k emission readback: surviving rows must select on host
+    keep = np.asarray(keep)  # arroyolint: disable=host-sync -- intentional top-k emission readback: surviving rows must select on host
     out = s_idx[keep]
     out.sort()  # restore original row order
     return out

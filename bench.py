@@ -600,11 +600,138 @@ def phase_profile(name: str, sql_template: str) -> dict:
         "event_loop_lag_p99_ms": round(
             snap["watchdog"]["lag_p99_secs"] * 1e3, 3),
     }
+    # ingest throughput through the decode phase alone: events per
+    # second of source_decode time (the number the vectorized serde
+    # fast path exists to move; the decode microbench isolates the
+    # same family outside the engine)
+    decode_secs = phases.get("source_decode", 0.0)
+    if decode_secs > 0:
+        out["ingest_rows_per_s"] = round(n / decode_secs, 1)
     if attributed > dt_on:
         out["phases_overlapped"] = True  # executor-side source decode
         # runs concurrently with the loop — same caveat as
         # device_time_overlapped
     return out
+
+
+def run_decode_microbench() -> dict:
+    """Decode-family microbench: JSON lines -> Batch through each serde
+    path (legacy per-row json.loads pivot, bulk one-shot array parse,
+    pyarrow columnar reader with the schema-once lock) plus the egress
+    mirror (Batch -> JSON payloads, template render vs per-row dumps).
+    Isolates the formats.py layer from the engine so the BENCH_r0*
+    trajectory shows the serde speedup independent of pipeline effects.
+    The fast paths must emit identical rows (asserted here — a parity
+    break is a bench failure, not a silent wrong-number).
+    BENCH_DECODE=0 skips."""
+    from arroyo_tpu.formats import JsonFormat, batch_to_rows
+
+    import numpy as np
+
+    n = int(os.environ.get("BENCH_DECODE_ROWS", 200_000))
+    rng = np.random.default_rng(42)
+    auction = rng.integers(1000, 2000, n)
+    price = rng.integers(1, 10_000_000, n)
+    bidder = rng.integers(0, 5000, n)
+    payloads = [
+        (f'{{"auction": {auction[i]}, "bidder": {bidder[i]}, '
+         f'"price": {price[i]}, "channel": "ch{bidder[i] % 10}", '
+         f'"ts": {1700000000000000 + i}}}').encode()
+        for i in range(n)
+    ]
+    chunks = [payloads[i:i + BATCH] for i in range(0, n, BATCH)]
+
+    def timed_decode(mode):
+        prev = os.environ.get("ARROYO_FAST_DECODE")
+        os.environ["ARROYO_FAST_DECODE"] = "0" if mode == "legacy" else "1"
+        try:
+            best, batches = None, None
+            for _ in range(2):
+                fmt = JsonFormat()  # fresh schema lock per run
+                if mode == "bulk":
+                    fmt._arrow_ok = False
+                t0 = time.perf_counter()
+                out = [fmt.batch(c, "ts") for c in chunks]
+                dt = time.perf_counter() - t0
+                if best is None or dt < best:
+                    best, batches = dt, out
+            return best, batches
+        finally:
+            if prev is None:
+                os.environ.pop("ARROYO_FAST_DECODE", None)
+            else:
+                os.environ["ARROYO_FAST_DECODE"] = prev
+
+    modes = ["legacy", "bulk"]
+    try:
+        import pyarrow.json  # noqa: F401
+        modes.append("arrow")
+    except ImportError:
+        pass
+    result = {"metric": "decode_microbench", "rows": n, "batch": BATCH}
+    batches_by_mode = {}
+    for mode in modes:
+        dt, batches = timed_decode(mode)
+        batches_by_mode[mode] = batches
+        result[f"decode_{mode}_rows_per_s"] = round(n / dt, 1)
+    for mode in modes[1:]:
+        # parity is part of the bench contract: a fast path that drifts
+        # from the legacy rows must fail loudly here. Compare every chunk:
+        # the arrow path only engages its schema-once lock from chunk 1 on,
+        # so first-chunk-only parity would miss exactly the locked path.
+        for ci, (fast_b, legacy_b) in enumerate(
+                zip(batches_by_mode[mode], batches_by_mode["legacy"])):
+            assert batch_to_rows(fast_b) == batch_to_rows(legacy_b), \
+                f"decode parity break: {mode} vs legacy (chunk {ci})"
+        result[f"decode_{mode}_speedup"] = round(
+            result[f"decode_{mode}_rows_per_s"]
+            / result["decode_legacy_rows_per_s"], 2)
+
+    # egress mirror: Batch -> JSON payloads
+    batch = batches_by_mode[modes[-1]][0]
+
+    def timed_encode(flag):
+        prev = os.environ.get("ARROYO_FAST_DECODE")
+        os.environ["ARROYO_FAST_DECODE"] = flag
+        try:
+            fmt = JsonFormat()
+            best, out = None, None
+            for _ in range(2):
+                t0 = time.perf_counter()
+                res = [fmt.serialize_batch(batch) for _ in range(10)]
+                dt = time.perf_counter() - t0
+                if best is None or dt < best:
+                    best, out = dt, res[0]
+            return best, out
+        finally:
+            if prev is None:
+                os.environ.pop("ARROYO_FAST_DECODE", None)
+            else:
+                os.environ["ARROYO_FAST_DECODE"] = prev
+
+    rows_enc = 10 * len(batch)
+    dt_legacy, enc_legacy = timed_encode("0")
+    dt_fast, enc_fast = timed_encode("1")
+    assert enc_fast == enc_legacy, "egress parity break: fast vs legacy"
+    result["encode_legacy_rows_per_s"] = round(rows_enc / dt_legacy, 1)
+    result["encode_fast_rows_per_s"] = round(rows_enc / dt_fast, 1)
+    result["encode_fast_speedup"] = round(dt_legacy / dt_fast, 2)
+    return result
+
+
+def emit_decode():
+    """Decode-family microbench: returned for embedding in the headline
+    line (serde-layer rows/s, fast vs legacy)."""
+    if os.environ.get("BENCH_DECODE", "1") in ("0", "false", "no"):
+        return None
+    try:
+        d = run_decode_microbench()
+    except Exception as e:  # the headline must still print
+        print(f"decode bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+    print(json.dumps(d), file=sys.stderr)
+    return d
 
 
 LAT_SQL = """
@@ -1370,6 +1497,9 @@ def main_child() -> None:
         js = emit_join_stress()
         if js is not None:
             headline_result["join_stress"] = js
+        dec = emit_decode()
+        if dec is not None:
+            headline_result["decode"] = dec
         print(json.dumps(headline_result))
     else:
         result = run_query(headline, QUERIES[headline])
@@ -1381,6 +1511,9 @@ def main_child() -> None:
         js = emit_join_stress()
         if js is not None:
             result["join_stress"] = js
+        dec = emit_decode()
+        if dec is not None:
+            result["decode"] = dec
         print(json.dumps(result))
 
 

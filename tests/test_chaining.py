@@ -175,10 +175,13 @@ def test_chain_on_equivalent_output_fewer_tasks(monkeypatch):
 
 def test_chained_members_keep_flight_recorder_attribution(monkeypatch):
     """Rollups still attribute per-member kernel-seconds / message
-    counts after fusion — the autoscaler's policy input is unchanged."""
+    counts after fusion — the autoscaler's policy input is unchanged.
+    Pinned to the jitted composed-expr mode: the host ingest spine
+    (tested separately below) dispatches no kernels at all."""
     from arroyo_tpu.obs.metrics import job_operator_summary
 
     monkeypatch.setenv("ARROYO_CHAIN", "1")
+    monkeypatch.setenv("ARROYO_CHAIN_FUSE_INGEST", "0")
     clear_sink("attr")
     prog = _map_filter_prog("attr", n=4000)
     engine = _run_engine(prog, "attr-job")
@@ -471,3 +474,169 @@ def test_q5_unchained_checkpoint_restores_chained_with_rescale(
 
     asyncio.run(run_phase2())
     assert _q5_rows(out_path) == reference
+
+
+# -- ingest-spine fusion / shuffle-1 chaining / update coalescing (PR 9) -----
+
+
+def test_ingest_spine_zero_dispatches_same_rows(monkeypatch):
+    """The host spine runs elementwise chains with no kernel dispatch at
+    all, emitting exactly the rows the jitted per-member path emits."""
+    from arroyo_tpu.obs import perf
+
+    monkeypatch.setenv("ARROYO_CHAIN", "1")
+    monkeypatch.setenv("ARROYO_COALESCE", "0")
+
+    def run(fuse):
+        monkeypatch.setenv("ARROYO_CHAIN_FUSE_INGEST", fuse)
+        sink = f"spine-{fuse}"
+        clear_sink(sink)
+        before = perf.counter("kernel_dispatches")
+        _run_engine(_map_filter_prog(sink, n=6000), f"spine-job-{fuse}")
+        d = perf.counter("kernel_dispatches") - before
+        return d, Batch.concat(sink_output(sink))
+
+    d_jit, rows_jit = run("0")
+    d_spine, rows_spine = run("1")
+    assert d_spine == 0, d_spine
+    assert d_jit > 0
+    np.testing.assert_array_equal(
+        np.sort(rows_spine.columns["tripled"]),
+        np.sort(rows_jit.columns["tripled"]))
+    assert sorted(rows_spine.columns["counter"].tolist()) == \
+        sorted(rows_jit.columns["counter"].tolist())
+
+
+def test_spine_member_counts_survive_filters(monkeypatch):
+    """Per-member recv/sent rollups stay exact through a spine whose
+    predicate drops rows — the autoscaler's per-operator signals must
+    not blur when members fuse."""
+    from arroyo_tpu.obs.metrics import job_operator_summary
+
+    monkeypatch.setenv("ARROYO_CHAIN", "1")
+    monkeypatch.setenv("ARROYO_CHAIN_FUSE_INGEST", "1")
+    clear_sink("spine-counts")
+    prog = _map_filter_prog("spine-counts", n=4000)
+    engine = _run_engine(prog, "spine-counts-job")
+    chained = next(h for h in engine.subtasks.values()
+                   if len(h.member_ids) > 1)
+    assert len(chained.member_ids) == 3
+    summary = job_operator_summary("spine-counts-job")
+    double, triple, evens = chained.member_ids
+    # maps are 1:1; the filter keeps counter % 2 == 0 (tripled = 3c)
+    assert summary[double].get("messages_sent_total") == 4000
+    assert summary[triple].get("messages_recv_total") == 4000
+    assert summary[triple].get("messages_sent_total") == 4000
+    assert summary[evens].get("messages_recv_total") == 4000
+    assert summary[evens].get("messages_sent_total") == 2000
+
+
+def test_shuffle1_chains_through_keyed_window(monkeypatch):
+    """A parallelism-1 keyed window pipeline fuses into one task across
+    the (routing-trivial) shuffle edge, with identical output rows."""
+    rng = np.random.default_rng(7)
+    ts = np.sort(rng.integers(0, 4 * SEC, 4000)).astype(np.int64)
+    batches = [Batch(ts[i:i + 256],
+                     {"k": rng.integers(0, 9, len(ts[i:i + 256])),
+                      "v": np.ones(len(ts[i:i + 256]), dtype=np.int64)})
+               for i in range(0, len(ts), 256)]
+
+    from arroyo_tpu import AggSpec, TumblingWindow
+
+    def build(sink):
+        return (Stream.source("memory", {"batches": batches})
+                .watermark(max_lateness_micros=0)
+                .key_by("k")
+                .window(TumblingWindow(SEC),
+                        [AggSpec(AggKind.COUNT, None, "n")])
+                .sink("memory", {"name": sink}))
+
+    def run(flag):
+        monkeypatch.setenv("ARROYO_CHAIN_SHUFFLE1", flag)
+        sink = f"sh1-{flag}"
+        clear_sink(sink)
+        engine = _run_engine(build(sink), f"sh1-job-{flag}")
+        rows = Batch.concat(sink_output(sink))
+        key = sorted(zip(rows.columns["k"].tolist(),
+                         rows.columns["window_end"].tolist(),
+                         rows.columns["n"].tolist()))
+        return len(engine.subtasks), key
+
+    n_off, rows_off = run("0")
+    n_on, rows_on = run("1")
+    assert rows_on == rows_off
+    assert n_on < n_off, (n_on, n_off)
+
+
+def test_shuffle_chains_only_at_parallelism_1():
+    """A plain SHUFFLE edge joins a chain iff both ends run at
+    parallelism 1 (identity routing); at any other parallelism it
+    breaks the chain exactly as before."""
+    def build():
+        return (
+            Stream.source("impulse", {"event_rate": 0.0,
+                                      "message_count": 10})
+            .map(lambda c: {"counter": c["counter"],
+                            "b": c["counter"] % 3}, name="m1")
+            .key_by("b")
+            .count()
+            .sink("memory", {"name": "sh2"})
+        )
+
+    prog = build()
+    plan = plan_chains(prog)
+    count_id = next(n.operator_id for n in prog.nodes()
+                    if n.operator_id.endswith("_count"))
+    grp = plan.group_for(count_id)
+    assert grp is not None, "p1 shuffle should chain into the count"
+    # now the same shape at parallelism 2: the shuffle breaks the chain
+    prog2 = build()
+    for n in prog2.nodes():
+        if n.operator.kind.value != "connector_sink":
+            n.parallelism = 2
+    plan2 = plan_chains(prog2)
+    validate_chain_plan(prog2, plan2)
+    for g in plan2.groups:
+        for u, v in zip(g, g[1:]):
+            assert prog2.edge(u, v).typ.value == "forward"
+
+
+def test_update_coalescing_parity_with_snapshot_roundtrip(monkeypatch):
+    """Deferred window-state scatters are invisible to emission and
+    checkpointing: same fired panes as the immediate-dispatch path, and
+    a snapshot taken mid-buffer flushes first (a restore of it resumes
+    bit-identically)."""
+    from arroyo_tpu.ops.keyed_bins import KeyedBinState
+
+    rng = np.random.default_rng(3)
+    aggs = (AggSpec(AggKind.COUNT, None, "n"), AggSpec(AggKind.SUM, "v", "s"))
+
+    def feed(state, upto):
+        for i in range(upto):
+            kh = rng2.integers(0, 50, 300).astype(np.uint64)
+            t = rng2.integers(i * SEC, (i + 1) * SEC, 300).astype(np.int64)
+            v = rng2.integers(1, 9, 300).astype(np.float64)
+            state.update(kh, t, {"v": v})
+
+    def fire(state):
+        out = state.fire_panes(10 * SEC)
+        if out is None:
+            return None
+        keys, cols, wend, cnts = out
+        return sorted(zip(keys.tolist(), wend.tolist(),
+                          cols["n"].tolist(), cols["s"].tolist()))
+
+    results = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("ARROYO_UPDATE_COALESCE", flag)
+        rng2 = np.random.default_rng(11)
+        st = KeyedBinState(aggs, SEC, 2 * SEC, capacity=64)
+        feed(st, 6)
+        snap = {k: np.copy(v) for k, v in st.snapshot().items()}
+        # restore the mid-stream snapshot into a fresh state and finish
+        st2 = KeyedBinState(aggs, SEC, 2 * SEC, capacity=64)
+        st2.restore(snap)
+        feed(st2, 2)
+        results[flag] = fire(st2)
+    assert results["1"] == results["0"]
+    assert results["1"] is not None

@@ -1,0 +1,316 @@
+"""Decode/egress parity matrix (zero-copy vectorized ingest PR).
+
+The vectorized serde fast paths (formats.py: pyarrow NDJSON reader,
+bulk array parse, template-based JSON egress) must emit rows IDENTICAL
+to the legacy row-at-a-time path on exactly the fixtures the legacy
+docstrings pin: nullable bools staying bool-typed object columns,
+digit strings staying strings, missing fields becoming NaN/object
+columns, and Debezium ``__op`` envelopes.  The matrix runs every
+fixture through all three decode paths (arrow / bulk / legacy) and
+both egress paths, plus the schema-drift mid-stream fallback and the
+``ARROYO_FAST_DECODE=0`` full escape.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from arroyo_tpu.formats import (
+    JsonFormat,
+    batch_to_rows,
+    encode_json_lines,
+    fast_decode_enabled,
+    make_format,
+)
+from arroyo_tpu.types import Batch
+
+try:
+    import pyarrow  # noqa: F401
+    import pyarrow.json  # noqa: F401
+
+    HAVE_ARROW = True
+except ImportError:  # pragma: no cover - image always has pyarrow
+    HAVE_ARROW = False
+
+needs_arrow = pytest.mark.skipif(not HAVE_ARROW, reason="pyarrow absent")
+
+
+def _decode(payloads, mode, monkeypatch, ts_field=None, **fmt_kwargs):
+    """Rows out of one decode path: 'legacy' (ARROYO_FAST_DECODE=0),
+    'bulk' (fast, pyarrow latched off), or 'arrow' (fast)."""
+    fmt = JsonFormat(**fmt_kwargs)
+    if mode == "legacy":
+        monkeypatch.setenv("ARROYO_FAST_DECODE", "0")
+    else:
+        monkeypatch.setenv("ARROYO_FAST_DECODE", "1")
+        if mode == "bulk":
+            fmt._arrow_ok = False
+    try:
+        batch = fmt.batch(payloads, ts_field)
+    finally:
+        monkeypatch.delenv("ARROYO_FAST_DECODE", raising=False)
+    return batch
+
+
+FAST_MODES = (["arrow"] if HAVE_ARROW else []) + ["bulk"]
+
+# the tricky fixtures the rows_to_columns docstring pins -------------------
+
+FIXTURES = {
+    "nullable_bools": [{"f": True, "i": 1}, {"f": None, "i": 2},
+                       {"f": False, "i": 3}],
+    "digit_strings": [{"s": "01234", "n": 5}, {"s": "99", "n": 6}],
+    "missing_numeric": [{"a": 1, "b": 2.5}, {"b": 3.5}, {"a": 4}],
+    "missing_strings": [{"s": "x", "k": 1}, {"k": 2}],
+    "all_null_column": [{"x": None, "k": 1}, {"x": None, "k": 2}],
+    "unicode_strings": [{"s": "café ☃", "k": 1},
+                        {"s": "line\nbreak \"q\"", "k": 2}],
+    "int_float_mix": [{"v": 1, "k": 1}, {"v": 2.5, "k": 2}],
+    "scalar_payloads": [1, "two", 3.5],
+    "array_payloads": "arrays",  # special-cased below
+}
+
+
+def _payloads(name):
+    fixture = FIXTURES[name]
+    if name == "scalar_payloads":
+        return [json.dumps(v).encode() for v in fixture]
+    if name == "array_payloads":
+        return [json.dumps([{"a": 1, "b": "x"}, {"a": 2, "b": "y"}]).encode()]
+    return [json.dumps(r).encode() for r in fixture]
+
+
+@pytest.mark.parametrize("fixture", sorted(FIXTURES))
+@pytest.mark.parametrize("mode", FAST_MODES)
+def test_decode_parity_matrix(fixture, mode, monkeypatch):
+    """Every fast decode path emits the exact rows the legacy path does
+    (NaN/None normalization via batch_to_rows, which both share)."""
+    payloads = _payloads(fixture)
+    legacy = _decode(payloads, "legacy", monkeypatch)
+    fast = _decode(payloads, mode, monkeypatch)
+    assert batch_to_rows(fast) == batch_to_rows(legacy)
+
+
+@pytest.mark.parametrize("mode", FAST_MODES)
+def test_decode_parity_column_dtypes(mode, monkeypatch):
+    """Beyond row equality: the pinned dtype semantics survive the fast
+    paths — digit strings stay strings, nullable bools stay bool-typed
+    object columns, missing ints become NaN float64."""
+    payloads = _payloads("digit_strings")
+    fast = _decode(payloads, mode, monkeypatch)
+    assert fast.columns["s"].dtype == object
+    assert list(fast.columns["s"]) == ["01234", "99"]
+
+    fast = _decode(_payloads("nullable_bools"), mode, monkeypatch)
+    assert fast.columns["f"].dtype == object
+    assert list(fast.columns["f"]) == [True, None, False]
+
+    fast = _decode(_payloads("missing_numeric"), mode, monkeypatch)
+    a = fast.columns["a"]
+    assert a.dtype == np.float64
+    assert a[0] == 1.0 and np.isnan(a[1]) and a[2] == 4.0
+
+
+@pytest.mark.parametrize("mode", FAST_MODES)
+def test_decode_parity_timestamp_field(mode, monkeypatch):
+    payloads = [json.dumps({"ts": 100 + i, "v": i}).encode()
+                for i in range(4)]
+    legacy = _decode(payloads, "legacy", monkeypatch, ts_field="ts")
+    fast = _decode(payloads, mode, monkeypatch, ts_field="ts")
+    assert fast.timestamp.tolist() == legacy.timestamp.tolist()
+    assert fast.timestamp.dtype == np.int64
+
+
+def test_debezium_envelopes_identical_fast_and_legacy(monkeypatch):
+    """Debezium is a designated row path: fast on/off must be
+    bit-identical (the envelope carries per-row op semantics)."""
+    payloads = [
+        json.dumps({"payload": {"before": None,
+                                "after": {"id": 1, "v": "a"},
+                                "op": "c"}}).encode(),
+        json.dumps({"payload": {"before": {"id": 1, "v": "a"},
+                                "after": {"id": 1, "v": "b"},
+                                "op": "u"}}).encode(),
+        json.dumps({"payload": {"before": {"id": 1, "v": "b"},
+                                "after": None, "op": "d"}}).encode(),
+    ]
+    rows = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("ARROYO_FAST_DECODE", flag)
+        fmt = make_format("debezium_json")
+        rows[flag] = batch_to_rows(fmt.batch(payloads))
+    assert rows["1"] == rows["0"]
+    assert [r["__op"] for r in rows["1"]] == [
+        "append", "retract", "append", "retract"]
+
+
+@needs_arrow
+def test_schema_lock_and_mid_stream_drift_fallback(monkeypatch):
+    """First batch locks the stream's Arrow schema; a mid-stream type
+    conflict (schema drift) re-infers instead of crashing, and the
+    drifted batch still matches the legacy rows."""
+    monkeypatch.setenv("ARROYO_FAST_DECODE", "1")
+    fmt = JsonFormat()
+    b1 = [json.dumps({"v": i, "k": i}).encode() for i in range(3)]
+    fmt.batch(b1)
+    locked = fmt._pa_schema
+    assert locked is not None and "v" in locked.names
+    # same shape: the lock holds (no re-inference, same schema object
+    # semantics) and rows stay correct
+    out2 = fmt.batch(b1)
+    assert out2.columns["v"].dtype == np.int64
+    # drift: v becomes a string — explicit-schema parse fails, the
+    # stream re-locks on the inferred schema, rows match legacy
+    b3 = [json.dumps({"v": "zero", "k": 0}).encode(),
+          json.dumps({"v": "one", "k": 1}).encode()]
+    out3 = fmt.batch(b3)
+    legacy = _decode(b3, "legacy", monkeypatch)
+    assert batch_to_rows(out3) == batch_to_rows(legacy)
+    assert fmt._pa_schema is not None and not fmt._pa_schema.equals(locked)
+    # drift must not latch the fast path off
+    assert getattr(fmt, "_arrow_ok", True) is not False
+
+
+@needs_arrow
+def test_schema_lock_null_fills_absent_fields(monkeypatch):
+    """Column-set stability under the locked schema: a field absent
+    from a later batch null-fills instead of vanishing (keeps the
+    coalescer/data-plane signatures from flapping mid-stream)."""
+    monkeypatch.setenv("ARROYO_FAST_DECODE", "1")
+    fmt = JsonFormat()
+    fmt.batch([json.dumps({"a": 1, "b": 2}).encode()])
+    out = fmt.batch([json.dumps({"a": 3}).encode()])
+    assert "b" in out.columns
+    assert np.isnan(out.columns["b"][0])
+
+
+def test_bulk_path_latches_off_after_repeated_failures(monkeypatch):
+    """Payloads the array join mis-frames stop paying the doomed
+    join+parse after 3 consecutive failures (the row path answers)."""
+    monkeypatch.setenv("ARROYO_FAST_DECODE", "1")
+    fmt = JsonFormat()
+    fmt._arrow_ok = False
+    # a UTF-8 BOM parses per payload (json detects utf-8-sig) but makes
+    # the bulk [p1,p2] array framing invalid JSON — the row path must
+    # answer every time and the stream must stop paying the join+parse
+    bad = [b"\xef\xbb\xbf" + json.dumps({"a": 1}).encode(),
+           b"\xef\xbb\xbf" + json.dumps({"a": 2}).encode()]
+    for _ in range(4):
+        out = fmt.batch(bad)
+        assert out.columns["a"].tolist() == [1, 2]
+    assert fmt._bulk_fails >= 3
+
+
+def test_fast_decode_escape_reads_env_per_call(monkeypatch):
+    monkeypatch.setenv("ARROYO_FAST_DECODE", "0")
+    assert not fast_decode_enabled()
+    monkeypatch.setenv("ARROYO_FAST_DECODE", "1")
+    assert fast_decode_enabled()
+
+
+# -- egress ----------------------------------------------------------------
+
+
+def _tricky_batch():
+    f = np.array([1.5, np.nan, np.inf, -np.inf], dtype=np.float64)
+    return Batch(
+        np.arange(4, dtype=np.int64),
+        {
+            "i": np.array([1, -2, 3, 40], dtype=np.int64),
+            "f": f,
+            "b": np.array([True, False, True, False]),
+            "nb": np.array([True, None, False, None], dtype=object),
+            "s": np.array(["01234", 'q"uote', "café", "x\ny"],
+                          dtype=object),
+        },
+    )
+
+
+def test_egress_parity_tricky_columns(monkeypatch):
+    """serialize_batch fast vs legacy: byte-identical payloads across
+    NaN/inf floats, nullable bools, digit strings and escapes."""
+    batch = _tricky_batch()
+    fmt = JsonFormat()
+    monkeypatch.setenv("ARROYO_FAST_DECODE", "0")
+    legacy = fmt.serialize_batch(batch)
+    monkeypatch.setenv("ARROYO_FAST_DECODE", "1")
+    fast = fmt.serialize_batch(batch)
+    assert fast == legacy
+    # every line re-parses (NaN became null on this path, like _py)
+    parsed = [json.loads(p) for p in fast]
+    assert parsed[1]["f"] is None and parsed[0]["f"] == 1.5
+
+
+def test_egress_decode_roundtrip_parity(monkeypatch):
+    """fast-encode -> fast-decode round trip equals the legacy-legacy
+    round trip row for row (the two halves compose)."""
+    batch = Batch(
+        np.arange(3, dtype=np.int64),
+        {"a": np.array([1, 2, 3], dtype=np.int64),
+         "s": np.array(["x", "01", "z"], dtype=object),
+         "f": np.array([0.5, np.nan, 2.0])})
+    fmt = JsonFormat()
+    rows = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("ARROYO_FAST_DECODE", flag)
+        f2 = JsonFormat()
+        rows[flag] = batch_to_rows(f2.batch(fmt.serialize_batch(batch)))
+    assert rows["1"] == rows["0"]
+
+
+def test_encode_json_lines_falls_back_on_nested_columns():
+    """Columns the cell encoders can't express (nested dicts) return
+    None — serialize_batch then matches the legacy row path output."""
+    batch = Batch(
+        np.arange(2, dtype=np.int64),
+        {"k": np.array([1, 2], dtype=np.int64),
+         "nest": np.array([{"a": 1}, {"b": 2}], dtype=object)})
+    assert encode_json_lines(batch) is None
+    fmt = JsonFormat()
+    fast = fmt.serialize_batch(batch)
+    legacy = fmt.serialize(batch_to_rows(batch))
+    assert fast == legacy
+
+
+def test_encode_json_lines_matches_json_dumps_layout():
+    """Template rendering reproduces json.dumps' exact separators and
+    escaping, including a column name that contains a % sign."""
+    batch = Batch(
+        np.arange(2, dtype=np.int64),
+        {"p%ct": np.array([1, 2], dtype=np.int64),
+         "s": np.array(["a", "b"], dtype=object)})
+    lines = encode_json_lines(batch)
+    expected = [json.dumps({"p%ct": 1, "s": "a"}),
+                json.dumps({"p%ct": 2, "s": "b"})]
+    assert lines == expected
+
+
+def test_single_file_fast_path_pins_formats_semantics(monkeypatch):
+    """The single_file connector's fast path decodes through formats.py
+    (digit strings STAY strings, missing fields stay None) while the
+    ``ARROYO_FAST_DECODE=0`` escape reproduces the connector's
+    historical ad-hoc pivot bit-for-bit — which coerced an
+    object-dtype digit-string column (one produced by missing values)
+    to float64.  Both behaviors are pinned ON PURPOSE: the divergence
+    on this corner is the documented semantic unification, not an
+    accident (docs/operations.md § Ingest & egress)."""
+    from arroyo_tpu.connectors.single_file import _rows_to_batch
+
+    rows = [{"id": 0, "ts": 1}, {"id": 1, "code": "105", "ts": 2}]
+    payloads = [json.dumps(r).encode() for r in rows]
+
+    legacy = _rows_to_batch([json.loads(p) for p in payloads], "ts")
+    # historical connector pivot: object column of digit strings with a
+    # missing value coerces to float64 (None -> nan, "105" -> 105.0)
+    assert legacy.columns["code"].dtype == np.float64
+    assert np.isnan(legacy.columns["code"][0])
+    assert legacy.columns["code"][1] == 105.0
+
+    monkeypatch.setenv("ARROYO_FAST_DECODE", "1")
+    fast = JsonFormat().batch(payloads, "ts")
+    # formats.py pinned semantics: digit strings stay strings, the
+    # missing field stays None (object column)
+    assert fast.columns["code"].dtype == object
+    assert fast.columns["code"][0] is None
+    assert fast.columns["code"][1] == "105"

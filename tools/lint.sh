@@ -2,22 +2,22 @@
 # lint.sh — arroyolint gate: zero unwaived static-analysis findings.
 #
 # Runs every arroyolint pass (checkpoint-state arity, blocking-calls-
-# in-async, implicit host-device syncs, trace purity, proto drift, the
-# arroyosan await-point race detector and the barrier/watermark
-# protocol checker) over the package and fails on any finding that is
-# neither inline-waived (# arroyolint: disable=<pass> -- reason) nor
-# accepted in tools/arroyolint_baseline.json.  Wired into
-# tools/smoke.sh so the pre-snapshot gate rejects the round-5 bug
-# class (and the PR 3 await-race class) before a commit lands.
+# in-async, implicit host-device syncs, trace purity, proto drift,
+# per-row serde loops, the arroyosan await-point race detector and the
+# barrier/watermark protocol checker) over the package and fails on
+# any finding that is neither inline-waived (# arroyolint:
+# disable=<pass> -- reason) nor accepted in
+# tools/arroyolint_baseline.json.  Wired into tools/smoke.sh so the
+# pre-snapshot gate rejects the round-5 bug class (and the PR 3
+# await-race class) before a commit lands.
 #
-# The baseline is a ratchet: it was burned down from 57 accepted
-# findings to 16 (the rest are reasoned inline waivers now), and
-# --max-baseline fails the gate if it ever grows past that — new
-# findings must be fixed or inline-waived with a reason, never
-# silently accepted.
+# The baseline is a ratchet: burned down 57 -> 16 -> 0 — every
+# accepted finding is now a reasoned inline waiver at its site, and
+# --max-baseline 0 keeps it that way: new findings must be fixed or
+# inline-waived with a reason, never silently accepted.
 #
 # Usage: tools/lint.sh [extra arroyolint args]
 set -euo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
-exec python -m arroyo_tpu.analysis --max-baseline 16 "$@"
+exec python -m arroyo_tpu.analysis --max-baseline 0 "$@"

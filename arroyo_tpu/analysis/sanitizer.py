@@ -17,6 +17,12 @@ arms invariant assertions at the runtime's protocol choke points:
 - **per-edge batch schema stability**: the column layout of record
   batches on one edge must stay stable (a silent layout change
   corrupts the data-plane continuation-frame cache and coalescer);
+- **per-edge sharding stability**: an operator's OUTPUT sharding spec
+  on one shuffle edge must not flip mid-stream (device all_to_all one
+  batch, host route the next) — the resharding analogue of the
+  column-layout check: a flip means downstream consumers alternate
+  between pre-partitioned device arrays and host-routed rows, which
+  silently re-stages state every flip;
 - **checkpoint completeness**: each epoch sees exactly one completion
   per distinct (member operator, subtask) — a duplicate means two
   snapshots raced for the same slot.
@@ -112,6 +118,8 @@ class Sanitizer:
         self._edge_wm: Dict[Any, int] = {}
         # (edge key) -> (column names, key_cols, has key_hash)
         self._edge_schema: Dict[Any, Tuple] = {}
+        # (edge key) -> output sharding spec string ("keys@n" / "host@n")
+        self._edge_sharding: Dict[Any, str] = {}
         # epoch -> {(operator_id, subtask)} completions seen; epochs far
         # behind the newest are pruned (they can never recur within one
         # run — the controller's per-epoch trackers are bounded the same
@@ -180,6 +188,26 @@ class Sanitizer:
                 "schema-instability",
                 f"edge {edge}: batch layout changed mid-stream "
                 f"{prev} -> {sig}")
+
+    def on_sharding(self, edge: Any, spec: str) -> None:
+        """Per-edge output sharding stability: the routing decision for
+        one shuffle edge (on-device ``all_to_all`` vs host partition)
+        must be made once and hold for the stream's life.  The device
+        path is sticky-by-construction (``DeviceShuffle`` falls back
+        permanently on the first unsupported batch); a flip reaching
+        here means the stickiness broke — the resharding analogue of a
+        mid-stream column-layout change."""
+        prev = self._edge_sharding.get(edge)
+        if prev is None:
+            self._edge_sharding[edge] = spec
+            self.event("sharding", str(edge), spec)
+            return
+        if prev != spec:
+            self.event("sharding", str(edge), spec)
+            self.violation(
+                "sharding-instability",
+                f"edge {edge}: output sharding spec flipped mid-stream "
+                f"({prev} -> {spec})")
 
     def on_record_during_alignment(self, task: str, input_idx: int,
                                    counter: Any) -> None:

@@ -170,16 +170,27 @@ class Collector:
     downstream subtask queues for one downstream operator.  Forward edges have
     exactly one queue in the group (1:1); shuffle edges have one queue per
     downstream subtask and batches are split by vectorized
-    ``server_for_hash`` routing on key_hash.
+    ``server_for_hash`` routing on key_hash — or, when every destination
+    is co-located and the device shuffle is enabled
+    (``parallel/shuffle.py``), by ONE on-device ``all_to_all`` exchange
+    whose per-destination routing is bit-identical to the host path.
     """
 
     def __init__(self, edge_groups: List[List[OutQueue]],
-                 metrics: Optional[Any] = None, op_id: str = ""):
+                 metrics: Optional[Any] = None, op_id: str = "",
+                 sanitizer: Optional[Any] = None, subtask: int = 0):
         from ..obs import profiler
 
         self.edge_groups = edge_groups
         self.metrics = metrics
         self.op_id = op_id
+        # arroyosan: per-edge output-sharding stability (None unless
+        # armed — the hook is one `is not None` test per shuffle batch).
+        # The edge key carries the subtask index: stability is per
+        # PRODUCING subtask (two subtasks may legitimately decide the
+        # sticky device/host route differently if their data differs).
+        self.sanitizer = sanitizer
+        self.subtask = subtask
         # phase profiler: None unless armed at engine build — partition/
         # route CPU is then charged to `shuffle_prep`, enqueue awaits to
         # the overlapping `send_wait` (backpressure) wait phase
@@ -187,6 +198,23 @@ class Collector:
         self._rr = [0] * len(edge_groups)  # round-robin cursor per group
         self._local_qs = [q.queue for g in edge_groups for q in g
                           if q.queue is not None]
+        # lazily-decided per shuffle group: a DeviceShuffle when the
+        # group is co-located (all local queues) and the device path is
+        # enabled; None pins the host route for the edge's life
+        self._dev_shuffle: Dict[int, Optional[Any]] = {}
+
+    def _device_shuffle_for(self, gi: int, n: int) -> Optional[Any]:
+        ds = self._dev_shuffle.get(gi, False)
+        if ds is not False:
+            return ds
+        ds = None
+        from ..parallel import shuffle as _shuffle
+
+        if (_shuffle.device_shuffle_enabled(n)
+                and all(q.queue is not None for q in self.edge_groups[gi])):
+            ds = _shuffle.DeviceShuffle(n, op_id=self.op_id)
+        self._dev_shuffle[gi] = ds
+        return ds
 
     def _update_queue_gauges(self) -> None:
         # backpressure visibility (engine.rs QueueSizes -> prometheus
@@ -245,9 +273,29 @@ class Collector:
                     await (send(q, m) if send else q.send(m))
                     self._rr[gi] += 1
                 else:
+                    ds = self._device_shuffle_for(gi, n)
+                    parts = ds.route(batch) if ds is not None else None
+                    san = self.sanitizer
+                    if san is not None:
+                        san.on_sharding(
+                            (self.op_id, self.subtask, gi),
+                            f"keys@{n}" if parts is not None
+                            else f"host@{n}")
+                    if parts is not None:
+                        # co-located on-device shuffle: the exchange ran
+                        # as one all_to_all; destinations receive their
+                        # pre-partitioned rows (host order preserved)
+                        for i, sub in parts:
+                            q = group[i]
+                            m = Message.record(sub)
+                            await (send(q, m) if send else q.send(m))
+                        continue
                     # one O(n) native pass: dest + stable order + bounds
                     from ..native import partition_route
+                    from ..obs import perf as _perf
+                    from ..parallel.shuffle import HOST_ROUTES
 
+                    _perf.count(HOST_ROUTES)
                     _, order, bounds = partition_route(batch.key_hash, n)
                     for i in range(n):
                         lo, hi = bounds[i], bounds[i + 1]

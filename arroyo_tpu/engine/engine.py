@@ -164,6 +164,19 @@ class Engine:
         chain_plan = plan_chains(self.program)
         validate_chain_plan(self.program, chain_plan)
         chain_interior = {m for grp in chain_plan.groups for m in grp[1:]}
+        # observable mesh carriage: how many chain-interior SHUFFLE
+        # edges the active mesh carries as on-device all_to_all (0 when
+        # ARROYO_MESH=off — those edges are then plain identity-routed
+        # queue hops inside the chain).  Set UNCONDITIONALLY: the gauge
+        # is process-global per job_id, so a re-plan that lost its
+        # carried edges (rescale past parallelism 1, chaining off) must
+        # drop it back to 0, not report the previous topology forever.
+        from ..obs.metrics import mesh_carried_gauge
+        from ..parallel.mesh_window import mesh_key_shards
+
+        mesh_carried_gauge(self.job_id).set(
+            len(chain_plan.shuffle_edges)
+            if chain_plan.shuffle_edges and mesh_key_shards() > 1 else 0)
         # queues[(src_id, src_idx, dst_id, dst_idx)] — the reference's Quad
         queues: Dict[Tuple[str, int, str, int], asyncio.Queue] = {}
         qsize = config().queue_size
@@ -258,7 +271,8 @@ class Engine:
             for st in stores:
                 st.sanitizer = sanitizer
             collector = Collector(edge_groups, metrics_list[-1],
-                                  op_id=tail_id)
+                                  op_id=tail_id, sanitizer=sanitizer,
+                                  subtask=idx)
             if len(ms) == 1:
                 operator = build_operator(head_node.operator)
                 rwm = (stores[0].restore_watermark()

@@ -174,6 +174,14 @@ class BinAggOperator(Operator):
     async def on_start(self, ctx: Context) -> None:
         from ..ops.keyed_bins import filter_canonical_snapshot
 
+        par = ctx.task_info.parallelism
+        if par > 1 and hasattr(self.state, "set_route_shift"):
+            # subtask key ranges consume the TOP hash bits; the mesh
+            # must route on the bits below them or this subtask's whole
+            # key slice funnels onto ~nk/parallelism devices.  Must run
+            # before register_device: a restore re-shards by _shard_of.
+            self.state.set_route_shift((par - 1).bit_length())
+
         def snap():
             return self.state.snapshot() | self.keyvals.snapshot()
 

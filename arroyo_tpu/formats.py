@@ -243,12 +243,17 @@ def _json_cells(col: np.ndarray, nan_literal: str) -> Optional[List[str]]:
                 out.append("true" if v else "false")
             elif isinstance(v, (int, np.integer)):
                 out.append(str(int(v)))
+            elif isinstance(v, np.floating):
+                # must precede the plain-float branch: np.float64
+                # SUBCLASSES float, and repr(np.float64) renders
+                # 'np.float64(x)' under numpy>=2 — corrupt JSON; the
+                # legacy _py path also nulls np.floating NaN, which the
+                # python-float branch's 'NaN' literal would not
+                out.append(_float_cell(float(v), nan_literal))
             elif isinstance(v, float):
                 # a python-float NaN in an object column survives _py
                 # untouched, so legacy json.dumps emits the literal
                 out.append(_float_cell(v, "NaN"))
-            elif isinstance(v, np.floating):
-                out.append(_float_cell(float(v), nan_literal))
             elif isinstance(v, np.str_):
                 out.append(dumps(str(v)))
             elif isinstance(v, bytes):

@@ -73,11 +73,19 @@ class ChainPlan:
 
     ``groups`` holds only multi-member chains (head first, topo order);
     ``head_of`` maps every member of a multi-member chain to its head;
-    ``members_of`` maps each head to its full member list."""
+    ``members_of`` maps each head to its full member list.
+    ``shuffle_edges`` lists the chain-interior SHUFFLE edges (the
+    routing-trivial parallelism-1 crossings): when the mesh is active,
+    these are exactly the edges whose keyed exchange is carried by the
+    downstream state's on-device ``all_to_all`` instead of a queue hop
+    or data-plane frame — the engine exports the count as
+    ``arroyo_mesh_carried_shuffles`` so "the SHUFFLE edge rode the
+    mesh" is observable, not inferred."""
 
     groups: List[List[str]] = field(default_factory=list)
     head_of: Dict[str, str] = field(default_factory=dict)
     members_of: Dict[str, List[str]] = field(default_factory=dict)
+    shuffle_edges: List[tuple] = field(default_factory=list)
 
     def group_for(self, op_id: str) -> Optional[List[str]]:
         head = self.head_of.get(op_id)
@@ -130,6 +138,9 @@ def plan_chains(program: Program) -> ChainPlan:
         plan.members_of[op_id] = run
         for m in run:
             plan.head_of[m] = op_id
+        for u, v in zip(run, run[1:]):
+            if program.edge(u, v).typ is not EdgeType.FORWARD:
+                plan.shuffle_edges.append((u, v))
     return plan
 
 

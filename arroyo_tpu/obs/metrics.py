@@ -43,6 +43,12 @@ COALESCE_BATCHES = "arroyo_worker_coalesce_batches"
 # count of stalls past the watchdog threshold (blocking-call episodes)
 EVENT_LOOP_LAG = "arroyo_worker_event_loop_lag_seconds"
 EVENT_LOOP_STALLS = "arroyo_worker_event_loop_stalls_total"
+# sharded data plane (parallel/shuffle.py): implicit resharding/transfer
+# events on device-resident state (the "no resharding" invariant — this
+# counter staying 0 in steady state is MEASURED, not hoped), and the
+# on-device all_to_all exchanges that replaced host shuffles
+RESHARDS_TOTAL = "arroyo_worker_reshards_total"
+SHUFFLE_COLLECTIVES = "arroyo_worker_shuffle_collectives_total"
 
 LABELS = ("job_id", "operator_id", "subtask_idx", "operator_name")
 
@@ -256,6 +262,61 @@ def event_loop_stalls_counter(job_id: str) -> Counter:
                 "event-loop stalls past the watchdog threshold",
                 ("job_id",), registry=REGISTRY)
     return _event_loop_stalls.labels(job_id=job_id or "")
+
+
+# -- sharded-data-plane instruments (parallel/shuffle.py) --------------------
+
+# process-level (no operator label: resharding is detected at kernel
+# dispatch sites that may run off-task, e.g. executor-offloaded
+# transfers; the profiler's per-operator `reshard` phase carries the
+# attribution, these counters carry the invariant)
+_PLAIN_LABELS = ("job_id",)
+_plain_counters: Dict[str, Counter] = {}
+
+
+def _plain_counter(name: str, help_: str, job_id: str = "") -> Counter:
+    with _lock:
+        if name not in _plain_counters:
+            _plain_counters[name] = Counter(name, help_, _PLAIN_LABELS,
+                                            registry=REGISTRY)
+    return _plain_counters[name].labels(job_id=job_id)
+
+
+def reshard_counter(job_id: str = "") -> Counter:
+    """Device arrays re-placed because their resident sharding did not
+    match a kernel's explicit in_sharding — the sharded data plane's
+    zero-in-steady-state invariant (docs/operations.md runbook)."""
+    return _plain_counter(
+        RESHARDS_TOTAL,
+        "device arrays resharded at a kernel boundary (0 = invariant holds)",
+        job_id)
+
+
+def shuffle_collective_counter(job_id: str = "") -> Counter:
+    """On-device all_to_all exchanges carrying co-located SHUFFLE edges
+    (each one is a host shuffle that never happened)."""
+    return _plain_counter(
+        SHUFFLE_COLLECTIVES,
+        "on-device all_to_all shuffle exchanges", job_id)
+
+
+MESH_CARRIED_SHUFFLES = "arroyo_mesh_carried_shuffles"
+_mesh_carried: Optional[Gauge] = None
+
+
+def mesh_carried_gauge(job_id: str) -> Gauge:
+    """Chain-interior SHUFFLE edges whose keyed exchange rides the mesh
+    state's on-device all_to_all (graph/chaining.py ``shuffle_edges``
+    when the mesh is active) — 0 when the mesh is off or no chain
+    crosses a shuffle."""
+    global _mesh_carried
+    with _lock:
+        if _mesh_carried is None:
+            _mesh_carried = Gauge(
+                MESH_CARRIED_SHUFFLES,
+                "chain-interior shuffle edges carried by the device mesh",
+                ("job_id",), registry=REGISTRY)
+    return _mesh_carried.labels(job_id=job_id)
 
 
 # -- autoscaler instruments --------------------------------------------------

@@ -28,6 +28,13 @@ phase               measured where
 ``emit_encode``     sink-side encode (single_file JSON lines, ...)
 ``frame_encode``    data-plane Arrow IPC encode per frame
 ``frame_decode``    data-plane decode on the receiving worker
+``reshard``         device arrays re-placed because their resident
+                    sharding mismatched a kernel's explicit in_sharding
+                    (parallel/shuffle.ensure_sharded — steady state
+                    should show NO such phase at all)
+``shuffle_collective``  on-device ``all_to_all`` exchange carrying a
+                    co-located SHUFFLE edge (parallel/shuffle.py route
+                    dispatch + per-shard readback)
 ==================  =========================================================
 
 plus overlapping **wait** phases (reported separately, never summed into
@@ -90,7 +97,8 @@ __all__ = [
 
 WORK_PHASES = ("source_decode", "proc", "dispatch", "device_execute",
                "shuffle_prep", "coalesce_merge", "watermark", "checkpoint",
-               "emit_encode", "frame_encode", "frame_decode")
+               "emit_encode", "frame_encode", "frame_decode", "reshard",
+               "shuffle_collective")
 WAIT_PHASES = ("queue_wait", "coalesce_wait", "send_wait", "net_flush")
 
 

@@ -192,13 +192,19 @@ def _merge_ring_kernel(cap: int, db: int):
     return run
 
 
-def stage_ring(sorted_keys: np.ndarray) -> Tuple[Any, int]:
+def stage_ring(sorted_keys: np.ndarray,
+               device: Any = None) -> Tuple[Any, int]:
     """Upload a sorted key run into a fresh power-of-two SENTINEL-padded
-    device ring; returns (device array, capacity)."""
+    device ring; returns (device array, capacity).  ``device`` pins the
+    ring to one mesh device (state/join_state.py spreads hot partitions
+    over the ``("keys",)`` mesh via ``parallel.shuffle.partition_device``
+    so q7/q8-style joins stop funneling every ring through chip 0);
+    None keeps the default placement.  Later ``merge_ring``/``probe_ring``
+    dispatches follow the committed ring's device automatically."""
     cap = _bucket(max(len(sorted_keys), 1))
     padded = np.full(cap, SENTINEL, np.uint64)
     padded[: len(sorted_keys)] = sorted_keys
-    return jax.device_put(padded), cap
+    return jax.device_put(padded, device), cap
 
 
 def merge_ring(ring: Any, cap: int, res_pos: np.ndarray,

@@ -116,12 +116,24 @@ def _channel_rows(aggs, ch_kinds, valid_of, agg_inputs, n) -> np.ndarray:
 
 
 @functools.lru_cache(maxsize=256)
-def _update_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int, N: int):
+def _update_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int, N: int,
+                 shift: int = 0):
     """shard_map step: route rows over the mesh, merge keys, scatter bins.
 
     Global shapes: keys u64[nk*C]; bins f32[n_ch, nk*C, B];
     counts i32[nk*C, B]; of i32[nk, 2] (route-drop, key-drop counters);
     rows: key u64[nk*N], bin i32[nk*N], vals f32[n_ch, nk*N], ok bool[nk*N].
+
+    ``shift`` skips the top key-hash bits already consumed by subtask
+    key ranges (``set_route_shift``): at operator parallelism P > 1 each
+    subtask only ever sees a 1/P top-bit slice, and routing on those
+    same bits would funnel the whole mesh onto ~nk/P devices.
+
+    Compiled with explicit ``in_shardings``/``out_shardings`` over the
+    ``("keys",)`` axis (SNIPPETS [1][2]): state outputs carry exactly
+    the shardings the next call's inputs declare, so chained dispatches
+    hand off pre-partitioned device arrays with zero implicit
+    resharding — measured by ``parallel/shuffle.ensure_sharded``.
     """
     import jax
     import jax.numpy as jnp
@@ -129,7 +141,7 @@ def _update_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int, N: int):
         from jax import shard_map  # jax >= 0.5 top-level export
     except ImportError:
         from jax.experimental.shard_map import shard_map
-    from jax.sharding import PartitionSpec as P
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     n_ch = len(ch_kinds)
     lg = int(np.log2(nk)) if nk > 1 else 0
@@ -140,7 +152,8 @@ def _update_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int, N: int):
         # of i32[1, 2]; rows: this slice's N rows
         # ---- route: bucket rows by destination shard, all_to_all over ICI
         if nk > 1:
-            dest = (r_key >> np.uint64(64 - lg)).astype(jnp.int32)
+            routed = (r_key << np.uint64(shift)) if shift else r_key
+            dest = (routed >> np.uint64(64 - lg)).astype(jnp.int32)
             order = jnp.argsort(dest)
             d_s = dest[order]
             k_s, b_s = r_key[order], r_bin[order]
@@ -272,21 +285,29 @@ def _update_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int, N: int):
                    P("keys", None)),
         **_check_kw,
     )
-    return jax.jit(fn)
+    s1 = NamedSharding(mesh, P("keys"))
+    s_bins = NamedSharding(mesh, P(None, "keys", None))
+    s2 = NamedSharding(mesh, P("keys", None))
+    s_vals = NamedSharding(mesh, P(None, "keys"))
+    return jax.jit(fn,
+                   in_shardings=(s1, s_bins, s2, s2, s1, s1, s_vals, s1),
+                   out_shardings=(s1, s_bins, s2, s2))
 
 
 @functools.lru_cache(maxsize=256)
 def _fire_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int, W: int):
     """Pane emission: aggregate window bins for panes in
-    [first_rel, wm_rel].  Pure read — eviction is the separate roll step."""
+    [first_rel, wm_rel].  Pure read — eviction is the separate roll step.
+    Explicit in/out shardings: the state arrives exactly as the update
+    step left it (no implicit resharding between chained dispatches)."""
     import jax
     import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     # panes at relative index 0..B+W-2: the last ring bin (B-1) still
     # feeds panes up to B-1+W-1, which must be emittable on final flush
     PANES = B + W - 1
 
-    @jax.jit
     def run(keys, bins, counts, lims):
         first_rel, wm_rel = lims[0], lims[1]
         pane = jnp.arange(PANES, dtype=jnp.int32)
@@ -311,19 +332,29 @@ def _fire_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int, W: int):
         return (jnp.stack(outs) if outs else
                 jnp.zeros((0,) + cnts.shape)), cnts, mask
 
-    return run
+    mesh = _keys_mesh(nk)
+    s1 = NamedSharding(mesh, P("keys"))
+    s_bins = NamedSharding(mesh, P(None, "keys", None))
+    s2 = NamedSharding(mesh, P("keys", None))
+    rep = NamedSharding(mesh, P())
+    return jax.jit(run,
+                   in_shardings=(s1, s_bins, s2, rep),
+                   out_shardings=(NamedSharding(mesh, P(None, "keys",
+                                                        None)), s2, s2))
 
 
 @functools.lru_cache(maxsize=256)
 def _roll_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int):
     """Evict bins below the new base: shift the linear bin axis left by
-    ``shift`` and fill the tail with each channel's identity."""
+    ``shift`` and fill the tail with each channel's identity.  Output
+    shardings match the update step's state inputs, so the roll hands
+    the ring back pre-partitioned."""
     import jax
     import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
 
     inits = tuple(float(_init_value(AggKind(k))) for k in ch_kinds)
 
-    @jax.jit
     def run(bins, counts, shift):
         idx = jnp.arange(B, dtype=jnp.int32) + shift
         ok = idx < B
@@ -333,7 +364,12 @@ def _roll_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int):
                 for j in range(len(ch_kinds))]
         return jnp.stack(outs), counts
 
-    return run
+    mesh = _keys_mesh(nk)
+    s_bins = NamedSharding(mesh, P(None, "keys", None))
+    s2 = NamedSharding(mesh, P("keys", None))
+    return jax.jit(run,
+                   in_shardings=(s_bins, s2, NamedSharding(mesh, P())),
+                   out_shardings=(s_bins, s2))
 
 
 # ---------------------------------------------------------------------------
@@ -377,6 +413,11 @@ class MeshKeyedBinState:
         self.nk = n_shards or mesh_key_shards()
         self.C = _bucket(max(capacity // self.nk, 64))  # per-shard slots
         self.mesh = _keys_mesh(self.nk)
+        # key-hash bits to skip when routing (set_route_shift): subtask
+        # key ranges consume the TOP bits, so a parallel operator's mesh
+        # must route on the bits below them or every row funnels to the
+        # few shards covering this subtask's top-bit slice
+        self.route_shift = 0
 
         # host key directory (same layout as KeyedBinState for _emit)
         self.key_sorted = np.zeros(0, dtype=np.uint64)
@@ -419,10 +460,25 @@ class MeshKeyedBinState:
         self.d_of = put(jnp.zeros((self.nk, 2), jnp.int32),
                         NamedSharding(self.mesh, P("keys", None)))
 
+    def set_route_shift(self, shift: int) -> None:
+        """Skip the top ``shift`` key-hash bits when routing rows to
+        shards (host directory AND device route step stay in lockstep).
+        Set by BinAggOperator before any row lands when the operator
+        runs at parallelism > 1: subtask ranges split the top bits, so
+        without the shift every subtask's keys collapse onto the
+        ~nk/parallelism shards covering its range — the mesh silently
+        degenerates to one device per subtask."""
+        assert self.next_slot == 0 and self.total_rows == 0, \
+            "route shift must be set before any key is admitted"
+        assert 0 <= shift <= 32
+        self.route_shift = int(shift)
+
     def _shard_of(self, kh: np.ndarray) -> np.ndarray:
         if self.nk == 1:
             return np.zeros(len(kh), dtype=np.int64)
         lg = int(np.log2(self.nk))
+        if self.route_shift:
+            kh = kh << np.uint64(self.route_shift)
         return (kh >> np.uint64(64 - lg)).astype(np.int64)
 
     # -- host key directory ------------------------------------------------
@@ -596,11 +652,29 @@ class MeshKeyedBinState:
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..obs.perf import timed_device
+        from . import shuffle as _shuffle
 
         shard1 = NamedSharding(self.mesh, P("keys"))
-        step = _update_step(self._ch_kinds, self.nk, self.C, self.B, N)
+        # resharding invariant: state arrays must still carry the exact
+        # shardings the previous step's out_shardings pinned — a
+        # mismatch here is counted (and healed), never silently absorbed
+        s_bins = NamedSharding(self.mesh, P(None, "keys", None))
+        s2 = NamedSharding(self.mesh, P("keys", None))
+        d_keys = _shuffle.ensure_sharded(self.d_keys, shard1)
+        d_bins = _shuffle.ensure_sharded(self.d_bins, s_bins)
+        d_counts = _shuffle.ensure_sharded(self.d_counts, s2)
+        d_of = _shuffle.ensure_sharded(self.d_of, s2)
+        step = _update_step(self._ch_kinds, self.nk, self.C, self.B, N,
+                            self.route_shift)
+        if self.nk > 1:
+            # the route half of this step IS the keyed shuffle: one
+            # all_to_all over ICI instead of a host exchange
+            from ..obs import perf as _perf
+
+            _perf.count(_shuffle.COLLECTIVES)
+            _perf.count(_shuffle.COLLECTIVE_ROWS, m)
         self.d_keys, self.d_bins, self.d_counts, self.d_of = timed_device(
-            step, self.d_keys, self.d_bins, self.d_counts, self.d_of,
+            step, d_keys, d_bins, d_counts, d_of,
             jax.device_put(jnp.asarray(kh_p), shard1),
             jax.device_put(jnp.asarray(rel_p), shard1),
             jax.device_put(jnp.asarray(vals_p),
@@ -638,12 +712,21 @@ class MeshKeyedBinState:
 
         import jax
         import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..obs.perf import timed_device
+        from . import shuffle as _shuffle
 
+        d_keys = _shuffle.ensure_sharded(
+            self.d_keys, NamedSharding(self.mesh, P("keys")))
+        d_bins = _shuffle.ensure_sharded(
+            self.d_bins, NamedSharding(self.mesh, P(None, "keys", None)))
+        d_counts = _shuffle.ensure_sharded(
+            self.d_counts, NamedSharding(self.mesh, P("keys", None)))
+        self.d_keys, self.d_bins, self.d_counts = d_keys, d_bins, d_counts
         fire = _fire_step(self._ch_kinds, self.nk, self.C, self.B, self.W)
         outs, cnts, mask = timed_device(
-            fire, self.d_keys, self.d_bins, self.d_counts,
+            fire, d_keys, d_bins, d_counts,
             jnp.asarray([first_rel, wm_rel], jnp.int32))
         # transfer only the fired pane range, not the whole [.., B+W-1];
         # prefetch all four buffers so the readbacks overlap into ~one

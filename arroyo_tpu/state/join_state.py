@@ -91,7 +91,7 @@ class _Partition:
 
     __slots__ = ("cols", "keys", "ts", "n", "cap", "order", "skeys",
                  "sts", "valid_from", "dead", "_evicts_since_scan",
-                 "touches", "dev")
+                 "touches", "dev", "dev_device")
 
     def __init__(self) -> None:
         self.cols: Dict[str, np.ndarray] = {}
@@ -109,6 +109,10 @@ class _Partition:
         self._evicts_since_scan = 0
         self.touches = 0.0  # EWMA of rows handled per operation
         self.dev: Optional[Any] = None  # device-resident sorted-key ring
+        # mesh device owning this partition's ring (None = default chip;
+        # parallel.shuffle.partition_device spreads hot rings over the
+        # ("keys",) mesh so joins stop funneling through one device)
+        self.dev_device: Optional[Any] = None
 
     # -- storage -----------------------------------------------------------
 
@@ -213,12 +217,16 @@ class _Partition:
         self.dev = (dj.merge_ring(ring, cap, res_pos, dkeys, dpos), cap)
         perf.count("join_state_device_merges")
 
-    def promote(self) -> None:
+    def promote(self, device: Any = None) -> None:
         """Stage this partition's sorted keys into a preallocated
-        power-of-two device ring (idempotent; also used to regrow)."""
+        power-of-two device ring (idempotent; also used to regrow —
+        regrows keep the mesh device the first promotion pinned)."""
         from ..ops import join as dj
 
-        ring, cap = dj.stage_ring(self.skeys[: self.n])
+        if device is not None:
+            self.dev_device = device
+        ring, cap = dj.stage_ring(self.skeys[: self.n],
+                                  device=self.dev_device)
         self.dev = (ring, cap)
         perf.count("join_state_promotions")
 
@@ -413,9 +421,15 @@ class PartitionedJoinBuffer(BatchBuffer):
         # budget even when ALL partitions keep moderate traffic (an
         # absolute floor alone would let rings accumulate to P)
         grace = set(ranked[: budget + 2])
+        from ..parallel.shuffle import partition_device
+
         for p, part in enumerate(self.parts):
             if p in hot and part.dev is None:
-                part.promote()
+                # sharded device placement over the same ("keys",) mesh
+                # axis the window state uses: partition p's ring lives on
+                # mesh device p % nk (deterministic — promotion stays a
+                # pure function of the observed data sequence)
+                part.promote(device=partition_device(p))
             elif part.dev is not None and p not in hot and (
                     part.touches < floor / 2 or p not in grace):
                 part.demote()
@@ -649,8 +663,14 @@ class PartitionedJoinBuffer(BatchBuffer):
                                   else n * 8 for v in part.cols.values())
                               + part.keys[:n].nbytes + part.ts[:n].nbytes)
         rows = sum(max(part.n - part.dead, 0) for part in self.parts)
+        # mesh spread of resident rings: >1 means hot partitions are NOT
+        # funneling through one device (the q7/q8 sharded-placement win)
+        ring_devs = {str(part.dev_device) for part in self.parts
+                     if part.dev is not None
+                     and part.dev_device is not None}
         return {"partitions": self.P, "hot_partitions": hot,
-                "spill_bytes": host_bytes, "rows": rows}
+                "spill_bytes": host_bytes, "rows": rows,
+                "ring_devices": len(ring_devs)}
 
 
 _BUF_UIDS = itertools.count()
@@ -668,6 +688,9 @@ def aggregate_stats_registry(reg: Optional[Dict[Any, Dict[str, Any]]]
            "buffers": len(entries)}
     for k in ("hot_partitions", "spill_bytes", "rows"):
         out[k] = int(sum(e.get(k, 0) for e in entries))
+    # mesh spread is per buffer; the fold reports the widest one
+    out["ring_devices"] = int(max(e.get("ring_devices", 0)
+                                  for e in entries))
     return out
 
 

@@ -401,6 +401,9 @@ def run_query(name: str, sql_template: str) -> dict:
     flight_before = job_operator_summary("local-job")
     dispatches_before = perf.counter("kernel_dispatches")
     join_before = {k: perf.counter(k) for k in JOIN_STATE_COUNTERS}
+    from arroyo_tpu.parallel import shuffle as _shuffle
+
+    shuffle_before = _shuffle.shuffle_stats()
     n_runs = 2
     best_dt = None
     for _ in range(n_runs):
@@ -433,6 +436,23 @@ def run_query(name: str, sql_template: str) -> dict:
         "coalesce": coalescing_enabled(),
         "dispatches_per_event": round(
             dispatches / max(NUM_EVENTS * n_runs, 1), 6),
+    }
+    # sharded-data-plane evidence: mesh shape + the reshard invariant
+    # (reshards MUST stay 0 across the timed runs — a nonzero value
+    # means some kernel's inputs arrived mis-partitioned) and how many
+    # host shuffles the on-device path replaced
+    import jax as _jax
+
+    from arroyo_tpu.parallel.mesh_window import mesh_key_shards
+
+    shuffle_delta = {k: v - shuffle_before[k]
+                     for k, v in _shuffle.shuffle_stats().items()}
+    result["mesh"] = {
+        "width": mesh_key_shards(),
+        "devices": len(_jax.devices()),
+        "reshards": shuffle_delta["reshards"],
+        "shuffle_collectives": shuffle_delta["collectives"],
+        "host_shuffle_routes": shuffle_delta["host_routes"],
     }
     if flight:
         result["operators"] = flight
@@ -1403,6 +1423,135 @@ def run_autoscale_bench() -> dict:
             "value": result["actuations"], "autoscale": result}
 
 
+def main_mesh_child() -> None:
+    """One point of the mesh-scaling sweep: q5 (and a reduced join-
+    stress run) at ONE mesh width, in its own process — XLA's device
+    count and the mesh shape are frozen at backend init, so the sweep
+    cannot share a process across widths.  Prints one JSON line with
+    events/s plus the sharded-data-plane counters (reshards MUST be 0:
+    the no-resharding invariant, measured per width)."""
+    os.environ.setdefault("BATCH_SIZE", str(BATCH))
+    os.environ.setdefault("STATE_CAPACITY", str(1 << 17))
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    from arroyo_tpu.connectors.memory import clear_sink, sink_output
+    from arroyo_tpu.engine.engine import LocalRunner
+    from arroyo_tpu.parallel import shuffle as _shuffle
+    from arroyo_tpu.parallel.mesh_window import mesh_key_shards
+    from arroyo_tpu.sql import plan_sql
+
+    width = int(os.environ["BENCH_MESH_CHILD"])
+    n = int(os.environ.get("BENCH_MESH_EVENTS", 300_000))
+    prog = plan_sql(QUERIES["q5"].format(n=n, b=BATCH),
+                    parallelism=bench_parallelism())
+    preflight_validate(prog, "mesh_scaling_q5")
+    clear_sink("results")
+    LocalRunner(prog).run()  # warm: compiles out of the timed window
+    before = _shuffle.shuffle_stats()
+    best = None
+    for _ in range(2):
+        clear_sink("results")
+        t0 = time.perf_counter()
+        LocalRunner(prog).run()
+        dt = time.perf_counter() - t0
+        best = dt if best is None else min(best, dt)
+    assert sum(len(b) for b in sink_output("results")) > 0, \
+        "mesh-sweep q5 produced no output"
+    delta = {k: v - before[k]
+             for k, v in _shuffle.shuffle_stats().items()}
+    out = {
+        "width": width,
+        "devices": len(jax.devices()),
+        "mesh_width": mesh_key_shards(),
+        "events": n,
+        "events_per_sec": round(n / best, 1),
+        "reshards": delta["reshards"],
+        "collectives": delta["collectives"],
+        "host_shuffle_routes": delta["host_routes"],
+    }
+    if os.environ.get("BENCH_MESH_JOIN", "1") not in ("0", "false", "no"):
+        os.environ.setdefault("BENCH_JOIN_STRESS_EVENTS", "120000")
+        # the sweep measures MESH behavior: resident join rings (and
+        # their spread over the mesh, join_state.ring_devices) are part
+        # of it, so the device-join auto=off-on-cpu policy is overridden
+        # for this child only
+        os.environ.setdefault("ARROYO_DEVICE_JOIN", "on")
+        try:
+            js = run_join_stress()
+            out["join_stress_events_per_sec"] = js["value"]
+            out["join_state"] = {
+                k: js.get("join_state", {}).get(k)
+                for k in ("hot_partitions", "ring_devices")}
+        except Exception as e:  # the q5 point must still print
+            out["join_stress_error"] = f"{type(e).__name__}: {e}"[:200]
+    print(json.dumps(out))
+
+
+def run_mesh_scaling(backend: str):
+    """Mesh-scaling bench family (ROADMAP 1): q5 + the join-stress
+    family swept across mesh widths, one bounded subprocess per width.
+    On the CPU box widths are fake XLA host devices
+    (``--xla_force_host_platform_device_count``); on a TPU box the real
+    chips carry the mesh.  Records events/s per width, scaling
+    efficiency vs width 1, and the reshard/collective counters.
+    ``BENCH_MESH_SWEEP=0`` skips."""
+    if os.environ.get("BENCH_MESH_SWEEP", "1") in ("0", "false", "no"):
+        return None
+    widths = [int(w) for w in os.environ.get(
+        "BENCH_MESH_WIDTHS", "1,2,4,8").split(",") if w.strip()]
+    timeout = float(os.environ.get("BENCH_MESH_TIMEOUT", 420))
+    points = []
+    for w in widths:
+        env = dict(os.environ, BENCH_MESH_CHILD=str(w),
+                   ARROYO_MESH=str(w) if w > 1 else "off",
+                   BENCH_ALL="0")
+        env.pop("BENCH_CHILD", None)
+        if backend == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
+            flags = env.get("XLA_FLAGS", "")
+            if "xla_force_host_platform_device_count" not in flags:
+                env["XLA_FLAGS"] = (
+                    flags + " --xla_force_host_platform_device_count="
+                    f"{max(widths)}").strip()
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)], env=env,
+                stdout=subprocess.PIPE, timeout=timeout, text=True)
+        except subprocess.TimeoutExpired:
+            points.append({"width": w, "error": "timeout"})
+            continue
+        if r.returncode == 0 and r.stdout.strip():
+            points.append(json.loads(r.stdout.strip().splitlines()[-1]))
+        else:
+            points.append({"width": w, "error": f"rc={r.returncode}"})
+        print(json.dumps({"mesh_scaling_point": points[-1]}),
+              file=sys.stderr)
+    base = next((p.get("events_per_sec") for p in points
+                 if p.get("width") == 1 and "events_per_sec" in p), None)
+    for p in points:
+        if base and "events_per_sec" in p:
+            p["speedup_vs_width1"] = round(p["events_per_sec"] / base, 3)
+            p["scaling_efficiency"] = round(
+                p["events_per_sec"] / (base * max(p["width"], 1)), 3)
+    return {"metric": "mesh_scaling", "widths": widths, "points": points}
+
+
+def emit_mesh_scaling(backend: str):
+    """Mesh-scaling family: returned for embedding in the headline line
+    (events/s per mesh width + reshard/collective counters)."""
+    try:
+        ms = run_mesh_scaling(backend)
+    except Exception as e:  # the headline must still print
+        print(f"mesh-scaling bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+    if ms is not None:
+        print(json.dumps(ms), file=sys.stderr)
+    return ms
+
+
 def main_kernels_child() -> None:
     import jax  # noqa: F401  (fail fast if the backend is unreachable)
 
@@ -1473,7 +1622,8 @@ def main_child() -> None:
                 continue
             env = dict(os.environ, BENCH_CHILD="1", BENCH_ALL="0",
                        BENCH_QUERY=name, BENCH_LAT_SECS="0",
-                       BENCH_CONFIG5="0", BENCH_JOIN_STRESS="0")
+                       BENCH_CONFIG5="0", BENCH_JOIN_STRESS="0",
+                       BENCH_MESH_SWEEP="0")
             try:
                 r = subprocess.run(
                     [sys.executable, os.path.abspath(__file__)], env=env,
@@ -1500,6 +1650,9 @@ def main_child() -> None:
         dec = emit_decode()
         if dec is not None:
             headline_result["decode"] = dec
+        ms = emit_mesh_scaling(backend)
+        if ms is not None:
+            headline_result["mesh_scaling"] = ms
         print(json.dumps(headline_result))
     else:
         result = run_query(headline, QUERIES[headline])
@@ -1514,6 +1667,9 @@ def main_child() -> None:
         dec = emit_decode()
         if dec is not None:
             result["decode"] = dec
+        ms = emit_mesh_scaling(backend)
+        if ms is not None:
+            result["mesh_scaling"] = ms
         print(json.dumps(result))
 
 
@@ -1708,6 +1864,8 @@ if __name__ == "__main__":
             sys.exit(1)
     elif os.environ.get("BENCH_KERNELS_CHILD"):
         main_kernels_child()
+    elif os.environ.get("BENCH_MESH_CHILD"):
+        main_mesh_child()
     elif os.environ.get("BENCH_CHILD"):
         main_child()
     else:

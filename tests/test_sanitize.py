@@ -430,6 +430,51 @@ def test_schema_instability_raises_but_dtype_promotion_allowed():
     assert ei.value.code == "schema-instability"
 
 
+def test_sharding_instability_raises_on_flip():
+    """The sharded-data-plane invariant: an edge that routed on-device
+    must not silently fall back to the host route mid-stream (or vice
+    versa) — the resharding analogue of the column-layout check."""
+    san = Sanitizer()
+    edge = ("opX", 0)
+    san.on_sharding(edge, "keys@4")
+    san.on_sharding(edge, "keys@4")  # stable: fine
+    san.on_sharding(("opY", 0), "host@4")  # other edge: independent
+    with pytest.raises(SanitizerError) as ei:
+        san.on_sharding(edge, "host@4")
+    assert ei.value.code == "sharding-instability"
+    assert any(e[1] == "sharding" for e in ei.value.events)
+
+
+def test_sharding_instability_engine_injected(monkeypatch, rng):
+    """Injected violation through the REAL Collector: force a device-
+    routed edge, then break the DeviceShuffle's stickiness so the next
+    batch takes the host route — the sanitizer must raise."""
+    import asyncio as aio
+
+    from arroyo_tpu.engine.context import Collector, OutQueue
+    from arroyo_tpu.types import hash_columns
+
+    monkeypatch.setenv("ARROYO_SHUFFLE_DEVICE", "on")
+    keys = rng.integers(0, 64, 500).astype(np.int64)
+    kh = hash_columns([keys])
+    b = Batch(np.zeros(500, np.int64), {"k": keys}, kh, ("k",))
+    san = Sanitizer("inject")
+    qs = [aio.Queue(maxsize=100) for _ in range(4)]
+    coll = Collector([[OutQueue(queue=q) for q in qs]],
+                     op_id="opZ", sanitizer=san)
+
+    async def scenario():
+        await coll.collect(b)
+        # sabotage: disable the device path mid-stream (the stickiness
+        # DeviceShuffle guarantees, deliberately broken)
+        coll._dev_shuffle[0] = None
+        await coll.collect(b)
+
+    with pytest.raises(SanitizerError) as ei:
+        aio.run(scenario())
+    assert ei.value.code == "sharding-instability"
+
+
 def test_barrier_crossing_detection():
     class Counter:
         seen = {7: {0}}

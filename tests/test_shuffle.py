@@ -1,0 +1,236 @@
+"""Sharded-by-default data plane (parallel/shuffle.py): on-device
+co-located shuffle parity with the host route, the resharding invariant
+(ensure_sharded counting), Collector integration at real parallelism,
+and the mesh placement of hot join rings."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from arroyo_tpu.native import partition_route
+from arroyo_tpu.obs import perf
+from arroyo_tpu.parallel import shuffle as shf
+from arroyo_tpu.types import Batch, hash_columns
+
+SEC = 1_000_000
+
+
+def _keyed_batch(rng, n=4000, nkeys=300):
+    keys = rng.integers(0, nkeys, n).astype(np.int64)
+    kh = hash_columns([keys])
+    return Batch(
+        np.sort(rng.integers(0, 10 * SEC, n)).astype(np.int64),
+        {"k": keys,
+         "v": rng.standard_normal(n),
+         "f32": rng.standard_normal(n).astype(np.float32),
+         "flag": rng.random(n) < 0.5,
+         "big": kh.copy(),  # u64 column: must survive bit-exact
+         "i32": rng.integers(-100, 100, n).astype(np.int32)},
+        kh, ("k",))
+
+
+@pytest.mark.parametrize("nd", [2, 4, 8])
+def test_device_route_matches_host_partition_route(rng, monkeypatch, nd):
+    """The on-device all_to_all exchange must deliver, per destination,
+    exactly the rows the host ``partition_route`` path delivers — same
+    rows, same order, same dtypes (u64 bit-exact)."""
+    monkeypatch.setenv("ARROYO_SHUFFLE_DEVICE", "on")
+    b = _keyed_batch(rng)
+    assert shf.device_shuffle_enabled(nd)
+    before = perf.counter(shf.COLLECTIVES)
+    parts = shf.DeviceShuffle(nd, op_id="t").route(b)
+    assert parts is not None
+    assert perf.counter(shf.COLLECTIVES) == before + 1
+    got = dict(parts)
+    _, order, bounds = partition_route(b.key_hash, nd)
+    for d in range(nd):
+        lo, hi = bounds[d], bounds[d + 1]
+        if hi == lo:
+            assert d not in got
+            continue
+        ref = b.select(order[lo:hi])
+        sub = got[d]
+        np.testing.assert_array_equal(sub.timestamp, ref.timestamp)
+        np.testing.assert_array_equal(sub.key_hash, ref.key_hash)
+        assert sub.key_cols == ref.key_cols
+        assert list(sub.columns) == list(ref.columns)
+        for c in ref.columns:
+            assert sub.columns[c].dtype == ref.columns[c].dtype, c
+            np.testing.assert_array_equal(sub.columns[c],
+                                          ref.columns[c], err_msg=c)
+
+
+def test_device_route_unsupported_batch_sticky_fallback(rng, monkeypatch):
+    """Object (string) columns cannot ride the device transport: route
+    returns None AND pins the host path for the edge's life, so the
+    edge's output sharding spec never flips mid-stream."""
+    monkeypatch.setenv("ARROYO_SHUFFLE_DEVICE", "on")
+    keys = rng.integers(0, 50, 200).astype(np.int64)
+    kh = hash_columns([keys])
+    stringy = Batch(np.zeros(200, np.int64),
+                    {"k": keys, "s": np.array(["x"] * 200, object)},
+                    kh, ("k",))
+    ds = shf.DeviceShuffle(4)
+    assert ds.route(stringy) is None
+    assert ds.route(_keyed_batch(rng)) is None  # sticky
+
+
+def test_device_shuffle_enabled_gates(monkeypatch):
+    monkeypatch.setenv("ARROYO_SHUFFLE_DEVICE", "on")
+    monkeypatch.setenv("ARROYO_MESH", "auto")
+    assert shf.device_shuffle_enabled(4)
+    assert not shf.device_shuffle_enabled(3)   # non-power-of-two
+    assert not shf.device_shuffle_enabled(16)  # beyond the 8-device mesh
+    monkeypatch.setenv("ARROYO_MESH", "off")
+    assert not shf.device_shuffle_enabled(4)   # mesh off = host topology
+    monkeypatch.setenv("ARROYO_MESH", "auto")
+    monkeypatch.setenv("ARROYO_SHUFFLE_DEVICE", "off")
+    assert not shf.device_shuffle_enabled(4)
+    # auto on the CPU backend stays off: device hop is pure overhead
+    monkeypatch.setenv("ARROYO_SHUFFLE_DEVICE", "auto")
+    assert not shf.device_shuffle_enabled(4)
+
+
+def test_ensure_sharded_counts_reshards_only_on_mismatch():
+    """Matched shardings pass through free; a mismatch counts ONE
+    reshard; host arrays count as ingest staging, never reshard."""
+    import jax
+
+    sh_keys = shf.keys_sharding(4, "keys")
+    sh_rep = shf.keys_sharding(4)
+    x = np.arange(64, dtype=np.int64)
+    r0 = perf.counter(shf.RESHARDS)
+    i0 = perf.counter(shf.INGEST_TRANSFERS)
+    d = shf.ensure_sharded(x, sh_keys)  # host -> device: ingest
+    assert perf.counter(shf.RESHARDS) == r0
+    assert perf.counter(shf.INGEST_TRANSFERS) == i0 + 1
+    d2 = shf.ensure_sharded(d, sh_keys)  # already matching: free
+    assert d2 is d
+    assert perf.counter(shf.RESHARDS) == r0
+    d3 = shf.ensure_sharded(d, sh_rep)  # mismatch: counted reshard
+    assert perf.counter(shf.RESHARDS) == r0 + 1
+    np.testing.assert_array_equal(np.asarray(jax.device_get(d3)), x)
+
+
+def test_collector_device_shuffle_end_to_end(rng, monkeypatch):
+    """A Collector with a co-located 4-way shuffle group routes through
+    the device exchange (ARROYO_SHUFFLE_DEVICE=on) and downstream queues
+    receive exactly the host path's rows; the sanitizer sees ONE stable
+    sharding spec."""
+    from arroyo_tpu.analysis.sanitizer import Sanitizer
+    from arroyo_tpu.engine.context import Collector, OutQueue
+    from arroyo_tpu.types import MessageKind
+
+    monkeypatch.setenv("ARROYO_SHUFFLE_DEVICE", "on")
+    b = _keyed_batch(rng, n=3000)
+
+    async def run(device_on):
+        monkeypatch.setenv("ARROYO_SHUFFLE_DEVICE",
+                           "on" if device_on else "off")
+        qs = [asyncio.Queue(maxsize=1000) for _ in range(4)]
+        san = Sanitizer("test")
+        coll = Collector([[OutQueue(queue=q) for q in qs]],
+                         op_id="opX", sanitizer=san)
+        await coll.collect(b)
+        await coll.collect(b)  # second batch: spec must not flip
+        out = []
+        for q in qs:
+            rows = []
+            while not q.empty():
+                msg = q.get_nowait()
+                assert msg.kind == MessageKind.RECORD
+                rows.append(msg.batch)
+            out.append(rows)
+        return out, san
+
+    c0 = perf.counter(shf.COLLECTIVES)
+    dev_out, san = asyncio.run(run(True))
+    assert perf.counter(shf.COLLECTIVES) == c0 + 2
+    assert san._edge_sharding == {("opX", 0, 0): "keys@4"}
+    host_out, _ = asyncio.run(run(False))
+    for d in range(4):
+        assert len(dev_out[d]) == len(host_out[d])
+        for db, hb in zip(dev_out[d], host_out[d]):
+            np.testing.assert_array_equal(db.key_hash, hb.key_hash)
+            for c in hb.columns:
+                np.testing.assert_array_equal(db.columns[c],
+                                              hb.columns[c])
+
+
+def test_engine_parallel2_device_shuffle_same_rows(monkeypatch):
+    """A real SQL pipeline at parallelism 2 (actual multi-destination
+    SHUFFLE edges) emits identical rows with the co-located device
+    shuffle on and off — and the device path actually ran."""
+    from arroyo_tpu.connectors.memory import clear_sink, sink_output
+    from arroyo_tpu.engine.engine import LocalRunner
+    from arroyo_tpu.sql import plan_sql
+
+    SQL = """
+    CREATE TABLE nexmark WITH (
+      connector = 'nexmark', event_rate = '1000000', num_events = '20000',
+      rate_limited = 'false', batch_size = '2048',
+      base_time_micros = '1700000000000000'
+    );
+    SELECT bid.auction as auction, TUMBLE(INTERVAL '2' SECOND) as window,
+           count(*) AS num
+    FROM nexmark WHERE bid is not null GROUP BY 1, 2
+    """
+
+    def run(mode):
+        monkeypatch.setenv("ARROYO_SHUFFLE_DEVICE", mode)
+        clear_sink("results")
+        LocalRunner(plan_sql(SQL, parallelism=2)).run()
+        return sorted(
+            (int(a), int(w), int(n))
+            for b in sink_output("results")
+            for a, w, n in zip(b.columns["auction"],
+                               b.columns["window_end"], b.columns["num"]))
+
+    c0 = perf.counter(shf.COLLECTIVES)
+    rows_dev = run("on")
+    assert perf.counter(shf.COLLECTIVES) > c0, \
+        "device shuffle never engaged at parallelism 2"
+    rows_host = run("off")
+    assert rows_dev and rows_dev == rows_host
+
+
+def test_join_ring_mesh_placement(rng, monkeypatch):
+    """Hot join-state partitions place their device rings across the
+    mesh (partition p -> device p % nk) instead of funneling through
+    chip 0; probes against mesh-placed rings stay bit-identical to the
+    host probe."""
+    import jax
+
+    from arroyo_tpu.state.join_state import PartitionedJoinBuffer
+
+    monkeypatch.setenv("ARROYO_DEVICE_JOIN", "on")
+    monkeypatch.setenv("ARROYO_MESH", "auto")
+    monkeypatch.setenv("ARROYO_JOIN_HOT_MIN_ROWS", "1")
+    monkeypatch.setenv("ARROYO_JOIN_HOT_PARTITIONS", "8")
+    buf = PartitionedJoinBuffer(n_partitions=8)
+    n = 20_000
+    keys = rng.integers(0, 5000, n).astype(np.int64)
+    kh = hash_columns([keys])
+    b = Batch(np.sort(rng.integers(0, 30 * SEC, n)).astype(np.int64),
+              {"k": keys, "v": rng.integers(0, 99, n)}, kh, ("k",))
+    for lo in range(0, n, 4096):
+        buf.append(b.select(np.arange(lo, min(lo + 4096, n))))
+    stats = buf.stats()
+    assert stats["hot_partitions"] >= 2
+    assert stats["ring_devices"] >= 2, stats
+    devices = {str(p.dev_device) for p in buf.parts if p.dev is not None}
+    assert len(devices) >= 2
+    assert all(p.dev_device in jax.devices() for p in buf.parts
+               if p.dev is not None)
+    # probe parity: device rings on non-default chips answer exactly
+    # like the host searchsorted probe
+    probe = np.sort(rng.choice(kh, 500, replace=False))
+    qidx_dev, gpos_dev = buf.probe_positions(probe, pre_sorted=True)
+    monkeypatch.setenv("ARROYO_DEVICE_JOIN", "off")
+    buf_host = PartitionedJoinBuffer(n_partitions=8)
+    buf_host.append(b)
+    qidx_h, gpos_h = buf_host.probe_positions(probe, pre_sorted=True)
+    pairs_dev = sorted(zip(qidx_dev.tolist(), gpos_dev.tolist()))
+    pairs_h = sorted(zip(qidx_h.tolist(), gpos_h.tolist()))
+    assert pairs_dev == pairs_h

@@ -71,7 +71,8 @@ def _keyed_state_kinds():
         OpKind.SLIDING_AGGREGATING_TOP_N, OpKind.WINDOW_JOIN,
         OpKind.JOIN_WITH_EXPIRATION, OpKind.NON_WINDOW_AGGREGATOR,
         OpKind.COUNT, OpKind.AGGREGATE, OpKind.WINDOW_ARGMAX,
-        OpKind.MULTI_WAY_JOIN,
+        OpKind.MULTI_WAY_JOIN, OpKind.WINDOW_FACTOR,
+        OpKind.DERIVED_WINDOW,
     }
 
 
@@ -131,6 +132,17 @@ def validate_program(program: "Program") -> List[PlanDiagnostic]:
             if node.max_parallelism != 1:
                 forwards = [s for s, _, d in in_edges
                             if d["edge"].typ is EdgeType.FORWARD]
+                if kind is OpKind.DERIVED_WINDOW:
+                    # the factored shape: a derived window's FORWARD
+                    # in-edge from its factor is co-partitioned by
+                    # construction (the factor is keyed-shuffled at
+                    # equal parallelism; 1:1 subtask pairing preserves
+                    # key ownership) — only NON-factor forwards are
+                    # unrouted
+                    forwards = [
+                        s for s in forwards
+                        if program.node(s).operator.kind
+                        is not OpKind.WINDOW_FACTOR]
                 if forwards:
                     diags.append(PlanDiagnostic(
                         "keyed-not-shuffled", "error",
@@ -139,6 +151,58 @@ def validate_program(program: "Program") -> List[PlanDiagnostic]:
                         f"edge(s) from {forwards}; each subtask would "
                         "see only a slice of each key's rows",
                         node=op_id))
+
+        if kind is OpKind.DERIVED_WINDOW:
+            spec = node.operator.spec
+            srcs = [s for s, _, _ in in_edges]
+            fsrcs = [s for s in srcs if program.node(s).operator.kind
+                     is OpKind.WINDOW_FACTOR]
+            if len(in_edges) != 1 or len(fsrcs) != 1:
+                diags.append(PlanDiagnostic(
+                    "factor-shape", "error",
+                    f"{node.operator.name} (derived_window) must be fed "
+                    "by exactly one window_factor node "
+                    f"(inputs: {srcs})", node=op_id))
+            else:
+                fnode = program.node(fsrcs[0])
+                pane = fnode.operator.spec.pane_micros
+                if (spec.pane_micros != pane
+                        or spec.slide_micros % max(pane, 1) != 0
+                        or spec.width_micros % max(pane, 1) != 0):
+                    diags.append(PlanDiagnostic(
+                        "factor-shape", "error",
+                        f"{node.operator.name}: factor pane {pane}us "
+                        f"must match the spec ({spec.pane_micros}us) "
+                        f"and divide slide {spec.slide_micros}us / "
+                        f"width {spec.width_micros}us", node=op_id))
+                if fnode.parallelism != node.parallelism:
+                    diags.append(PlanDiagnostic(
+                        "factor-shape", "error",
+                        f"{node.operator.name}: factor parallelism "
+                        f"{fnode.parallelism} != derived parallelism "
+                        f"{node.parallelism}; the FORWARD pane edge "
+                        "would rebalance and break keyed routing",
+                        node=op_id))
+
+        if kind is OpKind.WINDOW_FACTOR:
+            spec = node.operator.spec
+            if spec.pane_micros <= 0:
+                diags.append(PlanDiagnostic(
+                    "window-spec", "error",
+                    f"{node.operator.name}: factor pane must be "
+                    f"positive (got {spec.pane_micros})", node=op_id))
+            non_derived = [
+                dst for _, dst in g.out_edges(op_id)
+                if program.node(dst).operator.kind
+                is not OpKind.DERIVED_WINDOW]
+            if non_derived:
+                diags.append(PlanDiagnostic(
+                    "factor-shape", "error",
+                    f"{node.operator.name} (window_factor) emits "
+                    "partial-aggregate pane columns that only "
+                    "derived_window consumers understand "
+                    f"(non-derived consumers: {non_derived})",
+                    node=op_id))
 
         if kind in join_kinds:
             left = [d["edge"] for _, _, d in in_edges
@@ -199,8 +263,10 @@ def validate_program(program: "Program") -> List[PlanDiagnostic]:
 
         spec = node.operator.spec
         width = slide = None
-        if isinstance(spec, (SlidingAggregatorSpec,
-                             SlidingAggregatingTopNSpec)):
+        if kind is OpKind.DERIVED_WINDOW:
+            width, slide = spec.width_micros, spec.slide_micros
+        elif isinstance(spec, (SlidingAggregatorSpec,
+                               SlidingAggregatingTopNSpec)):
             width, slide = spec.width_micros, spec.slide_micros
         elif isinstance(spec, TumblingAggregatorSpec):
             width = spec.width_micros

@@ -312,6 +312,13 @@ class ControllerServer:
                          restore: bool = False,
                          ttl_secs: Optional[float] = None) -> str:
         job_id = job_id or f"job-{uuid.uuid4().hex[:8]}"
+        # factor-window rewrite BEFORE slot assignment: the controller's
+        # assignments are keyed by operator id, so the factor nodes must
+        # exist here, not only in each worker's engine-side (idempotent)
+        # re-application
+        from ..graph.factor_windows import apply_factor_windows
+
+        apply_factor_windows(program)
         job = Job(job_id, program,
                   checkpoint_url or config().checkpoint_url,
                   max(n.parallelism for n in program.nodes()))
@@ -351,11 +358,24 @@ class ControllerServer:
 
         A chain is the unit of parallelism: overrides addressed to any
         chained operator are expanded to the whole chain (otherwise the
-        rescale would split the chain and lose the fusion)."""
+        rescale would split the chain and lose the fusion).  So is a
+        factor-window group: the factor -> derived FORWARD edges carry
+        keyed co-partitioning, which a parallelism split would break."""
         from ..graph.chaining import expand_overrides
+        from ..graph.factor_windows import (
+            expand_overrides as expand_factor_overrides,
+        )
 
         job = self.jobs[job_id]
-        overrides = expand_overrides(job.program, overrides)
+        # fixpoint: factor expansion can add members whose CHAINS then
+        # need the override too (a derived window chaining with its
+        # post-projection), and vice versa — iterate until stable
+        # (override sets only grow, bounded by the node count)
+        prev: Dict[str, int] = {}
+        while overrides != prev:
+            prev = overrides
+            overrides = expand_overrides(job.program, overrides)
+            overrides = expand_factor_overrides(job.program, overrides)
         # worker count from the controller's own registry, BEFORE the
         # stop: schedulers' live listings are empty once workers exit
         n_workers = max(len(job.workers), 1)

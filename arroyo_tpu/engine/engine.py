@@ -90,6 +90,13 @@ class Engine:
         given with ``my_worker_id``, only this worker's subtasks are built and
         cross-worker edges ride the network data plane (``network`` must be a
         NetworkManager, ``worker_data_addrs`` maps worker_id -> host:port)."""
+        # factor-window sharing for Stream-API-built programs (SQL plans
+        # arrive already rewritten by the planner; the pass is idempotent
+        # — rewritten plans have no eligible member groups left).  Must
+        # run before validation so the validator sees the factored shape.
+        from ..graph.factor_windows import apply_factor_windows
+
+        self.factor_decisions = apply_factor_windows(program)
         errors = program.validate()
         if errors:
             raise ValueError("; ".join(errors))
@@ -177,6 +184,18 @@ class Engine:
         mesh_carried_gauge(self.job_id).set(
             len(chain_plan.shuffle_edges)
             if chain_plan.shuffle_edges and mesh_key_shards() > 1 else 0)
+        # factor-window shape (set unconditionally: a re-plan that lost
+        # its factored groups must drop the gauges to 0, same policy as
+        # the mesh-carried gauge)
+        from ..graph.logical import OpKind as _OpKind
+        from ..obs.metrics import (factor_derived_windows_gauge,
+                                   factor_shared_panes_gauge)
+
+        kinds = [n.operator.kind for n in self.program.nodes()]
+        factor_shared_panes_gauge(self.job_id).set(
+            kinds.count(_OpKind.WINDOW_FACTOR))
+        factor_derived_windows_gauge(self.job_id).set(
+            kinds.count(_OpKind.DERIVED_WINDOW))
         # queues[(src_id, src_idx, dst_id, dst_idx)] — the reference's Quad
         queues: Dict[Tuple[str, int, str, int], asyncio.Queue] = {}
         qsize = config().queue_size

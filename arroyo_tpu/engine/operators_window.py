@@ -258,6 +258,57 @@ class BinAggOperator(Operator):
         await ctx.collect(out)
 
 
+class FactorPaneOperator(BinAggOperator):
+    """The shared half of a factor-window rewrite
+    (graph/factor_windows.py): a width == slide == pane BinAggOperator
+    maintaining the member queries' decomposed partial aggregates once
+    per pane.  Watermark fires emit completed panes exactly like any
+    tumbling aggregate; the one extra behavior is the checkpoint-barrier
+    DRAIN — pending (watermark-incomplete) panes ship downstream as
+    deltas and reset on device BEFORE the snapshot, so this operator's
+    own table never holds un-shipped mass and a factored checkpoint
+    restores into an unfactored plan epoch for epoch (derived rings
+    merge deltas losslessly; see ``KeyedBinState.drain_deltas``)."""
+
+    def __init__(self, name: str, pane_micros: int,
+                 aggs: Tuple[AggSpec, ...]):
+        super().__init__(name, pane_micros, pane_micros, aggs)
+
+    async def pre_checkpoint(self, barrier, ctx: Context) -> None:
+        if self._offload_transfers():
+            from ..obs import perf
+
+            fired = await perf.run_offloaded(
+                asyncio.get_running_loop(), self.state.drain_deltas)
+        else:
+            fired = self.state.drain_deltas()
+        if fired is not None:
+            await self._emit(fired, ctx)
+
+
+class DerivedWindowOperator(BinAggOperator):
+    """The per-query half of a factor-window rewrite: a BinAggOperator
+    with the MEMBER's original (width, slide, aggs, projection) whose
+    ring runs in merge-input mode — updates consume fired factor panes
+    (one row per (key, pane), ``__f_*`` partial columns) instead of raw
+    events, so the per-event scatter cost lives once in the shared
+    factor while this ring pays only O(panes).  Channel layout, state
+    table name and canonical snapshot format are EXACTLY the unfactored
+    member's, so checkpoints interchange between factored and
+    unfactored plans (incl. rescale key-range filtering)."""
+
+    def __init__(self, name: str, width_micros: int, slide_micros: int,
+                 pane_micros: int, aggs: Tuple[AggSpec, ...],
+                 projection=None):
+        from ..graph.factor_windows import ROWS_COLUMN, derived_channel_cols
+
+        assert slide_micros % pane_micros == 0, \
+            "factor pane must divide the derived slide"
+        super().__init__(name, width_micros, slide_micros, aggs, projection)
+        self.pane = pane_micros
+        self.state.set_merge_inputs(derived_channel_cols(aggs), ROWS_COLUMN)
+
+
 def _topn_partition(batch: Batch, partition_cols: Tuple[str, ...]
                     ) -> np.ndarray:
     if partition_cols:
@@ -1821,6 +1872,19 @@ def _build_tumbling(op: LogicalOperator) -> Operator:
     return BinAggOperator(op.name, s.width_micros, s.width_micros, s.aggs,
                           s.projection,
                           argmax_local=getattr(s, "argmax_local", None))
+
+
+@register_builder(OpKind.WINDOW_FACTOR)
+def _build_window_factor(op: LogicalOperator) -> Operator:
+    s = op.spec
+    return FactorPaneOperator(op.name, s.pane_micros, s.aggs)
+
+
+@register_builder(OpKind.DERIVED_WINDOW)
+def _build_derived_window(op: LogicalOperator) -> Operator:
+    s = op.spec
+    return DerivedWindowOperator(op.name, s.width_micros, s.slide_micros,
+                                 s.pane_micros, s.aggs, s.projection)
 
 
 @register_builder(OpKind.SLIDING_AGGREGATING_TOP_N)

@@ -1,0 +1,543 @@
+"""Factor-window sharing — cost-based rewrite of correlated window
+aggregates onto shared pane state ("Factor Windows", PAPERS.md:
+arXiv:2008.12379).
+
+Correlated window aggregates — same upstream input, same key schema,
+decomposable aggregates, DIFFERENT widths/slides — each instantiate a
+private ``BinAggOperator`` ring today, so K overlapping windows pay K×
+the per-event pane-update cost (K scatter dispatches per batch, K
+emission readbacks).  This pass detects such sets over the logical
+graph and rewrites them so ONE **factor** operator maintains a shared
+tumbling pane ring of ``gcd(widths ∪ slides)`` micros, while each
+member query becomes a lightweight **derived window** consumer that
+rolls the fired factor panes into its own (width, slide) output —
+reusing the existing device bin-ring kernels on both halves, so
+derivation is a device-side scatter/segment-reduce over fired panes,
+never a host loop.
+
+Two correlated shapes are recognized:
+
+* **direct** — members fan out from one shared upstream node (the
+  Stream-API shape: ``keyed.sliding_aggregate(...)`` twice off the
+  same keyed stream).  The factor hangs off that node; member
+  aggregate input columns are shared by name.
+* **private-tail** — the SQL planner gives every query its own
+  ``agg_input_*`` projection + ``key_by`` below a common ancestor, so
+  members NEVER share an immediate upstream.  Members whose tails hang
+  off the same ancestor with structurally identical key expressions
+  (the ``aggin:`` canonical token) group; the rewrite synthesizes ONE
+  union projection (running each member's projection and renaming its
+  private ``__ain*`` aggregate inputs to token-keyed shared names, so
+  two queries aggregating the same expression share one input column
+  AND one factor partial) + one key_by + the factor, and the old
+  per-member tails are removed.
+
+Eligibility (all must hold per member):
+
+* kind is SLIDING_WINDOW_AGGREGATOR or TUMBLING_WINDOW_AGGREGATOR fed
+  by exactly one plain SHUFFLE edge (join sides and fan-in never
+  qualify);
+* every aggregate is bin-mergeable — the set ``ops/keyed_bins.py``
+  already maintains (COUNT/SUM/MIN/MAX/AVG, no UDAF/VEC/DISTINCT);
+* no ``argmax_local`` emission coupling (the argmax fusion owns that
+  operator's emission contract);
+* ``width % slide == 0`` (the bin-merged fast-path contract).
+
+Cost model: factoring trades K per-event ring updates for ONE update
+plus per-pane derivation work.  The factor pane is ``g = gcd(widths ∪
+slides)``; the rewrite wins unless ``g`` is pathologically small
+relative to the members' own firing cadence — the decision input is
+``ratio = min(slides) / g`` (how many times MORE often the factor ring
+fires than the finest member would have).  ``ratio <=
+ARROYO_FACTOR_MAX_RATIO`` (default 64) shares; a gcd-of-coprime-slides
+1 us pane is refused.  Every decision (shared or not) is recorded with
+its inputs for the bench/console.
+
+Checkpoint interchange: each derived node KEEPS its member's operator
+id and channel layout, and the factor operator drains its pending
+panes downstream at every checkpoint barrier (its own snapshot then
+holds no un-shipped mass) — so a factored checkpoint restores into an
+unfactored plan and vice versa, epoch for epoch (mirroring the PR 4
+chained/un-chained contract).
+
+``ARROYO_FACTOR_WINDOWS=0`` disables the pass entirely and reproduces
+the unfactored topology bit-for-bit (pinned by test).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import math
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .logical import (
+    AggKind,
+    AggSpec,
+    ColumnExpr,
+    DerivedWindowSpec,
+    EdgeType,
+    ExprReturnType,
+    FactorPaneSpec,
+    LogicalOperator,
+    OpKind,
+    Program,
+    SlidingAggregatorSpec,
+    TumblingAggregatorSpec,
+)
+
+# the bin-mergeable aggregate set (exactly what ops/keyed_bins maintains)
+MERGEABLE = frozenset({AggKind.COUNT, AggKind.SUM, AggKind.MIN,
+                       AggKind.MAX, AggKind.AVG})
+
+# the factor operator's per-pane row-mass column: COUNT(*) over the pane,
+# read from the counts plane (no extra transfer channel) and used by the
+# derived ring as the per-cell row count so COUNT(*) members stay exact
+ROWS_COLUMN = "__f_rows"
+
+_MEMBER_KINDS = (OpKind.SLIDING_WINDOW_AGGREGATOR,
+                 OpKind.TUMBLING_WINDOW_AGGREGATOR)
+
+
+def factor_windows_enabled() -> bool:
+    """``ARROYO_FACTOR_WINDOWS=0`` is the full escape hatch (read per
+    call so tests/smoke can toggle without a config reset; ``auto`` and
+    ``1`` both mean cost-model-decided sharing)."""
+    return os.environ.get("ARROYO_FACTOR_WINDOWS", "auto") not in (
+        "0", "off", "false")
+
+
+def max_pane_ratio() -> int:
+    """Largest acceptable ``min(slide) / pane`` blow-up before sharing
+    loses to per-query panes (``ARROYO_FACTOR_MAX_RATIO``)."""
+    return int(os.environ.get("ARROYO_FACTOR_MAX_RATIO", 64))
+
+
+@dataclass
+class FactorDecision:
+    """One cost-model evaluation over a correlated-window group."""
+
+    upstream: str  # the anchor node the shared input hangs off
+    members: List[str]
+    pane_micros: int
+    shared: bool
+    reason: str  # 'shared' | refusal cause
+    inputs: Dict[str, object] = field(default_factory=dict)
+    factor_node: Optional[str] = None  # set once the rewrite applied
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "upstream": self.upstream, "members": list(self.members),
+            "pane_micros": self.pane_micros, "shared": self.shared,
+            "reason": self.reason, "inputs": dict(self.inputs),
+            "factor_node": self.factor_node,
+        }
+
+
+@dataclass
+class _Candidate:
+    """One eligible member plus its (possibly private) input tail."""
+
+    member: str
+    anchor: str  # node the shared factor input will hang off
+    tail: Tuple[str, ...]  # private nodes anchor -> member, removed on rewrite
+    key_schema: str  # member in-edge key schema
+    key_token: str  # structural identity of the keying (groups members)
+    rename: Dict[str, str] = field(default_factory=dict)  # agg col renames
+
+
+def _member_params(spec) -> Tuple[int, int]:
+    """(width, slide) micros of a member aggregator spec."""
+    if isinstance(spec, TumblingAggregatorSpec):
+        return spec.width_micros, spec.width_micros
+    return spec.width_micros, spec.slide_micros
+
+
+def _aggin_parts(sql: str) -> Optional[Tuple[str, List[str]]]:
+    """Split an ``aggin:`` structural token into (key-exprs part, list of
+    canonical aggregate tokens) — None when not an aggin token."""
+    if not sql.startswith("aggin:") or "|" not in sql:
+        return None
+    keys_part, aggs_part = sql[len("aggin:"):].split("|", 1)
+    try:
+        import ast
+
+        toks = ast.literal_eval(aggs_part)
+    except (ValueError, SyntaxError):
+        return None
+    if not isinstance(toks, list):
+        return None
+    return keys_part, [str(t) for t in toks]
+
+
+def _shared_input_name(fc_token: str) -> str:
+    """Deterministic shared name for a member aggregate's input column,
+    keyed by the planner's canonical FunctionCall token.  Two queries
+    aggregating the same expression map to ONE column (and so one
+    factor partial).  AVG and SUM normalize together: their input
+    computations are identical (0.0-filled operand)."""
+    t = fc_token.replace("FunctionCall(name='avg'",
+                         "FunctionCall(name='sum'", 1)
+    return "__fin_" + hashlib.sha1(t.encode()).hexdigest()[:10]
+
+
+def _candidate(program: Program, op_id: str) -> Optional[_Candidate]:
+    """Build the member's candidate record, walking up through a
+    private [agg_input projection ->] key_by tail when present."""
+    g = program.graph
+    node = program.node(op_id)
+    if node.operator.kind not in _MEMBER_KINDS:
+        return None
+    spec = node.operator.spec
+    if getattr(spec, "argmax_local", None) is not None:
+        return None  # emission is coupled to a WindowArgmax consumer
+    width, slide = _member_params(spec)
+    if width <= 0 or slide <= 0 or width % slide != 0:
+        return None
+    for a in spec.aggs:
+        if a.kind not in MERGEABLE or a.fn is not None:
+            return None  # not bin-mergeable (UDAF/VEC/COUNT_DISTINCT)
+        if a.output.startswith("__f"):
+            return None  # would collide with factor partial naming
+    in_edges = list(g.in_edges(op_id, data=True))
+    if len(in_edges) != 1:
+        return None
+    src, _, data = in_edges[0]
+    edge = data["edge"]
+    if edge.typ is not EdgeType.SHUFFLE:
+        return None  # join sides / forwards never qualify
+
+    up = program.node(src)
+    if not (up.operator.kind is OpKind.KEY_BY and g.out_degree(src) == 1
+            and g.in_degree(src) == 1):
+        # direct shape: members share this upstream node (whatever it is)
+        return _Candidate(op_id, src, (), edge.key_schema,
+                          f"node:{src}:{edge.key_schema}")
+    kb_src, _, kb_data = next(iter(g.in_edges(src, data=True)))
+    if kb_data["edge"].typ is not EdgeType.FORWARD:
+        return _Candidate(op_id, src, (), edge.key_schema,
+                          f"node:{src}:{edge.key_schema}")
+    proj = program.node(kb_src)
+    parts = (_aggin_parts(proj.operator.expr.sql)
+             if proj.operator.kind in (OpKind.EXPRESSION, OpKind.UDF)
+             and proj.operator.expr is not None else None)
+    if (parts is not None and g.out_degree(kb_src) == 1
+            and g.in_degree(kb_src) == 1
+            and proj.operator.expr.return_type is ExprReturnType.RECORD):
+        anchor = next(iter(g.predecessors(kb_src)))
+        # member aggregate inputs rename to token-keyed shared names so
+        # per-query __ain indices can never collide across members
+        rename: Dict[str, str] = {}
+        for j, a in enumerate(spec.aggs):
+            if a.column is not None and j < len(parts[1]):
+                rename[a.column] = _shared_input_name(parts[1][j])
+        if any(a.column is not None and a.column not in rename
+               for a in spec.aggs):
+            # aggregate inputs not traceable to aggin tokens (renames
+            # would be unsound): fall back to requiring a shared node
+            return _Candidate(op_id, src, (), edge.key_schema,
+                              f"node:{src}:{edge.key_schema}")
+        return _Candidate(op_id, anchor, (kb_src, src), edge.key_schema,
+                          f"aggin:{parts[0]}", rename)
+    # private key_by without a recognizable projection: members sharing
+    # the key_by's own upstream and key columns can still group
+    anchor = kb_src
+    return _Candidate(op_id, anchor, (src,), edge.key_schema,
+                      f"keyby:{up.operator.key_cols}:{edge.key_schema}")
+
+
+def plan_factor_windows(program: Program) -> List[FactorDecision]:
+    """Pure analysis: group correlated members and run the cost model.
+    Returns every evaluated decision (shared AND refused) so the
+    bench/console can explain why a plan did or did not factor.  Empty
+    when the pass is disabled."""
+    return [d for d, _ in _plan(program)]
+
+
+def _plan(program: Program) -> List[Tuple[FactorDecision,
+                                          List[_Candidate]]]:
+    if not factor_windows_enabled():
+        return []
+    groups: Dict[Tuple, List[_Candidate]] = {}
+    for op_id in program.topo_order():
+        cand = _candidate(program, op_id)
+        if cand is None:
+            continue
+        node = program.node(op_id)
+        sig = (cand.anchor, len(cand.tail), cand.key_token,
+               node.parallelism, node.max_parallelism)
+        groups.setdefault(sig, []).append(cand)
+
+    out: List[Tuple[FactorDecision, List[_Candidate]]] = []
+    for (anchor, _tl, key_token, par, _mp), cands in groups.items():
+        if len(cands) < 2:
+            continue  # nothing to share
+        members = [c.member for c in cands]
+        params = [_member_params(program.node(m).operator.spec)
+                  for m in members]
+        widths = [w for w, _ in params]
+        slides = [s for _, s in params]
+        g = math.gcd(*(widths + slides))
+        ratio = min(slides) // max(g, 1)
+        inputs = {"k": len(members), "widths_micros": widths,
+                  "slides_micros": slides, "pane_micros": g,
+                  "pane_ratio": ratio,
+                  "max_pane_ratio": max_pane_ratio(),
+                  "key_token": key_token, "parallelism": par}
+        if ratio > max_pane_ratio():
+            # pathological gcd (e.g. coprime slides -> 1 us panes): the
+            # factor ring would fire `ratio`x more often than the finest
+            # member — per-pane overhead swamps the saved updates
+            out.append((FactorDecision(
+                anchor, members, g, False, "pane_ratio_exceeded",
+                inputs), cands))
+            continue
+        out.append((FactorDecision(anchor, members, g, True, "shared",
+                                   inputs), cands))
+    return out
+
+
+def factor_aggs_for(member_aggs: List[Tuple[AggSpec, ...]]
+                    ) -> Tuple[AggSpec, ...]:
+    """The factor operator's aggregate set: the DEDUPLICATED union of
+    the members' decomposed per-pane partials.  Two members aggregating
+    the same column share one partial channel — the sharing the rewrite
+    exists to exploit.
+
+    Per member aggregate:
+      COUNT(*)        -> the row-mass COUNT(*) partial (always present)
+      SUM(c)/AVG(c)   -> __f_sum_<c> (pane partial sum)
+      MIN(c)/MAX(c)   -> __f_min_<c> / __f_max_<c>
+      any column read -> __f_cnt_<c> (pane non-null count: COUNT(c)'s
+                         value AND every null-skipping agg's validity)
+    """
+    out: Dict[str, AggSpec] = {
+        ROWS_COLUMN: AggSpec(AggKind.COUNT, None, ROWS_COLUMN)}
+    for aggs in member_aggs:
+        for a in aggs:
+            if a.column is None:
+                continue  # COUNT(*): carried by ROWS_COLUMN
+            c = a.column
+            if a.kind in (AggKind.SUM, AggKind.AVG):
+                out.setdefault(f"__f_sum_{c}",
+                               AggSpec(AggKind.SUM, c, f"__f_sum_{c}"))
+            elif a.kind == AggKind.MIN:
+                out.setdefault(f"__f_min_{c}",
+                               AggSpec(AggKind.MIN, c, f"__f_min_{c}"))
+            elif a.kind == AggKind.MAX:
+                out.setdefault(f"__f_max_{c}",
+                               AggSpec(AggKind.MAX, c, f"__f_max_{c}"))
+            out.setdefault(f"__f_cnt_{c}",
+                           AggSpec(AggKind.COUNT, c, f"__f_cnt_{c}"))
+    return tuple(out.values())
+
+
+def partial_column(a: AggSpec) -> str:
+    """The factor partial column a member aggregate's VISIBLE channel
+    reads in merge-input mode."""
+    if a.column is None:
+        return ROWS_COLUMN  # COUNT(*): the per-pane row mass
+    if a.kind in (AggKind.SUM, AggKind.AVG):
+        return f"__f_sum_{a.column}"
+    if a.kind == AggKind.MIN:
+        return f"__f_min_{a.column}"
+    if a.kind == AggKind.MAX:
+        return f"__f_max_{a.column}"
+    return f"__f_cnt_{a.column}"  # COUNT(c)
+
+
+def derived_channel_cols(aggs: Tuple[AggSpec, ...]) -> Dict[int, str]:
+    """Channel index -> factor partial column for a derived ring whose
+    channel layout is ``build_channels(aggs)`` (the member's own layout,
+    so checkpoints stay interchangeable with unfactored plans).  Hidden
+    validity channels read the column's non-null-count partial."""
+    from ..ops.keyed_bins import build_channels
+
+    _, valid_ch = build_channels(aggs)
+    cols: Dict[int, str] = {}
+    for i, a in enumerate(aggs):
+        cols[i] = partial_column(a)
+    for src, j in valid_ch.items():
+        cols[j] = f"__f_cnt_{aggs[src].column}"
+    return cols
+
+
+def _union_projection(program: Program,
+                      cands: List[_Candidate]) -> Tuple[LogicalOperator,
+                                                        OpKind]:
+    """ONE projection node running every member's private ``agg_input``
+    fn over the shared anchor batch, renaming each member's ``__ain*``
+    outputs to their token-keyed shared names.  Key columns are
+    structurally identical across members (grouping requires equal
+    ``aggin`` key tokens), so first-writer-wins merging is sound."""
+    plans: List[Tuple[Callable, Dict[str, str]]] = []
+    kinds: List[OpKind] = []
+    used: Optional[set] = set()
+    for c in cands:
+        proj = program.node(c.tail[0]).operator
+        plans.append((proj.expr.fn, dict(c.rename)))
+        kinds.append(proj.kind)
+        u = getattr(proj.expr.fn, "used_cols", None)
+        if used is not None and u is not None:
+            used |= set(u)
+        else:
+            used = None
+
+    def union_fn(cols, _plans=tuple(plans)):
+        out: Dict[str, Any] = {}
+        for fn, ren in _plans:
+            o = dict(fn(cols))
+            o.pop("__timestamp", None)  # aggin projections never set it
+            for k, v in o.items():
+                out.setdefault(ren.get(k, k), v)
+        return out
+
+    if used is not None:
+        union_fn.used_cols = frozenset(used)
+    sqls = sorted(program.node(c.tail[0]).operator.expr.sql for c in cands)
+    expr = ColumnExpr("factor_input", union_fn, ExprReturnType.RECORD,
+                      sql="aggin-union:" + repr(sqls))
+    kind = OpKind.UDF if OpKind.UDF in kinds else OpKind.EXPRESSION
+    return LogicalOperator(kind, "factor_input", expr=expr), kind
+
+
+def apply_factor_windows(program: Program) -> List[FactorDecision]:
+    """Run the cost model and rewrite every shared group in place: ONE
+    new WINDOW_FACTOR node per group (fed through the group's shared —
+    possibly newly synthesized — projection/keying) and each member
+    node swapped — same operator id, same out-edges — to a
+    DERIVED_WINDOW consuming the factor's panes over a FORWARD edge
+    (1:1 subtask pairing preserves co-partitioning, so derived
+    consumers read pre-partitioned pane arrays with zero reshards).
+    Idempotent: already-rewritten plans have no eligible member groups.
+    Records the decisions on ``program.factor_decisions``."""
+    planned = _plan(program)
+    decisions = [d for d, _ in planned]
+    for d, cands in planned:
+        if not d.shared:
+            continue
+        members = [program.node(c.member) for c in cands]
+        par = members[0].parallelism
+        mp = members[0].max_parallelism
+        key_schema = cands[0].key_schema
+
+        # shared input chain up to the factor's SHUFFLE edge
+        tail_len = len(cands[0].tail)
+        if tail_len == 0:
+            feed = d.upstream  # members already shared this node
+        elif tail_len == 1:
+            # private key_bys off a common anchor: ONE key_by suffices
+            kb_old = program.node(cands[0].tail[0]).operator
+            kb = program.add_node(
+                LogicalOperator(OpKind.KEY_BY, kb_old.name,
+                                key_cols=kb_old.key_cols), par)
+            program.node(kb).max_parallelism = mp
+            program.add_edge(d.upstream, kb, EdgeType.FORWARD)
+            feed = kb
+        else:
+            # private [agg_input -> key_by] tails: union projection +
+            # one key_by replace them
+            proj_op, _k = _union_projection(program, cands)
+            anchor_edge = program.edge(d.upstream, cands[0].tail[0])
+            proj = program.add_node(proj_op, par)
+            program.node(proj).max_parallelism = mp
+            program.add_edge(d.upstream, proj, EdgeType.FORWARD,
+                             key_schema=anchor_edge.key_schema)
+            kb_old = program.node(cands[0].tail[1]).operator
+            kb = program.add_node(
+                LogicalOperator(OpKind.KEY_BY, kb_old.name,
+                                key_cols=kb_old.key_cols), par)
+            program.node(kb).max_parallelism = mp
+            program.add_edge(proj, kb, EdgeType.FORWARD)
+            feed = kb
+
+        f_aggs = factor_aggs_for(
+            [tuple(AggSpec(a.kind, c.rename.get(a.column, a.column)
+                           if a.column is not None else None, a.output)
+                   for a in program.node(c.member).operator.spec.aggs)
+             for c in cands])
+        f_op = LogicalOperator(
+            OpKind.WINDOW_FACTOR, f"factor_panes_{d.pane_micros}us",
+            spec=FactorPaneSpec(d.pane_micros, f_aggs))
+        fid = program.add_node(f_op, par)
+        program.node(fid).max_parallelism = mp
+        program.add_edge(feed, fid, EdgeType.SHUFFLE,
+                         key_schema=key_schema)
+        d.factor_node = fid
+
+        for c in cands:
+            m = program.node(c.member)
+            spec = m.operator.spec
+            width, slide = _member_params(spec)
+            aggs = tuple(
+                AggSpec(a.kind,
+                        c.rename.get(a.column, a.column)
+                        if a.column is not None else None,
+                        a.output)
+                for a in spec.aggs)
+            m.operator = LogicalOperator(
+                OpKind.DERIVED_WINDOW, m.operator.name,
+                spec=DerivedWindowSpec(width, slide, d.pane_micros,
+                                       aggs, spec.projection))
+            # drop the member's private tail (and with it the old
+            # upstream edge), then feed it the factor's panes 1:1
+            for t in c.tail:
+                program.graph.remove_node(t)
+            if program.graph.has_edge(d.upstream, c.member):
+                program.graph.remove_edge(d.upstream, c.member)
+            program.add_edge(fid, c.member, EdgeType.FORWARD,
+                             key_schema=key_schema)
+    # idempotent re-application (Engine.__init__ after the planner)
+    # re-finds refused groups but NOT already-rewritten shared ones —
+    # keep the prior shared records (their factor nodes are in the
+    # graph) so the decision log consumers read (bench factor objects,
+    # console) survives re-planning; refused groups re-evaluate fresh
+    kept = [d for d in getattr(program, "factor_decisions", []) or []
+            if d.shared and d.factor_node is not None
+            and program.graph.has_node(d.factor_node)]
+    decisions = kept + decisions
+    program.factor_decisions = decisions  # type: ignore[attr-defined]
+    return decisions
+
+
+def factor_groups(program: Program) -> Dict[str, List[str]]:
+    """{factor node id -> derived member ids} over an already-rewritten
+    program (rescale-path awareness; empty when nothing factored)."""
+    out: Dict[str, List[str]] = {}
+    for op_id in program.graph.nodes:
+        if program.node(op_id).operator.kind is OpKind.WINDOW_FACTOR:
+            out[op_id] = [
+                dst for _, dst in program.graph.out_edges(op_id)
+                if program.node(dst).operator.kind is OpKind.DERIVED_WINDOW]
+    return out
+
+
+def expand_overrides(program: Program,
+                     overrides: Dict[str, int]) -> Dict[str, int]:
+    """A factor group is a unit of parallelism: the factor -> derived
+    edges are FORWARD (1:1 subtask pairing carries the co-partitioning),
+    so a parallelism override addressed to any group member must apply
+    to the whole group or the rebalanced edge would break keyed
+    routing.  Same contract as ``chaining.expand_overrides``; the
+    larger target wins, capped at the group's smallest max_parallelism."""
+    groups = factor_groups(program)
+    if not groups:
+        return dict(overrides)
+    member_of: Dict[str, List[str]] = {}
+    for fid, derived in groups.items():
+        full = [fid] + derived
+        for m in full:
+            member_of[m] = full
+    out: Dict[str, int] = {}
+    for op_id, p in overrides.items():
+        group = member_of.get(op_id)
+        if group is None:
+            out[op_id] = max(out.get(op_id, 0), p)
+            continue
+        caps = [program.node(m).max_parallelism for m in group
+                if program.node(m).max_parallelism is not None]
+        target = min([p] + caps)
+        for m in group:
+            out[m] = max(out.get(m, 0), target)
+    return out

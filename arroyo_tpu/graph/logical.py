@@ -158,6 +158,10 @@ class OpKind(Enum):
     UNION = "union"  # N-ary stream merge (the reference bails on unions)
     WINDOW_ARGMAX = "window_argmax"  # fused self-join-on-window-max
     MULTI_WAY_JOIN = "multi_way_join"  # N-ary shared-key equi-join
+    # factor-window sharing (graph/factor_windows.py, "Factor Windows"
+    # PAPERS.md): ONE shared pane ring feeding per-query derived windows
+    WINDOW_FACTOR = "window_factor"  # shared factor-pane aggregate
+    DERIVED_WINDOW = "derived_window"  # rolls factor panes into a query window
 
 
 class JoinType(Enum):
@@ -211,6 +215,35 @@ class TumblingAggregatorSpec:
     aggs: Tuple[AggSpec, ...] = ()
     projection: Optional[ColumnExpr] = None
     argmax_local: Optional[Tuple[str, str]] = None  # see SlidingAggregatorSpec
+
+
+@dataclass
+class FactorPaneSpec:
+    """Operator::WindowFactor — the shared half of a factor-window rewrite
+    (graph/factor_windows.py).  One BinAggOperator ring of ``pane_micros``
+    tumbling panes maintains the UNION of the member queries' decomposed
+    partial aggregates (``__f_*`` columns) once per pane; the member
+    queries consume the fired panes as lightweight derived windows."""
+
+    pane_micros: int
+    aggs: Tuple[AggSpec, ...] = ()
+
+
+@dataclass
+class DerivedWindowSpec:
+    """Operator::DerivedWindow — the per-query half of a factor-window
+    rewrite: rolls fired factor panes of ``pane_micros`` into this
+    query's (width, slide) windows on the same device bin-ring kernels
+    (merge-input mode), emitting exactly the rows the original
+    sliding/tumbling aggregate would.  ``aggs``/``projection`` are the
+    ORIGINAL member spec's, so checkpoint state tables keep the member's
+    channel layout and epochs interchange with unfactored plans."""
+
+    width_micros: int
+    slide_micros: int
+    pane_micros: int
+    aggs: Tuple[AggSpec, ...] = ()
+    projection: Optional[ColumnExpr] = None
 
 
 @dataclass
@@ -474,6 +507,8 @@ class Program:
         OpKind.TUMBLING_WINDOW_AGGREGATOR,
         OpKind.TUMBLING_TOP_N,
         OpKind.SLIDING_AGGREGATING_TOP_N,
+        OpKind.WINDOW_FACTOR,
+        OpKind.DERIVED_WINDOW,
     }
 
     def validate(self) -> List[str]:

@@ -300,6 +300,40 @@ def shuffle_collective_counter(job_id: str = "") -> Counter:
         "on-device all_to_all shuffle exchanges", job_id)
 
 
+FACTOR_SHARED_PANES = "arroyo_factor_shared_panes"
+FACTOR_DERIVED_WINDOWS = "arroyo_factor_derived_windows"
+_factor_shared: Optional[Gauge] = None
+_factor_derived: Optional[Gauge] = None
+
+
+def factor_shared_panes_gauge(job_id: str) -> Gauge:
+    """Shared factor-pane operators in the running plan (one per
+    correlated-window group the cost model decided to share;
+    graph/factor_windows.py) — 0 when nothing factored or
+    ARROYO_FACTOR_WINDOWS=0."""
+    global _factor_shared
+    with _lock:
+        if _factor_shared is None:
+            _factor_shared = Gauge(
+                FACTOR_SHARED_PANES,
+                "shared factor-pane operators in the running plan",
+                ("job_id",), registry=REGISTRY)
+    return _factor_shared.labels(job_id=job_id)
+
+
+def factor_derived_windows_gauge(job_id: str) -> Gauge:
+    """Derived-window consumers rolling shared factor panes into their
+    query's (width, slide) output — 0 when nothing factored."""
+    global _factor_derived
+    with _lock:
+        if _factor_derived is None:
+            _factor_derived = Gauge(
+                FACTOR_DERIVED_WINDOWS,
+                "derived-window consumers over shared factor panes",
+                ("job_id",), registry=REGISTRY)
+    return _factor_derived.labels(job_id=job_id)
+
+
 MESH_CARRIED_SHUFFLES = "arroyo_mesh_carried_shuffles"
 _mesh_carried: Optional[Gauge] = None
 

@@ -578,6 +578,14 @@ class KeyedBinState:
         self._pending: List[Tuple[np.ndarray, np.ndarray, np.ndarray,
                                   np.ndarray]] = []
         self._pending_cells = 0
+        # merge-input mode (factor windows, graph/factor_windows.py):
+        # channel j reads an ALREADY-AGGREGATED per-pane partial column
+        # instead of deriving its contribution from raw rows, and the
+        # counts plane accumulates the per-pane row-mass column — the
+        # derived-window ring is then bit-compatible with the ring the
+        # unfactored member would have built from the same rows
+        self._merge_cols: Optional[Dict[int, str]] = None
+        self._rows_col: Optional[str] = None
 
     # -- key directory -----------------------------------------------------
 
@@ -609,42 +617,41 @@ class KeyedBinState:
 
     # -- update ------------------------------------------------------------
 
+    def set_merge_inputs(self, channel_cols: Dict[int, str],
+                         rows_col: str) -> None:
+        """Arm merge-input mode (must run before any row lands): channel
+        ``j`` reads ``channel_cols[j]`` — a per-(key, pane) partial of
+        its own kind (NaN = pane had no contributing rows, masked to the
+        channel identity) — and the per-cell row count accumulates
+        ``rows_col`` so COUNT(*) dup channels and the u16-proof bounds
+        stay exact row masses, not pane-arrival counts."""
+        assert self.next_slot == 0 and self.total_rows == 0, \
+            "merge inputs must be set before any key is admitted"
+        for j in self._xfer_ch:
+            assert j in channel_cols, f"no merge column for channel {j}"
+        self._merge_cols = dict(channel_cols)
+        self._rows_col = rows_col
+
     def update(self, key_hash: np.ndarray, timestamps: np.ndarray,
                agg_inputs: Dict[str, np.ndarray]) -> None:
         n = len(key_hash)
         if n == 0:
             return
-        # a row in bin b feeds panes b..b+W-1; it is late (dropped) only when
-        # all those panes already fired — matching the reference's
-        # drop-behind-watermark semantics.  Bin assignment + liveness +
-        # min/max run as one native pass (arroyo_assign_bins).
-        from ..native import assign_bins
+        # the factor-window cost claim, made measurable: rows entering
+        # pane-update state per event is ~K unfactored (every ring sees
+        # every event) vs ~1 + O(panes) factored (derived rings see only
+        # fired pane cells) — the correlated_windows bench reads these
+        from ..obs import perf
 
-        threshold = (self.last_fired_pane - self.W + 2
-                     if self.last_fired_pane is not None else None)
-        bins_mod, live, n_live, lo, hi = assign_bins(
-            timestamps, self.slide, self.B, threshold)
-        if n_live == 0:
+        perf.count("pane_update_rows", n)
+        if self._merge_cols is not None:
+            self._update_merged(key_hash, timestamps, agg_inputs)
             return
-        lo_new = lo if self.min_bin is None else min(self.min_bin, lo)
-        hi_new = hi if self.max_bin is None else max(self.max_bin, hi)
-        # ring capacity check BEFORE extending min/max: _grow_ring copies
-        # the ring span [min_bin, max_bin] into the wider ring, so the
-        # bounds must still describe what the OLD ring actually holds —
-        # growing after extending them replicated old slots into the
-        # about-to-be-written range (ghost duplicates under far-apart
-        # sources, e.g. two impulse splits with staggered time bases)
-        if hi_new - lo_new >= self.B:
-            self._grow_ring(hi_new - lo_new + 1)
-            bins_mod = ((timestamps // self.slide) % self.B).astype(np.int32)
-        self.min_bin = lo_new
-        self.max_bin = hi_new
-        self.total_rows += int(n_live)
-        if (self.total_rows >= self._i32_promote
-                and self.counts.dtype == jnp.int32):
-            # the next accumulation could wrap an i32 cell or pane sum:
-            # promote BEFORE it lands (kernels retrace on the new dtype)
-            self.counts = self.counts.astype(jnp.int64)
+        admitted = self._admit_bins(timestamps)
+        if admitted is None:
+            return
+        bins_mod, live, n_live, lo, hi = admitted
+        self._note_mass(int(n_live))
 
         slots = self._lookup_or_insert(key_hash)
 
@@ -674,17 +681,64 @@ class KeyedBinState:
                     slots[idx], bins_mod[idx], vals[:, idx]
             slots_c, bins_c, rowcnt, vals_c = preaggregate(
                 slots, bins_mod, xfer_kinds, vals)
+        self._enqueue_cells(slots_c, bins_c, rowcnt, vals_c, lo, hi)
+
+    def _admit_bins(self, timestamps: np.ndarray
+                    ) -> Optional[Tuple[np.ndarray, np.ndarray, int,
+                                        int, int]]:
+        """Shared update prologue (raw AND merge-input paths): a row in
+        bin b feeds panes b..b+W-1 and is late (dropped) only when all
+        those panes already fired — the reference's drop-behind-watermark
+        semantics.  Bin assignment + liveness + min/max run as one
+        native pass; returns (bins_mod, live, n_live, lo, hi), or None
+        when nothing is live."""
+        from ..native import assign_bins
+
+        threshold = (self.last_fired_pane - self.W + 2
+                     if self.last_fired_pane is not None else None)
+        bins_mod, live, n_live, lo, hi = assign_bins(
+            timestamps, self.slide, self.B, threshold)
+        if n_live == 0:
+            return None
+        lo_new = lo if self.min_bin is None else min(self.min_bin, lo)
+        hi_new = hi if self.max_bin is None else max(self.max_bin, hi)
+        # ring capacity check BEFORE extending min/max: _grow_ring copies
+        # the ring span [min_bin, max_bin] into the wider ring, so the
+        # bounds must still describe what the OLD ring actually holds —
+        # growing after extending them replicated old slots into the
+        # about-to-be-written range (ghost duplicates under far-apart
+        # sources, e.g. two impulse splits with staggered time bases)
+        if hi_new - lo_new >= self.B:
+            self._grow_ring(hi_new - lo_new + 1)
+            bins_mod = ((timestamps // self.slide) % self.B).astype(np.int32)
+        self.min_bin = lo_new
+        self.max_bin = hi_new
+        return bins_mod, live, n_live, lo, hi
+
+    def _note_mass(self, mass: int) -> None:
+        """Count accumulated row mass; the next accumulation could wrap
+        an i32 cell or pane sum once the total crosses the promotion
+        threshold, so promote BEFORE it lands (kernels retrace on the
+        new dtype).  Shared by both update paths."""
+        self.total_rows += mass
+        if (self.total_rows >= self._i32_promote
+                and self.counts.dtype == jnp.int32):
+            self.counts = self.counts.astype(jnp.int64)
+
+    def _enqueue_cells(self, slots_c: np.ndarray, bins_c: np.ndarray,
+                       rowcnt: np.ndarray, vals_c: np.ndarray,
+                       lo: int, hi: int) -> None:
+        """Shared update tail: u16-proof bin bounds, then either buffer
+        the pre-aggregated cell run (update coalescing — one merged
+        scatter carries many batches; the planes are only read at pane
+        fires / snapshots, and every reader flushes) or dispatch now."""
         m = len(slots_c)
         if m:
             # coarse but sound: every bin this batch touched could have
-            # grown by at most the batch's largest cell
-            bmax = int(rowcnt.max())
+            # grown by at most the batch's largest cell mass
+            bmax = int(np.ceil(rowcnt.max()))
             for b in range(lo, hi + 1):
                 self._bin_bound[b] = self._bin_bound.get(b, 0) + bmax
-
-        # update coalescing: buffer the (already pre-aggregated) cell run
-        # and let one merged scatter carry many batches — the planes are
-        # only read at pane fires / snapshots, and every reader flushes
         if update_coalescing_enabled():
             self._pending.append((slots_c, bins_c, rowcnt, vals_c))
             self._pending_cells += m
@@ -692,6 +746,49 @@ class KeyedBinState:
                 self.flush_updates()
             return
         self._dispatch_cells(slots_c, bins_c, rowcnt, vals_c)
+
+    def _update_merged(self, key_hash: np.ndarray, timestamps: np.ndarray,
+                       agg_inputs: Dict[str, np.ndarray]) -> None:
+        """Merge-input update (derived windows): inputs are fired factor
+        panes, one row per (key, pane) — channel values come straight
+        from the mapped partial columns (their kinds reduce partial →
+        partial losslessly) and the per-cell rowcount is the SUM of the
+        pane row-mass column, so the resulting ring is the one the
+        unfactored member would hold after the same raw rows."""
+        n = len(key_hash)
+        from ..formats import coerce_float
+
+        admitted = self._admit_bins(timestamps)
+        if admitted is None:
+            return
+        bins_mod, live, _n_live, lo, hi = admitted
+        w = coerce_float(agg_inputs[self._rows_col], ACC_DTYPE)
+        w = np.where(np.isnan(w), 0.0, w)
+        self._note_mass(int(np.ceil(w[live].sum())))
+
+        slots = self._lookup_or_insert(key_hash)
+
+        xfer = self._xfer_ch
+        xfer_kinds = tuple(self._ch_kinds[j] for j in xfer)
+        vals = np.empty((len(xfer), n), dtype=ACC_DTYPE)
+        for r, j in enumerate(xfer):
+            raw = coerce_float(agg_inputs[self._merge_cols[j]], ACC_DTYPE)
+            ident = ACC_DTYPE(_init_value(AggKind(self._ch_kinds[j])))
+            vals[r] = np.where(np.isnan(raw), ident, raw)
+        if not live.all():
+            idx = live.nonzero()[0]
+            slots, bins_mod = slots[idx], bins_mod[idx]
+            vals, w = vals[:, idx], w[idx]
+        # the row mass rides the cell reduction as one extra additive
+        # channel so duplicate (slot, bin) cells sum their masses —
+        # preaggregate's own rowcnt would count PANE ARRIVALS, which
+        # COUNT(*) outputs and the u16 proof must never see
+        ext_kinds = xfer_kinds + ("sum",)
+        slots_c, bins_c, _arrivals, red = preaggregate(
+            slots, bins_mod, ext_kinds, np.concatenate([vals, w[None]]))
+        rowcnt = red[-1]
+        vals_c = red[:-1]
+        self._enqueue_cells(slots_c, bins_c, rowcnt, vals_c, lo, hi)
 
     def flush_updates(self) -> None:
         """Apply every buffered pre-aggregated cell run to the device
@@ -716,6 +813,9 @@ class KeyedBinState:
 
     def _dispatch_cells(self, slots_c: np.ndarray, bins_c: np.ndarray,
                         rowcnt: np.ndarray, vals_c: np.ndarray) -> None:
+        from ..obs import perf
+
+        perf.count("pane_update_dispatches")
         # additive aggregates route through the Pallas MXU scatter (one-hot
         # matmul) instead of XLA's serial scatter; min/max stay on XLA
         if self._use_pallas():
@@ -1031,10 +1131,7 @@ class KeyedBinState:
         # granularity: finer than pow2 buckets (pow2 wastes up to 50% of a
         # remote-tunnel transfer) while bounding the compile-variant count;
         # the persistent compile cache amortizes each variant to one compile
-        if self.next_slot <= 2048:
-            c_slice = min(_bucket(max(self.next_slot, 1), floor=256), self.C)
-        else:
-            c_slice = min(-(-self.next_slot // 2048) * 2048, self.C)
+        c_slice = self._c_slice()
         compact = None
         use_ring = self._use_ring()
         if use_ring:
@@ -1051,20 +1148,8 @@ class KeyedBinState:
             # pane sums provably fit u16 -> halve the dominant transfer
             cnt16 = (self.counts.dtype == jnp.int32
                      and self._pane_bound(first_pane, last_pane) < 65_000)
-            kernel = _emit_kernel(self._ch_kinds, self.C, self.B, self.W,
-                                  kpad, self._xfer_ch, cnt16)
-            outs, cnts = timed_device(kernel, self.values, self.counts,
-                                      jnp.asarray(ring),
-                                      jnp.asarray(bin_ok))
-        if compact is None and not use_ring:
-            # device-slice to occupied keys AND real panes (k, not the
-            # pow2-padded kpad — a 5-pane fire in an 8-pane kernel grid
-            # would ship 37% dead bytes), then overlap the round-trips
-            outs_d = outs[:, :c_slice, :k]  # [n_xfer, c_slice, k]
-            cnts_d = cnts[:c_slice, :k]  # [c_slice, k]
-            _prefetch_host(outs_d, cnts_d)
-            outs = np.asarray(outs_d)  # arroyolint: disable=host-sync -- intentional canonical-snapshot/ring-relayout readback: rescale merges and ring growth operate on host copies by design
-            cnts = np.asarray(cnts_d)  # arroyolint: disable=host-sync -- intentional canonical-snapshot/ring-relayout readback: rescale merges and ring growth operate on host copies by design
+            outs, cnts = self._read_dense(ring, bin_ok, kpad, k, self.W,
+                                          cnt16)
 
         self.last_fired_pane = last_pane
         # evict bins that no future pane needs: abs bins <= last_pane - W + 1
@@ -1090,16 +1175,62 @@ class KeyedBinState:
         if compact is not None:
             key_idx, pane_idx, cnt_sel, ch_sel = compact
         else:
-            C_used = self.next_slot
-            cnts_u = cnts[:C_used, :k]
-            key_idx, pane_idx = np.nonzero(cnts_u)
-            cnt_sel = cnts_u[key_idx, pane_idx]
-            ch_sel = outs[:, :C_used, :k][:, key_idx, pane_idx]
+            key_idx, pane_idx, cnt_sel, ch_sel = self._flatten_dense(
+                outs, cnts, k)
         self._fire_density = len(key_idx) / max(self.next_slot * k, 1)
         if len(key_idx) == 0:
             return None
         keys = self.slot_to_key[key_idx]
         window_end = (pane_ends[pane_idx] + 1) * self.slide
+        return keys, self._out_cols(cnt_sel, ch_sel), window_end, cnt_sel
+
+    def _c_slice(self) -> int:
+        """Occupied-key transfer granularity (2048-row steps above the
+        pow2 floor: finer than pow2 buckets — which waste up to 50% of a
+        remote-tunnel transfer — while bounding compile variants)."""
+        if self.next_slot <= 2048:
+            return min(_bucket(max(self.next_slot, 1), floor=256), self.C)
+        return min(-(-self.next_slot // 2048) * 2048, self.C)
+
+    def _read_dense(self, ring: np.ndarray, bin_ok: np.ndarray, kpad: int,
+                    k: int, W: int, cnt16: bool
+                    ) -> Tuple[np.ndarray, np.ndarray]:
+        """Dense emit-kernel read: dispatch, device-slice to occupied
+        keys AND real panes (k, not the pow2-padded kpad — a 5-pane
+        fire in an 8-pane kernel grid would ship 37% dead bytes), then
+        overlap the round-trips.  ONE home for fire_panes and
+        drain_deltas so a transfer/slicing fix cannot diverge."""
+        from ..obs.perf import timed_device
+
+        c_slice = self._c_slice()
+        kernel = _emit_kernel(self._ch_kinds, self.C, self.B, W, kpad,
+                              self._xfer_ch, cnt16)
+        outs, cnts = timed_device(kernel, self.values, self.counts,
+                                  jnp.asarray(ring), jnp.asarray(bin_ok))
+        outs_d = outs[:, :c_slice, :k]  # [n_xfer, c_slice, k]
+        cnts_d = cnts[:c_slice, :k]  # [c_slice, k]
+        _prefetch_host(outs_d, cnts_d)
+        outs = np.asarray(outs_d)  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
+        cnts = np.asarray(cnts_d)  # arroyolint: disable=host-sync -- intentional pane-emission readback: fired panes must materialize on the host to become output batch columns
+        return outs, cnts
+
+    def _flatten_dense(self, outs: np.ndarray, cnts: np.ndarray, k: int
+                       ) -> Tuple[np.ndarray, np.ndarray, np.ndarray,
+                                  np.ndarray]:
+        """(key_idx, pane_idx, counts, channel values) for the live
+        cells of a dense read — shared fire/drain flatten."""
+        C_used = self.next_slot
+        cnts_u = cnts[:C_used, :k]
+        key_idx, pane_idx = np.nonzero(cnts_u)
+        cnt_sel = cnts_u[key_idx, pane_idx]
+        ch_sel = outs[:, :C_used, :k][:, key_idx, pane_idx]
+        return key_idx, pane_idx, cnt_sel, ch_sel
+
+    def _out_cols(self, cnt_sel: np.ndarray, ch_sel: np.ndarray
+                  ) -> Dict[str, np.ndarray]:
+        """Visible aggregate columns from flattened fired cells (shared
+        by fire_panes and drain_deltas so the two emission paths cannot
+        diverge)."""
         out_cols: Dict[str, np.ndarray] = {}
         dup_set = frozenset(self._dup_ch)
         for i, a in enumerate(self.aggs):
@@ -1119,7 +1250,65 @@ class KeyedBinState:
                     col = col / np.maximum(nv, 1)
                 col = np.where(nv > 0, col, np.nan)
             out_cols[a.output] = col
-        return keys, out_cols, window_end, cnt_sel
+        return out_cols
+
+    def drain_deltas(self) -> Optional[Tuple[np.ndarray,
+                                             Dict[str, np.ndarray],
+                                             np.ndarray, np.ndarray]]:
+        """Checkpoint-barrier drain for FACTOR pane rings (W == 1): read
+        every un-fired (key, bin) cell as a pane DELTA and reset those
+        cells to their channel identities — WITHOUT advancing
+        ``last_fired_pane``/``min_bin``, so rows arriving after the
+        drain re-accumulate in the same bins and ship as a later delta.
+        Derived-window rings merge deltas losslessly (their channels
+        reduce partial-into-partial), so the factor's own snapshot holds
+        no un-shipped mass and factored checkpoints restore into
+        unfactored plans epoch for epoch.  Same return shape as
+        ``fire_panes``; None when nothing is pending."""
+        assert self.W == 1, "drain_deltas is the factor-pane path (W == 1)"
+        if self.max_bin is None or self.next_slot == 0:
+            return None
+        self.flush_updates()
+        first_pane = (self.last_fired_pane + 1
+                      if self.last_fired_pane is not None
+                      else (self.min_bin or 0))
+        last_pane = self.max_bin
+        if last_pane < first_pane:
+            return None
+        pane_ends = np.arange(first_pane, last_pane + 1, dtype=np.int64)
+        k = len(pane_ends)
+        kpad = _bucket(k, floor=1)
+        ring = np.zeros((kpad, 1), dtype=np.int32)
+        ring[:k, 0] = (pane_ends % self.B).astype(np.int32)
+        bin_ok = np.zeros((kpad, 1), dtype=bool)
+        lo = self.min_bin if self.min_bin is not None else 0
+        bin_ok[:k, 0] = (pane_ends >= lo) & (pane_ends <= self.max_bin)
+
+        outs, cnts = self._read_dense(ring, bin_ok, kpad, k, 1, False)
+
+        # reset the drained bins to identity; bookkeeping stays put
+        drained = pane_ends[bin_ok[:k, 0]]
+        if len(drained):
+            epad = _bucket(len(drained), floor=8)
+            rslots = np.zeros(epad, dtype=np.int32)
+            rslots[:len(drained)] = (drained % self.B).astype(np.int32)
+            ev = np.zeros(epad, dtype=bool)
+            ev[:len(drained)] = True
+            ek = _evict_kernel(self._ch_kinds, self.C, self.B)
+            self.values, self.counts = ek(self.values, self.counts,
+                                          jnp.asarray(rslots),
+                                          jnp.asarray(ev))
+            # drained cells are 0 again: their bounds restart from zero
+            for b in drained.tolist():
+                self._bin_bound.pop(int(b), None)
+
+        key_idx, pane_idx, cnt_sel, ch_sel = self._flatten_dense(
+            outs, cnts, k)
+        if len(key_idx) == 0:
+            return None
+        keys = self.slot_to_key[key_idx]
+        window_end = (pane_ends[pane_idx] + 1) * self.slide
+        return keys, self._out_cols(cnt_sel, ch_sel), window_end, cnt_sel
 
     # -- checkpoint ---------------------------------------------------------
     #

@@ -344,6 +344,35 @@ def _fire_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int, W: int):
 
 
 @functools.lru_cache(maxsize=256)
+def _reset_span_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int):
+    """Reset the relative bin columns in [lims[0], lims[1]] to each
+    channel's identity (counts to 0) — the barrier-drain half of the
+    factor-pane path: drained cells must read as empty for the next
+    fire WITHOUT moving the ring base the way the roll step does.
+    Output shardings match the update step's state inputs."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    inits = tuple(float(_init_value(AggKind(k))) for k in ch_kinds)
+
+    def run(bins, counts, lims):
+        idx = jnp.arange(B, dtype=jnp.int32)
+        m = (idx >= lims[0]) & (idx <= lims[1])
+        counts = jnp.where(m[None, :], 0, counts)
+        outs = [jnp.where(m[None, :], jnp.float64(inits[j]), bins[j])
+                for j in range(len(ch_kinds))]
+        return jnp.stack(outs), counts
+
+    mesh = _keys_mesh(nk)
+    s_bins = NamedSharding(mesh, P(None, "keys", None))
+    s2 = NamedSharding(mesh, P("keys", None))
+    return jax.jit(run,
+                   in_shardings=(s_bins, s2, NamedSharding(mesh, P())),
+                   out_shardings=(s_bins, s2))
+
+
+@functools.lru_cache(maxsize=256)
 def _roll_step(ch_kinds: Tuple[str, ...], nk: int, C: int, B: int):
     """Evict bins below the new base: shift the linear bin axis left by
     ``shift`` and fill the tail with each channel's identity.  Output
@@ -438,6 +467,11 @@ class MeshKeyedBinState:
         # mirror of KeyedBinState.total_rows: bounds any cell/pane count
         # sum, driving i32 -> i64 plane promotion before a wrap is possible
         self.total_rows = 0
+        # merge-input mode (factor windows): see
+        # KeyedBinState.set_merge_inputs — channels read per-pane partial
+        # columns, the counts plane accumulates the row-mass column
+        self._merge_cols: Optional[Dict[int, str]] = None
+        self._rows_col: Optional[str] = None
 
         self._alloc_device()
 
@@ -578,11 +612,30 @@ class MeshKeyedBinState:
 
     # -- update ------------------------------------------------------------
 
+    def set_merge_inputs(self, channel_cols: Dict[int, str],
+                         rows_col: str) -> None:
+        """Arm merge-input mode (factor windows) — same contract as
+        :meth:`KeyedBinState.set_merge_inputs`; every channel (visible
+        and hidden validity) must have a mapped partial column because
+        the mesh ships all channels through the route step."""
+        assert self.next_slot == 0 and self.total_rows == 0, \
+            "merge inputs must be set before any key is admitted"
+        for j in range(len(self._ch_kinds)):
+            assert j in channel_cols, f"no merge column for channel {j}"
+        self._merge_cols = dict(channel_cols)
+        self._rows_col = rows_col
+
     def update(self, key_hash: np.ndarray, timestamps: np.ndarray,
                agg_inputs: Dict[str, np.ndarray]) -> None:
         n = len(key_hash)
         if n == 0:
             return
+        from ..obs import perf as _perf
+
+        # factor-window cost evidence (see KeyedBinState.update); the
+        # DISPATCH counter increments next to the actual scatter below,
+        # so all-late batches that never dispatch are not counted
+        _perf.count("pane_update_rows", n)
         kh = np.where(key_hash == EMPTY, EMPTY - np.uint64(1),
                       key_hash.astype(np.uint64))
         self._lookup_or_insert(kh)  # idempotent; ensures capacity
@@ -600,7 +653,15 @@ class MeshKeyedBinState:
         self.late_rows += int((~live).sum())
         if not live.any():
             return
-        self.total_rows += int(live.sum())
+        if self._merge_cols is not None:
+            from ..formats import coerce_float
+
+            w_rows = coerce_float(agg_inputs[self._rows_col], np.float64)
+            w_rows = np.where(np.isnan(w_rows), 0.0, w_rows)
+            self.total_rows += int(np.ceil(w_rows[live].sum()))
+        else:
+            w_rows = None
+            self.total_rows += int(live.sum())
         if self.total_rows >= KeyedBinState._i32_promote:
             import jax.numpy as _jnp
 
@@ -622,16 +683,39 @@ class MeshKeyedBinState:
             self._grow_ring(hi - self.base_bin + 1)
         rel = (abs_bin - self.base_bin).astype(np.int32)
 
-        vals = _channel_rows(self.aggs, self._ch_kinds, self._valid_of,
-                             agg_inputs, n)
-        # two-phase, local half: reduce rows per (key, bin) on the host
-        # BEFORE routing (TumblingLocalAggregator analog) — shrinks both
-        # the all_to_all payload and the per-shard scatter
-        if not live.all():
-            idx = live.nonzero()[0]
-            kh, rel, vals = kh[idx], rel[idx], vals[:, idx]
-        kh_c, rel_c, rowcnt, vals_c = preaggregate(
-            kh, rel, self._ch_kinds, vals)
+        if self._merge_cols is not None:
+            # merge-input mode: channels read already-aggregated partial
+            # columns (NaN masked to the channel identity); the row mass
+            # rides as one extra additive channel so duplicate cells sum
+            # their true masses instead of counting pane arrivals
+            from ..formats import coerce_float
+
+            vals = np.zeros((len(self._ch_kinds), n), dtype=np.float64)
+            for j, kind in enumerate(self._ch_kinds):
+                raw = coerce_float(agg_inputs[self._merge_cols[j]],
+                                   np.float64)
+                ident = np.float64(_init_value(AggKind(kind)))
+                vals[j] = np.where(np.isnan(raw), ident, raw)
+            if not live.all():
+                idx = live.nonzero()[0]
+                kh, rel, vals = kh[idx], rel[idx], vals[:, idx]
+                w_rows = w_rows[idx]
+            kh_c, rel_c, _arrivals, red = preaggregate(
+                kh, rel, self._ch_kinds + ("sum",),
+                np.concatenate([vals, w_rows[None]]))
+            rowcnt = red[-1]
+            vals_c = red[:-1]
+        else:
+            vals = _channel_rows(self.aggs, self._ch_kinds, self._valid_of,
+                                 agg_inputs, n)
+            # two-phase, local half: reduce rows per (key, bin) on the host
+            # BEFORE routing (TumblingLocalAggregator analog) — shrinks both
+            # the all_to_all payload and the per-shard scatter
+            if not live.all():
+                idx = live.nonzero()[0]
+                kh, rel, vals = kh[idx], rel[idx], vals[:, idx]
+            kh_c, rel_c, rowcnt, vals_c = preaggregate(
+                kh, rel, self._ch_kinds, vals)
         m = len(kh_c)
         # pad to nk * N (N power-of-two cells per mesh slice); each slice
         # holds <= N cells so route buckets cannot overflow
@@ -666,6 +750,7 @@ class MeshKeyedBinState:
         d_of = _shuffle.ensure_sharded(self.d_of, s2)
         step = _update_step(self._ch_kinds, self.nk, self.C, self.B, N,
                             self.route_shift)
+        _perf.count("pane_update_dispatches")
         if self.nk > 1:
             # the route half of this step IS the keyed shuffle: one
             # all_to_all over ICI instead of a host exchange
@@ -691,25 +776,12 @@ class MeshKeyedBinState:
         of = np.asarray(jax.device_get(self.d_of))
         return int(of[:, 0].sum()), int(of[:, 1].sum())
 
-    def fire_panes(self, watermark: int, final: bool = False):
-        if self.max_bin is None or self.next_slot == 0:
-            return None
-        if final:
-            last_pane = self.max_bin + self.W - 1
-        else:
-            last_pane = min(int(watermark // self.slide) - 1,
-                            self.max_bin + self.W - 1)
-        first_pane = (self.last_fired_pane + 1
-                      if self.last_fired_pane is not None
-                      else (self.min_bin or 0))
-        if last_pane < first_pane:
-            return None
-        base = self.base_bin if self.base_bin is not None else 0
-        # rel pane range is always within [0, B+W-2]: last_pane is capped
-        # at max_bin + W - 1 and max_bin < base + B
-        wm_rel = last_pane - base
-        first_rel = first_pane - base
-
+    def _read_fired(self, first_rel: int, wm_rel: int):
+        """Run the fire step over relative panes [first_rel, wm_rel] and
+        materialize (outs, cnts, mask, keys) on host — the shared read
+        half of :meth:`fire_panes` and :meth:`drain_deltas` (transfer
+        only the fired range; prefetch so the readbacks overlap into
+        ~one round-trip)."""
         import jax
         import jax.numpy as jnp
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -728,9 +800,6 @@ class MeshKeyedBinState:
         outs, cnts, mask = timed_device(
             fire, d_keys, d_bins, d_counts,
             jnp.asarray([first_rel, wm_rel], jnp.int32))
-        # transfer only the fired pane range, not the whole [.., B+W-1];
-        # prefetch all four buffers so the readbacks overlap into ~one
-        # round-trip instead of four
         from ..ops.keyed_bins import _prefetch_host
 
         k = wm_rel - first_rel + 1
@@ -738,28 +807,22 @@ class MeshKeyedBinState:
         cnts_d = cnts[:, first_rel:first_rel + k]
         mask_d = mask[:, first_rel:first_rel + k]
         _prefetch_host(outs_d, cnts_d, mask_d, self.d_keys)
-        outs = np.asarray(jax.device_get(outs_d))
-        cnts = np.asarray(jax.device_get(cnts_d))
-        mask = np.asarray(jax.device_get(mask_d))
-        keys_h = np.asarray(jax.device_get(self.d_keys))
+        return (np.asarray(jax.device_get(outs_d)),
+                np.asarray(jax.device_get(cnts_d)),
+                np.asarray(jax.device_get(mask_d)),
+                np.asarray(jax.device_get(self.d_keys)))
 
-        self.last_fired_pane = last_pane
-        # evict: roll the base forward past bins no future pane needs
-        new_base = last_pane - self.W + 2
-        if new_base > base:
-            shift = int(min(new_base - base, self.B))
-            roll = _roll_step(self._ch_kinds, self.nk, self.C, self.B)
-            self.d_bins, self.d_counts = roll(self.d_bins, self.d_counts,
-                                              jnp.int32(shift))
-            self.base_bin = base + shift
-            if self.min_bin is not None:
-                self.min_bin = max(self.min_bin, self.base_bin)
-
+    def _flatten_fired(self, outs, cnts, mask, keys_h, base: int,
+                       first_rel: int):
+        """Visible aggregate columns from the fired-cell grid — ONE home
+        for both emission paths (mirrors KeyedBinState._out_cols) so a
+        null/AVG semantics fix cannot apply to fire_panes and silently
+        miss drain_deltas."""
         cell_idx, pane_idx = np.nonzero(mask)
         if len(cell_idx) == 0:
             return None
         keys = keys_h[cell_idx]
-        # pane_idx is relative to the transferred slice [first_rel, wm_rel]
+        # pane_idx is relative to the transferred slice [first_rel, ..]
         window_end = (base + first_rel + pane_idx.astype(np.int64) + 1) \
             * self.slide
         out_cols: Dict[str, np.ndarray] = {}
@@ -774,6 +837,74 @@ class MeshKeyedBinState:
                 col = np.where(nv > 0, col, np.nan)
             out_cols[a.output] = col
         return keys, out_cols, window_end, cnts[cell_idx, pane_idx]
+
+    def fire_panes(self, watermark: int, final: bool = False):
+        if self.max_bin is None or self.next_slot == 0:
+            return None
+        if final:
+            last_pane = self.max_bin + self.W - 1
+        else:
+            last_pane = min(int(watermark // self.slide) - 1,
+                            self.max_bin + self.W - 1)
+        first_pane = (self.last_fired_pane + 1
+                      if self.last_fired_pane is not None
+                      else (self.min_bin or 0))
+        if last_pane < first_pane:
+            return None
+        base = self.base_bin if self.base_bin is not None else 0
+        # rel pane range is always within [0, B+W-2]: last_pane is capped
+        # at max_bin + W - 1 and max_bin < base + B
+        wm_rel = last_pane - base
+        first_rel = first_pane - base
+        outs, cnts, mask, keys_h = self._read_fired(first_rel, wm_rel)
+
+        import jax.numpy as jnp
+
+        self.last_fired_pane = last_pane
+        # evict: roll the base forward past bins no future pane needs
+        new_base = last_pane - self.W + 2
+        if new_base > base:
+            shift = int(min(new_base - base, self.B))
+            roll = _roll_step(self._ch_kinds, self.nk, self.C, self.B)
+            self.d_bins, self.d_counts = roll(self.d_bins, self.d_counts,
+                                              jnp.int32(shift))
+            self.base_bin = base + shift
+            if self.min_bin is not None:
+                self.min_bin = max(self.min_bin, self.base_bin)
+
+        return self._flatten_fired(outs, cnts, mask, keys_h, base,
+                                   first_rel)
+
+    def drain_deltas(self):
+        """Checkpoint-barrier drain for FACTOR pane rings (W == 1): same
+        contract as :meth:`KeyedBinState.drain_deltas` — read every
+        un-fired (key, bin) cell as a pane delta, reset those cells on
+        device, leave ``last_fired_pane``/``base_bin`` untouched."""
+        assert self.W == 1, "drain_deltas is the factor-pane path (W == 1)"
+        if self.max_bin is None or self.next_slot == 0:
+            return None
+        first_pane = (self.last_fired_pane + 1
+                      if self.last_fired_pane is not None
+                      else (self.min_bin or 0))
+        last_pane = self.max_bin
+        if last_pane < first_pane:
+            return None
+        base = self.base_bin if self.base_bin is not None else 0
+        first_rel = max(first_pane - base, 0)
+        wm_rel = last_pane - base
+        outs, cnts, mask, keys_h = self._read_fired(first_rel, wm_rel)
+
+        import jax.numpy as jnp
+
+        # reset the drained relative bin span on device (base stays put:
+        # later rows for these bins re-accumulate and ship as new deltas)
+        rs = _reset_span_step(self._ch_kinds, self.nk, self.C, self.B)
+        self.d_bins, self.d_counts = rs(
+            self.d_bins, self.d_counts,
+            jnp.asarray([first_rel, wm_rel], jnp.int32))
+
+        return self._flatten_fired(outs, cnts, mask, keys_h, base,
+                                   first_rel)
 
     # -- checkpoint --------------------------------------------------------
 

@@ -403,6 +403,14 @@ class Planner:
         prog.prune_dead()
         prog.eliminate_common_subplans()
         self._push_argmax_local(prog)
+        # factor-window sharing (graph/factor_windows.py): correlated
+        # window aggregates left distinct by CSE (same input/keys,
+        # DIFFERENT widths/slides) rewrite onto one shared pane ring.
+        # Runs after argmax_local so emission-coupled aggregates are
+        # visible (and excluded); ARROYO_FACTOR_WINDOWS=0 is a no-op.
+        from ..graph.factor_windows import apply_factor_windows
+
+        apply_factor_windows(prog)
         return prog
 
     @staticmethod
@@ -784,15 +792,22 @@ class Planner:
                     guaranteed.add(target.name.lower())
         compiled = compile_scalar(pred, planned.schema)
         fn = _wrap_predicate(compiled)
+        # STRUCTURAL token (same canonicalization as aggin): textually
+        # repeated WHERE clauses (every multi-query script over one
+        # source repeats its null-guard) now CSE-merge even when the
+        # chains diverge below — which is what lets the factor-window
+        # pass see correlated aggregates hanging off ONE shared filter
+        pred_tok = f"{name}:" + self._canon_token(pred, planned.schema)
         expr = ColumnExpr(f"{name}_{self._next_id()}", fn,
-                          ExprReturnType.PREDICATE)
+                          ExprReturnType.PREDICATE, sql=pred_tok)
         if compiled.needs_host:
             stream = planned.stream._chain(LogicalOperator(
                 OpKind.UDF, expr.name,
                 expr=ColumnExpr(expr.name, self._host_filter(fn),
-                                ExprReturnType.RECORD)))
+                                ExprReturnType.RECORD, sql=pred_tok)))
         else:
-            stream = planned.stream.filter(fn, name=expr.name)
+            stream = planned.stream._chain(LogicalOperator(
+                OpKind.EXPRESSION, expr.name, expr=expr))
         schema = planned.schema
         if guaranteed:
             schema = schema.clone()

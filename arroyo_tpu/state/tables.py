@@ -181,12 +181,37 @@ class KeyTimeMultiMap:
 
 class GlobalKeyedState:
     """kv state visible across all subtasks — used for source offsets
-    (global_keyed_map.rs)."""
+    (global_keyed_map.rs).
+
+    Entries carry a strictly monotonic per-key INSERT VERSION (persisted
+    through the checkpoint row's timestamp column) and restore is
+    newest-version-wins.  Global tables merge across every subtask's
+    checkpoint files unfiltered, and a restored subtask re-persists the
+    OTHER subtasks' entries it merely read — so its next checkpoint
+    contains STALE COPIES of its peers' keys.  Un-versioned restore
+    resolved such collisions by file order: after a second
+    checkpoint-restore cycle a source could resume from a peer's stale
+    offset and replay thousands of delivered events (observed as
+    duplicated window mass at parallelism 2; regression-pinned by
+    tests/test_state.py + the factor-window interchange test).  A
+    restored entry keeps its original version, so staleness can never
+    launder through re-snapshotting."""
 
     def __init__(self) -> None:
         self._data: Dict[Any, Any] = {}
+        self._version: Dict[Any, int] = {}
 
     def insert(self, key: Any, value: Any) -> None:
+        from ..types import now_micros
+
+        v = now_micros()
+        prev = self._version.get(key, -1)
+        # max(wall, prev + 1): restore always precedes any insert and
+        # merges EVERY peer's files, so ``prev`` already holds the
+        # highest version any worker ever recorded for this key — a new
+        # owner with a lagging clock (cross-worker skew) still bumps
+        # strictly past the restored copy instead of losing to it
+        self._version[key] = v if v > prev else prev + 1
         self._data[key] = value
 
     def get(self, key: Any) -> Any:
@@ -197,16 +222,23 @@ class GlobalKeyedState:
 
     def remove(self, key: Any) -> None:
         self._data.pop(key, None)
+        self._version.pop(key, None)
 
     def clear(self) -> None:
         self._data.clear()
+        self._version.clear()
 
     def snapshot(self) -> List[Tuple[int, Any, Any]]:
-        return [(0, k, v) for k, v in self._data.items()]
+        return [(self._version.get(k, 0), k, v)
+                for k, v in self._data.items()]
 
     def restore(self, entries: Iterable[Tuple[int, Any, Any]]) -> None:
-        for _, k, v in entries:
-            self._data[k] = v
+        for t, k, v in entries:
+            # >= so identical stale copies (same version, same value)
+            # and legacy un-versioned (t=0) checkpoints still restore
+            if int(t) >= self._version.get(k, -1):
+                self._version[k] = int(t)
+                self._data[k] = v
 
     def __len__(self) -> int:
         return len(self._data)

@@ -437,6 +437,18 @@ def run_query(name: str, sql_template: str) -> dict:
         "dispatches_per_event": round(
             dispatches / max(NUM_EVENTS * n_runs, 1), 6),
     }
+    # factor-window shape of THIS plan: how many correlated-window
+    # groups the cost model shared (q5 after CSE holds ONE hop
+    # aggregate, so its decision is "no correlated group" — the
+    # correlated_windows family carries the factored-vs-unfactored
+    # before/after numbers)
+    decisions = [d.to_json() for d in getattr(prog, "factor_decisions", [])]
+    result["factor"] = {
+        "shared_panes": sum(1 for d in decisions if d["shared"]),
+        "derived_windows": sum(len(d["members"]) for d in decisions
+                               if d["shared"]),
+        "decisions": decisions,
+    }
     # sharded-data-plane evidence: mesh shape + the reshard invariant
     # (reshards MUST stay 0 across the timed runs — a nonzero value
     # means some kernel's inputs arrived mis-partitioned) and how many
@@ -1423,6 +1435,152 @@ def run_autoscale_bench() -> dict:
             "value": result["actuations"], "autoscale": result}
 
 
+def run_correlated_windows() -> dict:
+    """Correlated-windows family (factor-window sharing,
+    graph/factor_windows.py): K in {2, 4, 8} sliding aggregates over
+    the SAME input/keys with distinct widths (shared 2s slide), each K
+    measured with factoring on (ARROYO_FACTOR_WINDOWS=auto) and off
+    (=0).  Records events/s, pane-update kernel-dispatch counts per
+    event, and the factor decision (shared_panes / derived_windows /
+    cost_model_decision) per point.  The claim under test: factored
+    per-event cost grows ~O(panes) — the shared ring pays ONE update
+    per batch regardless of K — while unfactored cost grows ~O(K)
+    (K private rings, K scatters).  ``cost_o_panes_ok`` asserts the
+    factored dispatch growth from K=2 to K=8 stays well below the
+    unfactored growth."""
+    from arroyo_tpu.connectors.memory import clear_sink, sink_output
+    from arroyo_tpu.engine.engine import LocalRunner
+    from arroyo_tpu.obs import perf
+    from arroyo_tpu.sql import plan_sql
+
+    n = int(os.environ.get("BENCH_CORRELATED_EVENTS", 300_000))
+    widths = [10, 4, 20, 6, 16, 8, 30, 14]  # seconds; slide 2s for all
+
+    def sql_for(k: int) -> str:
+        # 8k batches (not the headline 128k): pane firing must happen
+        # continuously mid-stream, or the whole family degenerates to
+        # one final flush and measures nothing about steady-state cost
+        parts = [SRC.format(n=n, b=8192)]
+        for i in range(k):
+            parts.append(
+                f"CREATE TABLE cw{i} (auction BIGINT, window_end BIGINT,"
+                f" num BIGINT, tot BIGINT) WITH (connector = 'memory',"
+                f" name = 'cw{i}', type = 'sink');")
+            parts.append(
+                f"INSERT INTO cw{i}\n"
+                f"SELECT bid.auction as auction,\n"
+                f"  HOP(INTERVAL '2' SECOND, INTERVAL '{widths[i]}'"
+                f" SECOND) as window,\n"
+                f"  count(*) AS num, sum(bid.price) AS tot\n"
+                f"FROM nexmark WHERE bid is not null GROUP BY 1, 2;")
+        return "\n".join(parts)
+
+    prev = os.environ.get("ARROYO_FACTOR_WINDOWS")
+
+    def measure(k: int, flag: str) -> dict:
+        os.environ["ARROYO_FACTOR_WINDOWS"] = flag
+        prog = plan_sql(sql_for(k), parallelism=bench_parallelism())
+        preflight_validate(prog, "correlated_windows")
+        decisions = [d.to_json()
+                     for d in getattr(prog, "factor_decisions", [])]
+        shared = [d for d in decisions if d["shared"]]
+        for i in range(k):
+            clear_sink(f"cw{i}")
+        LocalRunner(prog).run()  # warm (compiles shared by both arms)
+        before = {c: perf.counter(c)
+                  for c in ("kernel_dispatches", "pane_update_rows",
+                            "pane_update_dispatches")}
+        for i in range(k):
+            clear_sink(f"cw{i}")
+        t0 = time.perf_counter()
+        LocalRunner(prog).run()
+        dt = time.perf_counter() - t0
+        delta = {c: perf.counter(c) - v for c, v in before.items()}
+        rows = sum(sum(len(b) for b in sink_output(f"cw{i}"))
+                   for i in range(k))
+        assert rows > 0, f"correlated_windows k={k} produced no output"
+        return {
+            "events_per_sec": round(n / dt, 1),
+            "dispatches_per_event": round(
+                delta["kernel_dispatches"] / max(n, 1), 6),
+            # rows entering pane-update state per source event: ~K
+            # unfactored (every private ring sees every event), ~1 +
+            # O(panes) factored (derived rings see fired pane cells)
+            "pane_update_rows_per_event": round(
+                delta["pane_update_rows"] / max(n, 1), 4),
+            "pane_update_dispatches": delta["pane_update_dispatches"],
+            "output_rows": rows,
+            "factor": {
+                "shared_panes": len(shared),
+                "derived_windows": sum(len(d["members"]) for d in shared),
+                "pane_micros": (shared[0]["pane_micros"]
+                                if shared else None),
+                "cost_model_decision": (shared[0]["reason"] if shared
+                                        else (decisions[0]["reason"]
+                                              if decisions else
+                                              "no_correlated_group")),
+            },
+        }
+
+    points = []
+    try:
+        for k in (2, 4, 8):
+            factored = measure(k, "auto")
+            unfactored = measure(k, "0")
+            assert factored["factor"]["shared_panes"] == 1, \
+                f"k={k}: the factor pass did not share"
+            assert factored["factor"]["derived_windows"] == k
+            points.append({"k": k, "factored": factored,
+                           "unfactored": unfactored})
+            print(json.dumps({"correlated_windows_point": points[-1]}),
+                  file=sys.stderr)
+    finally:
+        if prev is None:
+            os.environ.pop("ARROYO_FACTOR_WINDOWS", None)
+        else:
+            os.environ["ARROYO_FACTOR_WINDOWS"] = prev
+
+    by_k = {p["k"]: p for p in points}
+    growth_f = (by_k[8]["factored"]["pane_update_rows_per_event"]
+                / max(by_k[2]["factored"]["pane_update_rows_per_event"],
+                      1e-12))
+    growth_u = (by_k[8]["unfactored"]["pane_update_rows_per_event"]
+                / max(by_k[2]["unfactored"]["pane_update_rows_per_event"],
+                      1e-12))
+    return {
+        "metric": "correlated_windows",
+        "events": n,
+        "points": points,
+        # K doubled twice (2 -> 8): unfactored pane-update work scales
+        # ~4x (K private rings each consuming every event); factored
+        # stays ~O(panes) — the shared ring consumes each event once and
+        # the derived rings consume fired pane CELLS, whose count tracks
+        # the pane grid, not K x events.  The margin absorbs the
+        # real-but-small per-K derived-cell cost.
+        "update_rows_growth_factored_2_to_8": round(growth_f, 3),
+        "update_rows_growth_unfactored_2_to_8": round(growth_u, 3),
+        "cost_o_panes_ok": bool(growth_f <= max(0.5 * growth_u, 1.25)),
+        "speedup_at_8": round(
+            by_k[8]["factored"]["events_per_sec"]
+            / max(by_k[8]["unfactored"]["events_per_sec"], 1e-9), 3),
+    }
+
+
+def emit_correlated_windows():
+    """Correlated-windows family: returned for embedding in the
+    headline line (``BENCH_FACTOR=0`` skips)."""
+    if os.environ.get("BENCH_FACTOR", "1") in ("0", "false", "no"):
+        return None
+    try:
+        cw = run_correlated_windows()
+    except Exception as e:  # the headline must still print
+        print(f"correlated-windows bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+    print(json.dumps(cw), file=sys.stderr)
+    return cw
+
+
 def main_mesh_child() -> None:
     """One point of the mesh-scaling sweep: q5 (and a reduced join-
     stress run) at ONE mesh width, in its own process — XLA's device
@@ -1623,7 +1781,7 @@ def main_child() -> None:
             env = dict(os.environ, BENCH_CHILD="1", BENCH_ALL="0",
                        BENCH_QUERY=name, BENCH_LAT_SECS="0",
                        BENCH_CONFIG5="0", BENCH_JOIN_STRESS="0",
-                       BENCH_MESH_SWEEP="0")
+                       BENCH_MESH_SWEEP="0", BENCH_FACTOR="0")
             try:
                 r = subprocess.run(
                     [sys.executable, os.path.abspath(__file__)], env=env,
@@ -1653,6 +1811,9 @@ def main_child() -> None:
         ms = emit_mesh_scaling(backend)
         if ms is not None:
             headline_result["mesh_scaling"] = ms
+        cw = emit_correlated_windows()
+        if cw is not None:
+            headline_result["correlated_windows"] = cw
         print(json.dumps(headline_result))
     else:
         result = run_query(headline, QUERIES[headline])
@@ -1670,6 +1831,9 @@ def main_child() -> None:
         ms = emit_mesh_scaling(backend)
         if ms is not None:
             result["mesh_scaling"] = ms
+        cw = emit_correlated_windows()
+        if cw is not None:
+            result["correlated_windows"] = cw
         print(json.dumps(result))
 
 

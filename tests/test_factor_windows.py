@@ -1,0 +1,309 @@
+"""Factor-window sharing (graph/factor_windows.py): cost-model
+decisions (incl. the don't-factor cases), the ARROYO_FACTOR_WINDOWS=0
+bit-for-bit escape, sanitized row parity factored x mesh on/off, and
+the factored <-> unfactored checkpoint interchange with a mid-restore
+rescale."""
+
+import asyncio
+import json
+import os
+
+import pytest
+
+from arroyo_tpu import Stream
+from arroyo_tpu.engine.engine import Engine, LocalRunner
+from arroyo_tpu.graph.factor_windows import (
+    apply_factor_windows,
+    expand_overrides,
+    factor_groups,
+    plan_factor_windows,
+)
+from arroyo_tpu.graph.logical import AggKind, AggSpec, OpKind
+from arroyo_tpu.sql import plan_sql
+
+SECOND = 1_000_000
+
+TWO_WINDOW_SQL = """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark', event_rate = '1000000', num_events = '{n}',
+  rate_limited = 'false', batch_size = '2048',
+  base_time_micros = '1700000000000000'
+);
+CREATE TABLE s1 (auction BIGINT, window_end BIGINT, num BIGINT) WITH (
+  connector = 'memory', name = 'fw1', type = 'sink');
+CREATE TABLE s2 (auction BIGINT, window_end BIGINT, tot BIGINT) WITH (
+  connector = 'memory', name = 'fw2', type = 'sink');
+INSERT INTO s1
+SELECT bid.auction as auction,
+       HOP(INTERVAL '2' SECOND, INTERVAL '10' SECOND) as window,
+       count(*) AS num
+FROM nexmark WHERE bid is not null GROUP BY 1, 2;
+INSERT INTO s2
+SELECT bid.auction as auction,
+       HOP(INTERVAL '2' SECOND, INTERVAL '4' SECOND) as window,
+       sum(bid.price) AS tot
+FROM nexmark WHERE bid is not null GROUP BY 1, 2;
+"""
+
+
+def _kinds(prog):
+    return sorted(n.operator.kind.value for n in prog.nodes())
+
+
+def _stream_pair(width_a, slide_a, width_b, slide_b, aggs_b=None,
+                 key_b=None):
+    """Two Stream-API window aggregates off one shared keyed source."""
+    src = Stream.source("impulse", {"message_count": 100}) \
+        .watermark(name="wm")
+    keyed = src.key_by("counter")
+    keyed.sliding_aggregate(width_a, slide_a,
+                            [AggSpec(AggKind.COUNT, None, "c")],
+                            name="agg_a").sink("blackhole", {})
+    second = keyed if key_b is None else src.key_by(key_b)
+    second.sliding_aggregate(
+        width_b, slide_b,
+        aggs_b or [AggSpec(AggKind.SUM, "counter", "s")],
+        name="agg_b").sink("blackhole", {})
+    return keyed.program
+
+
+# -- pass unit tests ---------------------------------------------------------
+
+
+def test_sql_plan_factors(monkeypatch):
+    monkeypatch.setenv("ARROYO_FACTOR_WINDOWS", "auto")
+    prog = plan_sql(TWO_WINDOW_SQL.format(n=1000))
+    kinds = _kinds(prog)
+    assert kinds.count("window_factor") == 1
+    assert kinds.count("derived_window") == 2
+    assert kinds.count("sliding_window_aggregator") == 0
+    # one shared keying chain: the two private agg_input/key_by tails
+    # are gone
+    assert kinds.count("key_by") == 1
+    decisions = prog.factor_decisions
+    shared = [d for d in decisions if d.shared]
+    assert len(shared) == 1
+    d = shared[0]
+    assert d.pane_micros == 2 * SECOND  # gcd(10s, 2s, 4s, 2s)
+    assert d.inputs["k"] == 2 and d.factor_node is not None
+    # the factor's SHUFFLE feed is keyed like the members were
+    fid = d.factor_node
+    (src, _, data), = prog.graph.in_edges(fid, data=True)
+    assert data["edge"].key_schema == "auction"
+
+
+def test_knob_off_reproduces_topology(monkeypatch):
+    """ARROYO_FACTOR_WINDOWS=0 pins today's (unfactored) topology
+    bit-for-bit: the plan hash with the knob off matches a second
+    knob-off plan, contains the original aggregator kinds, and the
+    engine-side re-application is a no-op."""
+    monkeypatch.setenv("ARROYO_FACTOR_WINDOWS", "0")
+    prog = plan_sql(TWO_WINDOW_SQL.format(n=1000))
+    again = plan_sql(TWO_WINDOW_SQL.format(n=1000))
+    assert prog.get_hash() == again.get_hash()
+    kinds = _kinds(prog)
+    assert kinds.count("sliding_window_aggregator") == 2
+    assert "window_factor" not in kinds
+    assert apply_factor_windows(prog) == []
+    assert prog.get_hash() == again.get_hash()
+
+
+def test_stream_api_direct_shape_factors(monkeypatch):
+    monkeypatch.setenv("ARROYO_FACTOR_WINDOWS", "auto")
+    prog = _stream_pair(10 * SECOND, 2 * SECOND, 4 * SECOND, 2 * SECOND)
+    decisions = apply_factor_windows(prog)
+    assert [d.shared for d in decisions] == [True]
+    groups = factor_groups(prog)
+    assert len(groups) == 1
+    (fid, derived), = groups.items()
+    assert len(derived) == 2
+    # validator accepts the factored shape
+    from arroyo_tpu.analysis.plan_validator import check_program
+
+    check_program(prog)
+
+
+def test_no_factor_single_member(monkeypatch):
+    monkeypatch.setenv("ARROYO_FACTOR_WINDOWS", "auto")
+    src = Stream.source("impulse", {"message_count": 10}).watermark()
+    src.key_by("counter").sliding_aggregate(
+        4 * SECOND, 2 * SECOND,
+        [AggSpec(AggKind.COUNT, None, "c")]).sink("blackhole", {})
+    prog = src.program
+    assert plan_factor_windows(prog) == []
+    assert apply_factor_windows(prog) == []
+
+
+def test_no_factor_non_decomposable(monkeypatch):
+    """A UDAF member is not bin-mergeable: the group never forms."""
+    monkeypatch.setenv("ARROYO_FACTOR_WINDOWS", "auto")
+    prog = _stream_pair(
+        10 * SECOND, 2 * SECOND, 4 * SECOND, 2 * SECOND,
+        aggs_b=[AggSpec(AggKind.UDAF, "counter", "u",
+                        fn=lambda v: float(v.sum()))])
+    assert [d for d in plan_factor_windows(prog) if d.shared] == []
+    assert "window_factor" not in _kinds(prog)
+
+
+def test_no_factor_mismatched_keys(monkeypatch):
+    """Members keyed by different columns never share pane state."""
+    monkeypatch.setenv("ARROYO_FACTOR_WINDOWS", "auto")
+    prog = _stream_pair(10 * SECOND, 2 * SECOND, 4 * SECOND, 2 * SECOND,
+                        key_b="subtask_index")
+    apply_factor_windows(prog)
+    assert "window_factor" not in _kinds(prog)
+
+
+def test_no_factor_pathological_gcd(monkeypatch):
+    """Near-coprime slides gcd to a micro-pane: the cost model refuses
+    (the factor ring would fire min(slide)/gcd times more often)."""
+    monkeypatch.setenv("ARROYO_FACTOR_WINDOWS", "auto")
+    prog = _stream_pair(2 * SECOND + 2, 2 * SECOND + 2,
+                        4 * SECOND, 2 * SECOND)
+    decisions = plan_factor_windows(prog)
+    assert len(decisions) == 1
+    d = decisions[0]
+    assert not d.shared and d.reason == "pane_ratio_exceeded"
+    assert d.pane_micros == 2  # gcd(2000002, 4000000, 2000000)
+    apply_factor_windows(prog)
+    assert "window_factor" not in _kinds(prog)
+
+
+def test_expand_overrides_covers_group(monkeypatch):
+    monkeypatch.setenv("ARROYO_FACTOR_WINDOWS", "auto")
+    prog = _stream_pair(10 * SECOND, 2 * SECOND, 4 * SECOND, 2 * SECOND)
+    apply_factor_windows(prog)
+    (fid, derived), = factor_groups(prog).items()
+    out = expand_overrides(prog, {derived[0]: 3})
+    assert out[fid] == 3 and all(out[m] == 3 for m in derived)
+
+
+# -- sanitized row-parity matrix: factored x mesh on/off ---------------------
+
+
+def _run_two_window(monkeypatch, factor: str, mesh: str):
+    from arroyo_tpu.connectors.memory import clear_sink, sink_output
+
+    monkeypatch.setenv("ARROYO_FACTOR_WINDOWS", factor)
+    monkeypatch.setenv("ARROYO_MESH", mesh)
+    monkeypatch.setenv("ARROYO_SANITIZE", "1")
+    prog = plan_sql(TWO_WINDOW_SQL.format(n=30000))
+    clear_sink("fw1")
+    clear_sink("fw2")
+    runner = LocalRunner(prog)
+    runner.run()
+    san = runner.engine.sanitizer
+    assert san is not None and not san.violations, san and san.violations
+    out = []
+    for name, cols in (("fw1", ("auction", "window_end", "num")),
+                       ("fw2", ("auction", "window_end", "tot"))):
+        out.append(sorted(
+            tuple(int(b.columns[c][i]) for c in cols)
+            for b in sink_output(name) for i in range(len(b))))
+    return out
+
+
+def test_row_parity_factored_x_mesh(monkeypatch):
+    ref = _run_two_window(monkeypatch, "0", "off")
+    assert all(len(r) for r in ref)
+    for factor in ("auto",):
+        for mesh in ("off", "auto"):
+            got = _run_two_window(monkeypatch, factor, mesh)
+            assert got == ref, (factor, mesh, len(got[0]), len(ref[0]))
+    # unfactored mesh run closes the matrix
+    assert _run_two_window(monkeypatch, "0", "auto") == ref
+
+
+# -- checkpoint interchange with mid-restore rescale -------------------------
+
+RT_SQL = """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark', event_rate = '60000', num_events = '60000',
+  rate_limited = 'true', batch_size = '2048',
+  base_time_micros = '1700000000000000'
+);
+CREATE TABLE s1 (auction BIGINT, window_end BIGINT, num BIGINT) WITH (
+  connector = 'single_file', path = '{o1}', type = 'sink');
+CREATE TABLE s2 (auction BIGINT, window_end BIGINT, tot BIGINT) WITH (
+  connector = 'single_file', path = '{o2}', type = 'sink');
+INSERT INTO s1
+SELECT bid.auction as auction,
+       HOP(INTERVAL '2' SECOND, INTERVAL '10' SECOND) as window,
+       count(*) AS num
+FROM nexmark WHERE bid is not null GROUP BY 1, 2;
+INSERT INTO s2
+SELECT bid.auction as auction,
+       HOP(INTERVAL '2' SECOND, INTERVAL '4' SECOND) as window,
+       sum(bid.price) AS tot
+FROM nexmark WHERE bid is not null GROUP BY 1, 2;
+"""
+
+
+def _rows_of(path):
+    with open(path) as f:
+        return sorted(tuple(sorted(json.loads(line).items()))
+                      for line in f)
+
+
+def test_checkpoint_interchange_with_rescale(tmp_path, monkeypatch):
+    """factored -> unfactored -> factored epoch interchange, with a
+    2 -> 3 rescale applied at the final (factored) restore.  The factor
+    drains its pending panes at every barrier, so no epoch ever strands
+    mass in a table the other topology cannot restore; exactly-once
+    output is pinned against an uninterrupted factored reference."""
+    monkeypatch.setenv("ARROYO_SANITIZE", "1")
+    monkeypatch.setenv("ARROYO_FACTOR_WINDOWS", "auto")
+    url = f"file://{tmp_path}/ckpt"
+    r1, r2 = str(tmp_path / "ref1.jsonl"), str(tmp_path / "ref2.jsonl")
+    LocalRunner(plan_sql(RT_SQL.format(o1=r1, o2=r2),
+                         parallelism=2)).run()
+    ref = (_rows_of(r1), _rows_of(r2))
+    assert ref[0] and ref[1]
+
+    o1, o2 = str(tmp_path / "out1.jsonl"), str(tmp_path / "out2.jsonl")
+
+    def make_prog(factor: str, rescale_to=None):
+        monkeypatch.setenv("ARROYO_FACTOR_WINDOWS", factor)
+        prog = plan_sql(RT_SQL.format(o1=o1, o2=o2), parallelism=2)
+        factored = any(n.operator.kind is OpKind.WINDOW_FACTOR
+                       for n in prog.nodes())
+        assert factored == (factor == "auto")
+        if rescale_to is not None:
+            from arroyo_tpu.graph.chaining import (
+                expand_overrides as chain_expand,
+            )
+
+            member = next(n.operator_id for n in prog.nodes()
+                          if n.operator.kind is OpKind.DERIVED_WINDOW)
+            # same fixpoint as controller.rescale_job: factor expansion
+            # adds members whose chains then need the override too
+            overrides, prev = {member: rescale_to}, None
+            while overrides != prev:
+                prev = overrides
+                overrides = chain_expand(prog, overrides)
+                overrides = expand_overrides(prog, overrides)
+            prog.update_parallelism(overrides)
+        return prog
+
+    async def phase(prog, restore, epoch):
+        engine = Engine.for_local(prog, "factor-rt", checkpoint_url=url,
+                                  restore_epoch=restore)
+        running = engine.start()
+        if epoch is not None:
+            await asyncio.sleep(0.35)
+            await running.checkpoint(epoch=epoch, then_stop=True)
+            assert await running.wait_for_checkpoint(epoch, timeout=60)
+            try:
+                await running.join()
+            except RuntimeError:
+                pass
+        else:
+            await running.join()
+        san = engine.sanitizer
+        assert san is None or not san.violations
+
+    asyncio.run(phase(make_prog("auto"), None, 1))
+    asyncio.run(phase(make_prog("0"), 1, 2))
+    asyncio.run(phase(make_prog("auto", rescale_to=3), 2, None))
+
+    assert (_rows_of(o1), _rows_of(o2)) == ref

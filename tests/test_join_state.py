@@ -423,8 +423,8 @@ JOIN b Z ON X.bidder = Z.bidder
 
 RT_SQL = """
 CREATE TABLE nexmark WITH (
-  connector = 'nexmark', event_rate = '1000000', num_events = '{n}',
-  rate_limited = 'false', batch_size = '1024',
+  connector = 'nexmark', event_rate = '60000', num_events = '{n}',
+  rate_limited = 'true', batch_size = '1024',
   base_time_micros = '1700000000000000'
 );
 CREATE TABLE sinkt (auction BIGINT, price BIGINT, reserve BIGINT) WITH (
@@ -448,7 +448,17 @@ def test_join_checkpoint_restores_with_rescale(tmp_path, monkeypatch):
     """Headline round-trip (mirrors the q5 chaining test): partitioned
     join state checkpointed mid-stream at parallelism 2 restores at
     parallelism 3 — the snapshot batches re-filter by key range and
-    re-partition into fresh sorted runs — with exactly-once output."""
+    re-partition into fresh sorted runs — with exactly-once output.
+
+    The source is RATE-LIMITED (60k events at 60k/s = a ~1s stream) so
+    the barrier injected at t+0.3s deterministically lands mid-stream:
+    with the unthrottled source the vectorized ingest drains all 60k
+    events in tens of milliseconds on a fast box — the sources are then
+    already finished when the barrier arrives, the checkpoint can never
+    complete, and the test times out (the "fails at HEAD on loaded
+    boxes" flake was actually fails-when-the-run-finishes-too-soon).
+    Acceptance is unchanged: exactly-once row equality after a 2 -> 3
+    mid-restore rescale."""
     monkeypatch.setenv("ARROYO_JOIN_STATE", "partitioned")
     n = 60_000
     ref_path = tmp_path / "ref.jsonl"

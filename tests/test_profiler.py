@@ -13,8 +13,8 @@ from arroyo_tpu.obs import profiler
 
 NEXMARK_SQL = """
 CREATE TABLE nexmark WITH (
-  connector = 'nexmark', event_rate = '1000000', num_events = '120000',
-  rate_limited = 'false', batch_size = '2048',
+  connector = 'nexmark', event_rate = '1000000', num_events = '600000',
+  rate_limited = 'false', batch_size = '8192',
   base_time_micros = '1700000000000000'
 );
 SELECT bid.auction as auction,
@@ -22,6 +22,12 @@ SELECT bid.auction as auction,
        count(*) AS num
 FROM nexmark WHERE bid is not null GROUP BY 1, 2
 """
+# 600k events / 8k batches (was 120k / 2k): the sums-to-wall claim is
+# about STEADY-STATE attribution, and the vectorized ingest kept
+# shrinking the 120k wall until one-time engine start/stop + scheduler
+# gaps (honestly not phases) were >15% of it on a loaded box — the
+# same runway widening smoke's profiler gate got in PR 9.  The 0.85
+# acceptance bar is unchanged.
 
 
 @pytest.fixture(autouse=True)

@@ -56,6 +56,47 @@ def test_kv_tables_roundtrip(backend):
         ["v1", "v2"]
 
 
+def test_global_state_stale_peer_copy_never_wins(backend):
+    """The second-generation restore bug (regression): global tables
+    merge across EVERY subtask's files, and a restored subtask
+    re-persists its peers' entries it merely read — so epoch 2's file
+    for subtask 0 holds a STALE COPY of subtask 1's source offset.
+    Un-versioned restore resolved that collision by file order; a
+    source could then resume from the stale offset and replay
+    already-delivered events.  Entry versions pin newest-wins."""
+    job = f"job-{uuid.uuid4().hex[:8]}"
+    t0 = TaskInfo(job, "src", "src", 0, 2)
+    t1 = TaskInfo(job, "src", "src", 1, 2)
+
+    # epoch 1: each subtask records only its own offset
+    s0 = StateStore(t0, backend)
+    s0.get_global_keyed_state("s").insert(0, 100)
+    s0.checkpoint(1, watermark=None)
+    s1 = StateStore(t1, backend)
+    s1.get_global_keyed_state("s").insert(1, 100)
+    s1.checkpoint(1, watermark=None)
+
+    # restore -> subtask 0 now ALSO holds subtask 1's entry (stale once
+    # subtask 1 advances); both advance their OWN key and checkpoint 2
+    r0 = StateStore(t0, backend, restore_epoch=1)
+    g0 = r0.get_global_keyed_state("s")
+    assert g0.get(1) == 100  # the merged peer copy
+    g0.insert(0, 200)
+    r1 = StateStore(t1, backend, restore_epoch=1)
+    g1 = r1.get_global_keyed_state("s")
+    g1.insert(1, 250)
+    r0.checkpoint(2, watermark=None)
+    r1.checkpoint(2, watermark=None)
+
+    # epoch-2 restore: every subtask must see every key's NEWEST value,
+    # whatever file order the merge read them in
+    for t in (t0, t1):
+        g = StateStore(t, backend,
+                       restore_epoch=2).get_global_keyed_state("s")
+        assert g.get(0) == 200 and g.get(1) == 250, (t.task_index,
+                                                     g.get_all())
+
+
 def test_batch_buffer_roundtrip(backend):
     task = fresh_task()
     store = StateStore(task, backend)

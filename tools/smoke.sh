@@ -25,14 +25,18 @@
 #      two-stream join on an 8-fake-device mesh (ARROYO_MESH=auto vs
 #      off, sanitizer armed) must emit identical rows with the
 #      no-resharding invariant holding (reshard counter == 0);
-#   7. arroyosan: a sanitized tiny-Nexmark run (ARROYO_SANITIZE=1,
+#   7. factored-vs-unfactored: a two-window correlated query must
+#      actually factor (one shared pane ring), emit identical rows
+#      with ARROYO_FACTOR_WINDOWS=auto vs =0, sanitizer armed, and
+#      hold the no-resharding invariant on the 8-device mesh;
+#   8. arroyosan: a sanitized tiny-Nexmark run (ARROYO_SANITIZE=1,
 #      chaining on, periodic checkpoints) must complete with zero
 #      invariant violations — the runtime protocol contract;
-#   8. the phase profiler: an armed steady-state Nexmark run must
+#   9. the phase profiler: an armed steady-state Nexmark run must
 #      attribute >=85% of wall time to named phases (best-of-2) with
 #      zero event-loop stalls (unattributed time means the
 #      instrumentation drifted off the hot path);
-#   9. tests/test_obs.py + tests/test_profiler.py — the observability
+#  10. tests/test_obs.py + tests/test_profiler.py — the observability
 #      contract suites.
 #
 # Budget: the whole gate stays under ~90s.
@@ -325,6 +329,97 @@ if reshards:
 os.environ.pop("ARROYO_MESH", None)
 print(f"smoke: mesh equivalence ok (q5 {len(q5_mesh)} rows, join "
       f"{len(j_mesh)} rows, mesh == single-device, 0 reshards)")
+PY
+
+python - <<'PY'
+# factored-vs-unfactored equivalence gate (factor-window sharing): a
+# tiny TWO-window correlated query (same input/keys, different widths)
+# on the 8-fake-device mesh, ARROYO_FACTOR_WINDOWS=auto vs =0, with the
+# sanitizer armed — the factored plan must actually factor (one shared
+# pane ring), emit IDENTICAL rows, and hold the no-resharding invariant
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["ARROYO_SANITIZE"] = "1"
+
+from arroyo_tpu.connectors.memory import clear_sink, sink_output
+from arroyo_tpu.engine.engine import LocalRunner
+from arroyo_tpu.obs import perf
+from arroyo_tpu.parallel.shuffle import RESHARDS
+from arroyo_tpu.sql import plan_sql
+
+SQL = """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark', event_rate = '1000000', num_events = '30000',
+  rate_limited = 'false', batch_size = '2048',
+  base_time_micros = '1700000000000000'
+);
+CREATE TABLE f1 (auction BIGINT, window_end BIGINT, num BIGINT) WITH (
+  connector = 'memory', name = 'fw_a', type = 'sink');
+CREATE TABLE f2 (auction BIGINT, window_end BIGINT, tot BIGINT) WITH (
+  connector = 'memory', name = 'fw_b', type = 'sink');
+INSERT INTO f1
+SELECT bid.auction as auction,
+       HOP(INTERVAL '2' SECOND, INTERVAL '10' SECOND) as window,
+       count(*) AS num
+FROM nexmark WHERE bid is not null GROUP BY 1, 2;
+INSERT INTO f2
+SELECT bid.auction as auction,
+       HOP(INTERVAL '2' SECOND, INTERVAL '4' SECOND) as window,
+       sum(bid.price) AS tot
+FROM nexmark WHERE bid is not null GROUP BY 1, 2;
+"""
+
+
+def run(flag):
+    os.environ["ARROYO_FACTOR_WINDOWS"] = flag
+    prog = plan_sql(SQL)
+    n_factor = sum(1 for nd in prog.nodes()
+                   if nd.operator.kind.value == "window_factor")
+    if flag == "auto" and n_factor != 1:
+        sys.exit(f"smoke: factor pass did not share ({n_factor} factor "
+                 "nodes; expected 1) — the gate would compare nothing")
+    if flag == "0" and n_factor != 0:
+        sys.exit("smoke: ARROYO_FACTOR_WINDOWS=0 still factored")
+    clear_sink("fw_a")
+    clear_sink("fw_b")
+    runner = LocalRunner(prog)
+    runner.run()
+    san = runner.engine.sanitizer
+    if san is None or san.violations:
+        sys.exit(f"smoke: factor gate sanitizer problem (factor={flag}, "
+                 f"violations={getattr(san, 'violations', None)})")
+    out = []
+    for name, cols in (("fw_a", ("auction", "window_end", "num")),
+                       ("fw_b", ("auction", "window_end", "tot"))):
+        out.append(sorted(
+            tuple(int(b.columns[c][i]) for c in cols)
+            for b in sink_output(name) for i in range(len(b))))
+    return out
+
+
+r0 = perf.counter(RESHARDS)
+rows_on = run("auto")
+rows_off = run("0")
+os.environ.pop("ARROYO_FACTOR_WINDOWS", None)
+if not rows_on[0] or not rows_on[1]:
+    sys.exit("smoke: factored correlated-window query produced no output")
+if rows_on != rows_off:
+    sys.exit(f"smoke: factored output diverges from unfactored "
+             f"({[len(r) for r in rows_on]} vs "
+             f"{[len(r) for r in rows_off]} rows)")
+reshards = perf.counter(RESHARDS) - r0
+if reshards:
+    sys.exit(f"smoke: factor gate recorded {reshards} reshard(s) — "
+             "derived consumers must read pre-partitioned pane arrays")
+print(f"smoke: factor-window equivalence ok "
+      f"({len(rows_on[0])}+{len(rows_on[1])} identical rows, 1 shared "
+      "pane ring, 0 reshards)")
 PY
 
 python - <<'PY'

@@ -11,11 +11,15 @@ bug classes before they run:
    ``jax.jit``/``pallas_call``, and drift between ``rpc.proto`` and the
    hand-surgered ``rpc_pb2.py`` descriptors.
 
-2. **Plan-time validation** (``validate_program``): graph-level
-   invariants over ``graph.logical.Program`` — keyed-state operators
-   behind shuffle edges, watermark/window consistency, join key-schema
-   agreement, no dangling nodes — run at pipeline-create time
-   (api/rest.py) and before compilation (engine/build.py).
+2. **Plan-time validation** (``validate_program`` + ``plan_report``):
+   graph-level invariants over ``graph.logical.Program`` — keyed-state
+   operators behind shuffle edges, watermark/window consistency, join
+   key-schema agreement, no dangling nodes — plus **shardcheck**
+   (``shardcheck.py``), the sharding & transfer verifier that proves
+   ``predicted_reshards == 0`` at plan time and is cross-checked
+   against the live ``reshard_transfers`` counter by the smoke
+   model-drift gate.  Run at pipeline-create time (api/rest.py) and
+   before compilation (engine/build.py).
 
 Findings support inline waivers::
 
@@ -37,11 +41,12 @@ from .plan_validator import (  # noqa: F401
     PlanDiagnostic,
     PlanValidationError,
     check_program,
+    plan_report,
     validate_program,
 )
 
 __all__ = [
     "Finding", "run_analysis", "load_baseline", "write_baseline",
     "DEFAULT_BASELINE", "PlanDiagnostic", "PlanValidationError",
-    "check_program", "validate_program",
+    "check_program", "plan_report", "validate_program",
 ]

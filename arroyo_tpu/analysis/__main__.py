@@ -5,10 +5,16 @@ Exit status: 0 when every finding is waived or baselined, 1 otherwise
 
     python -m arroyo_tpu.analysis                 # lint arroyo_tpu/
     python -m arroyo_tpu.analysis path1 path2     # explicit paths
-    python -m arroyo_tpu.analysis --json          # machine-readable
+    python -m arroyo_tpu.analysis --format json   # machine-readable
     python -m arroyo_tpu.analysis --all           # show waived too
     python -m arroyo_tpu.analysis --pass ckpt-arity,host-sync
     python -m arroyo_tpu.analysis --write-baseline  # accept current
+
+``--format json`` emits one object with ``findings`` entries carrying
+``file``/``line``/``pass``/``code``/``severity``/``message``/
+``fingerprint``/``waived``/``baselined`` — the shape CI annotations and
+editor integrations consume without scraping the text renderer (the
+exit status contract is identical: 0 iff the gate is clean).
 """
 
 from __future__ import annotations
@@ -47,7 +53,12 @@ def main(argv=None) -> int:
                          "accepted findings (the adoption ratchet: the "
                          "baseline may only shrink)")
     ap.add_argument("--json", action="store_true",
-                    help="emit findings as JSON")
+                    help="emit findings as JSON (alias of --format json)")
+    ap.add_argument("--format", dest="fmt", choices=("text", "json"),
+                    default="text",
+                    help="output format; json is the machine-readable "
+                         "shape (file/line/pass/code/fingerprint per "
+                         "finding) for CI and editors")
     ap.add_argument("--all", action="store_true",
                     help="also print waived/baselined findings")
     args = ap.parse_args(argv)
@@ -77,10 +88,17 @@ def main(argv=None) -> int:
 
     gate = unwaived(findings)
     shown = findings if args.all else gate
-    if args.json:
+    if args.json or args.fmt == "json":
         print(json.dumps({
-            "findings": [f.to_json() for f in shown],
-            "total": len(findings), "gate": len(gate),
+            "version": 1,
+            "findings": [f.to_json() for f in sorted(
+                shown, key=lambda f: (f.rel_path(), f.line))],
+            "counts": {
+                "total": len(findings), "gate": len(gate),
+                "waived": sum(1 for f in findings if f.waived),
+                "baselined": sum(1 for f in findings if f.baselined),
+            },
+            "total": len(findings), "gate": len(gate),  # legacy keys
         }, indent=1))
     else:
         for f in sorted(shown, key=lambda f: (f.rel_path(), f.line)):

@@ -8,8 +8,10 @@ A *pass* is a module exposing ``PASS_ID`` and one of
   run once over every parsed file (``files`` maps path -> (tree,
   lines)); its findings are file-anchored, so inline waivers and the
   baseline apply exactly as for AST passes (async-race), or
-- ``check_repo(root) -> List[Finding]`` — a repo-level pass run once
-  (e.g. proto drift).
+- ``check_repo(root, full_scan) -> List[Finding]`` — a repo-level pass
+  run once (e.g. proto drift); ``full_scan`` is False when the caller
+  restricted the lint below the package root, and expensive whole-repo
+  work (shardcheck's plan sweep) must be skipped then.
 
 Waivers: a finding is suppressed when its line (or the immediately
 preceding comment-only line) carries::
@@ -69,7 +71,11 @@ class Finding:
     def to_json(self) -> Dict:
         return {
             "pass": self.pass_id, "code": self.code,
-            "path": self.rel_path(), "line": self.line,
+            # both keys on purpose: "file" is the documented
+            # machine-readable name (--format json consumers), "path"
+            # the historical one older tooling may already read
+            "file": self.rel_path(), "path": self.rel_path(),
+            "line": self.line,
             "message": self.message, "severity": self.severity,
             "waived": self.waived, "baselined": self.baselined,
             "fingerprint": self.fingerprint,
@@ -213,12 +219,16 @@ def _ast_passes():
         checkpoint_arity,
         host_sync,
         protocol,
+        recompile_hazard,
         row_loop,
         trace_purity,
     )
 
-    return [checkpoint_arity, async_blocking, host_sync, trace_purity,
-            protocol, row_loop]
+    # recompile-hazard runs FIRST: a jit cache-key hazard in ops/ or
+    # parallel/ turns the steady state into a compile storm, which
+    # invalidates every number the later invariants protect
+    return [recompile_hazard, checkpoint_arity, async_blocking,
+            host_sync, trace_purity, protocol, row_loop]
 
 
 def _project_passes():
@@ -228,9 +238,11 @@ def _project_passes():
 
 
 def _repo_passes():
-    from . import proto_drift
+    from . import proto_drift, shardcheck
 
-    return [proto_drift]
+    # shardcheck first: the sharding contract (route-shift wiring +
+    # representative-plan sweep) gates everything the data plane runs
+    return [shardcheck, proto_drift]
 
 
 def run_analysis(paths: Optional[Sequence[str]] = None,
@@ -240,6 +252,13 @@ def run_analysis(paths: Optional[Sequence[str]] = None,
     """Run every pass; returns ALL findings with ``waived``/``baselined``
     flags applied — callers gate on the ones with neither."""
     paths = list(paths) if paths else [PKG_ROOT]
+    # repo passes with expensive whole-repo work (shardcheck's plan
+    # sweep) only run it when the scan covers the package root — a
+    # single-file lint must stay fast and never gate on plan findings
+    pkg = os.path.abspath(PKG_ROOT)
+    full_scan = any(os.path.abspath(p) == pkg
+                    or pkg.startswith(os.path.abspath(p) + os.sep)
+                    for p in paths)
     findings: List[Finding] = []
     lines_by_path: Dict[str, Sequence[str]] = {}
     trees_by_path: Dict[str, ast.AST] = {}
@@ -266,6 +285,11 @@ def run_analysis(paths: Optional[Sequence[str]] = None,
             file_findings.extend(mod.check(tree, lines, path))
         apply_waivers(file_findings, waivers)
         findings.extend(file_findings)
+    # waiver lookups key on the absolute path: repo passes anchor
+    # findings at REPO_ROOT-joined paths while the CLI may have been
+    # given relative ones, and both must land on the same waiver set
+    waivers_by_abspath = {os.path.abspath(p): w
+                          for p, w in waivers_by_path.items()}
     # interprocedural passes see every parsed file at once; their
     # findings are file-anchored, so per-file waivers apply the same way
     for mod in _project_passes():
@@ -275,12 +299,21 @@ def run_analysis(paths: Optional[Sequence[str]] = None,
             {p: (trees_by_path[p], lines_by_path[p])
              for p in trees_by_path})
         for f in proj:
-            apply_waivers([f], waivers_by_path.get(f.path, {}))
+            apply_waivers(
+                [f], waivers_by_abspath.get(os.path.abspath(f.path), {}))
         findings.extend(proj)
     for mod in _repo_passes():
         if passes and mod.PASS_ID not in passes:
             continue
-        findings.extend(mod.check_repo(repo_root))
+        repo_findings = mod.check_repo(repo_root, full_scan=full_scan)
+        for f in repo_findings:
+            # repo-pass findings anchored to a parsed file (shardcheck's
+            # wiring audit) honor that file's inline waivers exactly
+            # like AST/project passes; findings anchored elsewhere
+            # (rpc.proto, plan-sweep anchors) have no waiver surface
+            apply_waivers(
+                [f], waivers_by_abspath.get(os.path.abspath(f.path), {}))
+        findings.extend(repo_findings)
     assign_fingerprints(findings, lines_by_path)
     if baseline_path:
         apply_baseline(findings, load_baseline(baseline_path))

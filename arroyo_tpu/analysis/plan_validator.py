@@ -306,11 +306,34 @@ def errors_of(diags: List[PlanDiagnostic]) -> List[PlanDiagnostic]:
     return [d for d in diags if d.severity == "error"]
 
 
-def check_program(program: "Program") -> List[PlanDiagnostic]:
-    """Validate and raise PlanValidationError on any error-severity
-    diagnostic; returns the full diagnostic list (warnings included)
-    otherwise."""
+def plan_report(program: "Program", nk: Optional[int] = None
+                ) -> Dict[str, Any]:
+    """The combined plan report every validator consumer serves:
+    graph-level diagnostics PLUS shardcheck's sharding/transfer
+    verification (``analysis/shardcheck.py``) and its
+    ``predicted_reshards`` total — the static analog of the runtime
+    ``reshard_transfers`` counter the smoke drift gate cross-checks.
+    ``ARROYO_SHARDCHECK=0`` drops the shardcheck half (triage only)."""
     diags = validate_program(program)
+    from .shardcheck import analyze, shardcheck_enabled
+
+    if not shardcheck_enabled():
+        # the verifier did NOT run: report null, never a fabricated 0 —
+        # a console/bench line must not display "statically proven
+        # clean" for a plan nobody verified
+        return {"diagnostics": diags, "predicted_reshards": None,
+                "mesh_shards": None}
+    rep = analyze(program, nk=nk)
+    return {"diagnostics": diags + rep.diagnostics,
+            "predicted_reshards": rep.predicted_reshards,
+            "mesh_shards": rep.nk}
+
+
+def check_program(program: "Program") -> List[PlanDiagnostic]:
+    """Validate (graph invariants + shardcheck) and raise
+    PlanValidationError on any error-severity diagnostic; returns the
+    full diagnostic list (warnings included) otherwise."""
+    diags = plan_report(program)["diagnostics"]
     errs = errors_of(diags)
     if errs:
         raise PlanValidationError(errs)

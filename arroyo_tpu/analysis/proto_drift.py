@@ -194,7 +194,7 @@ def compare(messages: Dict, services: Dict, descriptor,
     return findings
 
 
-def check_repo(root: str) -> List[Finding]:
+def check_repo(root: str, full_scan: bool = True) -> List[Finding]:
     proto_path = os.path.join(root, PROTO_REL)
     if not os.path.exists(proto_path):
         return []

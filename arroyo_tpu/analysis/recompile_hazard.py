@@ -1,0 +1,246 @@
+"""recompile_hazard: jit cache-key hazards in the kernel layers.
+
+Scope: ``ops/*.py`` and ``parallel/*.py`` — every jitted step the hot
+path dispatches.  The engine's throughput story rests on kernels
+compiling ONCE per shape signature (`functools.lru_cache`-wrapped step
+factories with `@jax.jit` inside, static args bucketed to powers of
+two); a single site that rebuilds its jit per dispatch, passes a
+per-batch-varying value as a cache key, or branches on a traced shape
+silently turns the steady state into a compile storm that only shows
+up as mysterious wall time (the profiler's `dispatch` phase inflating
+was historically how these were found — this pass catches them before
+they run).
+
+Codes:
+
+- ``jit-rebuild`` — a ``jax.jit`` / ``shard_map`` / ``pallas_call``
+  created inside a function that is neither ``functools.lru_cache``/
+  ``cache``-wrapped nor stores the result in a cache (subscript
+  assignment, e.g. ``self._jitted[key] = f``): the closure is rebuilt
+  per call, so every dispatch pays a fresh trace+compile.
+- ``unhashable-static`` — a call to a same-file ``lru_cache``-wrapped
+  factory passing a list/dict/set literal: TypeError at runtime, and
+  even tuple-fixed it would be a per-call-varying cache key.
+- ``varying-static`` — a cached factory called with a bare
+  ``len(...)`` / ``x.shape[...]`` argument: per-batch-varying static
+  arg, one compile per batch size.  Bucket it first
+  (``_bucket``/power-of-two padding) like every existing caller.
+- ``shape-branch`` — Python ``if``/``while`` on a traced parameter's
+  ``.shape``/``len()`` inside a jit-compiled function: either a
+  TracerBoolConversionError or a retrace per shape, depending on how
+  the value flows.  Branch on closure statics instead.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Set
+
+from .core import Finding, call_name
+
+PASS_ID = "recompile-hazard"
+
+_SCOPE_RE = re.compile(r"(^|/)(ops|parallel)/[^/]+\.py$")
+
+_JIT_MAKERS = {"jax.jit", "jit", "shard_map", "jax.experimental."
+               "shard_map.shard_map", "pl.pallas_call", "pallas_call"}
+_CACHE_DECOS = {"functools.lru_cache", "lru_cache", "functools.cache",
+                "cache"}
+
+
+def in_scope(path: str) -> bool:
+    return bool(_SCOPE_RE.search(path.replace("\\", "/")))
+
+
+def _deco_name(d: ast.expr) -> str:
+    if isinstance(d, ast.Call):
+        d = d.func
+    if isinstance(d, (ast.Name, ast.Attribute)):
+        parts = []
+        cur = d
+        while isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        if isinstance(cur, ast.Name):
+            parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_cached_fn(node) -> bool:
+    return any(_deco_name(d) in _CACHE_DECOS
+               for d in getattr(node, "decorator_list", ()))
+
+
+def _has_cache_store(fn_node) -> bool:
+    """A ``cache[key] = value`` / ``self._x[key] = f`` assignment inside
+    the function body — the memoized-builder pattern (CompiledExpr's
+    per-schema jit cache) that makes an inline jit build legitimate."""
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Assign):
+            if any(isinstance(t, ast.Subscript) for t in sub.targets):
+                return True
+        if isinstance(sub, ast.Call) and \
+                call_name(sub).endswith(".setdefault"):
+            return True
+    return False
+
+
+def _jit_param_names(tree: ast.AST) -> Set[str]:
+    """Parameter names of every function that is jit-compiled in this
+    file: decorated ``@jax.jit`` or passed (by name) to a jit maker."""
+    jitted_defs: Set[str] = set()
+    defs = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs[node.name] = node
+            if any(_deco_name(d) in _JIT_MAKERS
+                   for d in node.decorator_list):
+                jitted_defs.add(node.name)
+        if isinstance(node, ast.Call) and call_name(node) in _JIT_MAKERS:
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(a, ast.Name):
+                    jitted_defs.add(a.id)
+    params: Set[str] = set()
+    for name in jitted_defs:
+        fn = defs.get(name)
+        if fn is None:
+            continue
+        for a in (fn.args.posonlyargs + fn.args.args
+                  + fn.args.kwonlyargs):
+            params.add((name, a.arg))
+    return params
+
+
+class _Scan(ast.NodeVisitor):
+    def __init__(self, path: str, cached_factories: Set[str],
+                 jit_params: Set):
+        self.path = path
+        self.cached_factories = cached_factories
+        self.jit_params = jit_params
+        self.findings: List[Finding] = []
+        self.fn_stack: List = []
+
+    def _flag(self, node, code: str, msg: str) -> None:
+        self.findings.append(
+            Finding(PASS_ID, code, self.path, node.lineno, msg))
+
+    # ---- enclosing-function bookkeeping ------------------------------
+
+    def _visit_fn(self, node) -> None:
+        self.fn_stack.append(node)
+        for d in node.decorator_list:
+            if _deco_name(d) in _JIT_MAKERS:
+                self._check_rebuild(node, f"@{_deco_name(d)} def "
+                                          f"{node.name}")
+        self.generic_visit(node)
+        self.fn_stack.pop()
+
+    visit_FunctionDef = _visit_fn
+    visit_AsyncFunctionDef = _visit_fn
+
+    def _check_rebuild(self, node, what: str) -> None:
+        """``node`` creates a jitted callable; the function frames it is
+        nested in must include a cache (lru_cache deco or cache-store
+        body) — module level is fine (built once at import)."""
+        # the frame the jit build runs in is the INNERMOST enclosing
+        # function that is not the jitted def itself
+        frames = [f for f in self.fn_stack if f is not node]
+        if not frames:
+            return  # module level: built once at import
+        if any(_is_cached_fn(f) for f in frames):
+            return
+        if any(_has_cache_store(f) for f in frames):
+            return
+        self._flag(node, "jit-rebuild",
+                   f"{what} is built inside "
+                   f"{frames[-1].name}(), which neither memoizes "
+                   "(functools.lru_cache) nor stores the result in a "
+                   "cache — the closure recompiles on every call; hot "
+                   "paths must build jitted steps once per shape "
+                   "signature")
+
+    # ---- calls -------------------------------------------------------
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = call_name(node)
+        if name in _JIT_MAKERS:
+            self._check_rebuild(node, f"{name}(...)")
+        base = name.split(".")[-1]
+        if base in self.cached_factories:
+            for a in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(a, (ast.List, ast.Dict, ast.Set)):
+                    self._flag(a, "unhashable-static",
+                               f"{base}() is lru_cache-wrapped but is "
+                               "passed a list/dict/set literal — "
+                               "unhashable cache key (TypeError), and "
+                               "mutable statics vary per call; pass a "
+                               "tuple of scalars")
+                elif isinstance(a, ast.Call) and call_name(a) == "len":
+                    self._flag(a, "varying-static",
+                               f"{base}() is keyed by a bare len(...) "
+                               "— a per-batch-varying static arg "
+                               "compiles one kernel per batch size; "
+                               "bucket it (_bucket / power-of-two "
+                               "padding) like the existing steps")
+                elif isinstance(a, ast.Subscript) and \
+                        isinstance(a.value, ast.Attribute) and \
+                        a.value.attr == "shape":
+                    self._flag(a, "varying-static",
+                               f"{base}() is keyed by a raw .shape "
+                               "element — per-batch-varying static "
+                               "arg; bucket it first")
+        self.generic_visit(node)
+
+    # ---- shape branches inside jitted bodies -------------------------
+
+    def _check_shape_test(self, node, test: ast.expr) -> None:
+        jit_fns = [f for f in self.fn_stack
+                   if any((f.name, a.arg) in self.jit_params
+                          for a in (f.args.posonlyargs + f.args.args
+                                    + f.args.kwonlyargs))]
+        if not jit_fns:
+            return
+        fn = jit_fns[-1]
+        pnames = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)
+                  if (fn.name, a.arg) in self.jit_params}
+        for sub in ast.walk(test):
+            hit = None
+            if isinstance(sub, ast.Attribute) and sub.attr == "shape" \
+                    and isinstance(sub.value, ast.Name) \
+                    and sub.value.id in pnames:
+                hit = f"{sub.value.id}.shape"
+            elif isinstance(sub, ast.Call) and call_name(sub) == "len" \
+                    and sub.args and isinstance(sub.args[0], ast.Name) \
+                    and sub.args[0].id in pnames:
+                hit = f"len({sub.args[0].id})"
+            if hit:
+                self._flag(node, "shape-branch",
+                           f"Python branch on {hit} inside jitted "
+                           f"{fn.name}(): shape-dependent control flow "
+                           "re-traces per shape (or raises under "
+                           "tracing); branch on closure statics "
+                           "instead")
+                return
+
+    def visit_If(self, node: ast.If) -> None:
+        self._check_shape_test(node, node.test)
+        self.generic_visit(node)
+
+    def visit_While(self, node: ast.While) -> None:
+        self._check_shape_test(node, node.test)
+        self.generic_visit(node)
+
+
+def check(tree: ast.AST, lines, path: str,
+          force: bool = False) -> List[Finding]:
+    if not force and not in_scope(path):
+        return []
+    cached = {node.name for node in ast.walk(tree)
+              if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+              and _is_cached_fn(node)}
+    scan = _Scan(path, cached, _jit_param_names(tree))
+    scan.visit(tree)
+    return scan.findings

@@ -1,0 +1,771 @@
+"""shardcheck: plan-time sharding & transfer verification.
+
+PR 9 made "no implicit reshards, no surprise host<->device hops" the
+data plane's central invariant — but only *observed* it at runtime
+(``reshard_transfers``, the sanitizer's sharding-instability check).  A
+bad plan still shipped, ran, and paid the transfer before anyone
+noticed.  Following HiFrames' stance that distribution properties of a
+dataflow program are statically inferable (arXiv:1704.02341) and
+Flare's whole-plan analysis (arXiv:1703.08219), this module *proves*
+the invariant at plan time, before a single kernel compiles.
+
+It is an abstract interpreter over the logical ``Program``: every node
+output gets a symbolic :class:`ShardSpec` — declared key columns,
+whether rows are actually key-range **aligned** across subtasks, the
+top key-hash bits consumed by subtask ranges, mesh-state engagement
+(``nk`` shards and the ``route_shift`` skipping the subtask bits),
+join-ring placement (device ``p % nk``), and the host/device transport
+pin of the producing edge (string columns force the sticky host
+fallback).  Specs propagate through FORWARD edges 1:1 (chains,
+factor->derived pane edges), re-partition at SHUFFLE/join edges, and
+degrade to unaligned on rebalances.
+
+Checks (diagnostic codes; errors reject plans at every plan-validator
+consumer — engine build preflight, REST ``/v1/pipelines/validate``,
+``bench.py`` preflight):
+
+- ``route-bit-collision`` (error) — a mesh bin-state operator at
+  parallelism P whose device route bits overlap the top
+  ``ceil(log2(P))`` subtask key-range bits: the PR 9 funneling class,
+  where every subtask's key slice collapses onto ~nk/P devices.  The
+  expected shift is ``types.route_shift_for`` — the SAME function the
+  engine wires — and the companion source audit
+  (:func:`check_wiring_source`) pins that the wiring call site exists.
+- ``predicted-reshard`` (error) — an edge where the producer's
+  out-spec cannot unify with the consumer's pinned in-spec, so mesh-
+  sharded device arrays would be re-placed at runtime (counted by
+  ``ensure_sharded``).  The report's ``predicted_reshards`` total is
+  the static analog of the live ``reshard_transfers`` counter; the
+  smoke drift gate (:func:`drift_check`) fails when the two disagree
+  in either direction, so this model can never silently rot.
+- ``shard-unpinned`` (error) — a keyed-state kernel entered with an
+  unaligned/unpinned spec (e.g. a FORWARD rebalance feeding keyed
+  state): an implicit transfer/re-key at runtime.
+- ``sticky-spec-flip`` (error) — a keyed edge behind mesh-resident
+  state that a proven string column pins to the host route: the
+  sharding spec flips device->host mid-chain and every batch gathers
+  back to host.
+- ``sticky-host-edge`` (warning) — a device-shuffle-eligible keyed
+  edge that a declared string column permanently pins to the host
+  route (stable, but the mesh never carries it).
+- ``sharding-instability`` (warning) — a device-eligible keyed edge
+  fed by an OPEN schema (JSON ingest may grow columns mid-stream): a
+  late string column would flip the edge's route mid-stream and trip
+  the runtime sanitizer.
+
+``ARROYO_SHARDCHECK=0`` disables the gate at every consumer (triage
+only — a plan that fails here pays real transfers).
+
+The lint integration (``python -m arroyo_tpu.analysis``) runs this as
+a repo-level pass: the wiring audit over ``engine/operators_window.py``
+plus a representative-plan sweep (q5-shape hop aggregate, two-stream
+join, factored correlated windows, at parallelism 1 and 2 on a
+symbolic 8-shard mesh) that must report zero errors and zero predicted
+reshards.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field, replace
+from typing import Any, Dict, List, Optional, Tuple
+
+from .core import Finding
+from .plan_validator import PlanDiagnostic
+
+PASS_ID = "shardcheck"
+
+_WIRING_FILE = os.path.join("engine", "operators_window.py")
+
+
+def shardcheck_enabled() -> bool:
+    return os.environ.get("ARROYO_SHARDCHECK", "1") not in (
+        "0", "off", "false")
+
+
+# ---------------------------------------------------------------------------
+# the spec lattice
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class ShardSpec:
+    """Symbolic sharding of one node output / edge handoff.
+
+    ``keys``     declared key columns of the rows (None = unkeyed).
+    ``aligned``  rows are key-range partitioned across subtasks on
+                 ``keys`` (a SHUFFLE routed them); FORWARD preserves
+                 it 1:1, rebalances destroy it.
+    ``part_bits`` top key-hash bits consumed by subtask ranges
+                 (``ceil(log2(P))`` at parallelism P > 1).
+    ``mesh_nk``  key shards of mesh-resident state backing this output
+                 (1 = host/single-device state).
+    ``route_shift`` first top key-hash bit the mesh routes on.
+    ``device_out`` the handoff payload is mesh-sharded device arrays
+                 (the factor->derived pane contract), so a repartition
+                 or re-placement of this edge is a predicted reshard.
+    ``sticky``   transport pin of the producing edge: 'device', 'host',
+                 or 'open' (undetermined — schema may grow at runtime).
+    ``mesh_behind`` mesh-resident state exists upstream of this spec
+                 (drives the mid-chain device->host flip check).
+    """
+
+    keys: Optional[Tuple[str, ...]] = None
+    aligned: bool = False
+    part_bits: int = 0
+    mesh_nk: int = 1
+    route_shift: int = 0
+    device_out: bool = False
+    sticky: str = "host"
+    mesh_behind: bool = False
+
+    def render(self) -> str:
+        k = ",".join(self.keys) if self.keys else "unkeyed"
+        out = f"{k}{'|aligned' if self.aligned else ''}"
+        if self.part_bits:
+            out += f"|top{self.part_bits}b"
+        if self.mesh_nk > 1:
+            out += f"|mesh{self.mesh_nk}<<{self.route_shift}"
+        if self.device_out:
+            out += "|device"
+        if self.sticky != "device":
+            out += f"|{self.sticky}"
+        return out
+
+
+@dataclass
+class ShardReport:
+    diagnostics: List[PlanDiagnostic] = field(default_factory=list)
+    predicted_reshards: int = 0
+    edge_specs: Dict[Tuple[str, str], str] = field(default_factory=dict)
+    node_specs: Dict[str, str] = field(default_factory=dict)
+    nk: int = 1
+
+    def errors(self) -> List[PlanDiagnostic]:
+        return [d for d in self.diagnostics if d.severity == "error"]
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "nk": self.nk,
+            "predicted_reshards": self.predicted_reshards,
+            "diagnostics": [d.to_json() for d in self.diagnostics],
+            "edge_specs": {f"{s}->{d}": v
+                           for (s, d), v in self.edge_specs.items()},
+        }
+
+
+def drift_check(predicted: int, observed: int,
+                plan: str = "plan") -> Optional[str]:
+    """The model-drift comparator the smoke gate runs after live
+    smoke pipelines: shardcheck's ``predicted_reshards`` must equal the
+    runtime ``reshard_transfers`` counter delta **in both directions** —
+    a runtime reshard the model missed means the static model rotted; a
+    predicted reshard the runtime never paid means the model went
+    pessimistic and would start rejecting good plans.  Returns None on
+    agreement, else the failure message."""
+    if predicted == observed:
+        return None
+    if observed > predicted:
+        return (f"shardcheck drift on {plan}: runtime counted {observed} "
+                f"reshard(s) but the static model predicted {predicted} "
+                "— the plan-time model missed a transfer class "
+                "(model rot; fix analyze(), do not waive)")
+    return (f"shardcheck drift on {plan}: the static model predicted "
+            f"{predicted} reshard(s) but runtime counted {observed} — "
+            "the model is over-pessimistic and would reject plans the "
+            "data plane runs clean")
+
+
+# ---------------------------------------------------------------------------
+# column-kind propagation (drives the sticky string-column checks)
+# ---------------------------------------------------------------------------
+
+# connector schemas the interpreter knows cold; everything else is
+# either declared (expr.output_schema) or unknown/open
+_IMPULSE_COLS = {"counter": "i", "subtask_index": "i"}
+
+
+def _source_cols(spec: Any) -> Tuple[Optional[Dict[str, str]], bool]:
+    """(column kinds, open) for a connector source.  ``open`` means the
+    schema may GROW at runtime (JSON ingest locks a schema per run but
+    genuinely-new fields still appear — formats.py), so stickiness of
+    downstream keyed edges cannot be pinned statically."""
+    conn = getattr(spec, "connector", None)
+    cfg = getattr(spec, "config", {}) or {}
+    if conn == "nexmark":
+        try:
+            from ..sql.schema_provider import nexmark_table
+
+            cols = dict(nexmark_table({}).schema.columns)
+        except Exception:
+            return None, False
+        proj = cfg.get("projection")
+        if proj:
+            cols = {c: k for c, k in cols.items() if c in proj}
+        return cols, False
+    if conn == "impulse":
+        return dict(_IMPULSE_COLS), False
+    if conn in ("single_file", "kafka", "kinesis", "sse", "polling_http",
+                "websocket", "fluvio", "filesystem", "webhook"):
+        fmt = str(cfg.get("format", "json")).lower()
+        # JSON schemas are inferred from data and may grow mid-stream
+        return None, fmt in ("json", "debezium_json", "")
+    return None, False
+
+
+def _merge_cols(sides: List[Tuple[Optional[Dict[str, str]], bool]]
+                ) -> Tuple[Optional[Dict[str, str]], bool]:
+    is_open = any(o for _c, o in sides)
+    known = [c for c, _o in sides if c is not None]
+    if len(known) != len(sides):
+        return None, is_open
+    out: Dict[str, str] = {}
+    for c in known:
+        for name, kind in c.items():
+            if out.get(name, kind) != kind:
+                # string-wins: a column that is a string on ANY branch
+                # forces the sticky host route at runtime, so the merge
+                # must stay visible to _has_string; conflicting numeric
+                # kinds promote on device and stay packable
+                out[name] = "s" if "s" in (kind, out[name]) else "?"
+            else:
+                out[name] = kind
+    return out, is_open
+
+
+def _has_string(cols: Optional[Dict[str, str]]) -> Optional[str]:
+    if not cols:
+        return None
+    for name, kind in cols.items():
+        if kind == "s":
+            return name
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the interpreter
+# ---------------------------------------------------------------------------
+
+
+def _bin_state_kinds():
+    from ..graph.logical import OpKind
+
+    # operators whose state is make_bin_state (parallel/mesh_window.py):
+    # mesh-sharded device bin rings when nk > 1 and the window shape is
+    # short enough for key sharding (long windows ring-shard the BIN
+    # axis instead and never touch the key route bits)
+    return {
+        OpKind.SLIDING_WINDOW_AGGREGATOR,
+        OpKind.TUMBLING_WINDOW_AGGREGATOR,
+        OpKind.SLIDING_AGGREGATING_TOP_N,
+        OpKind.WINDOW_FACTOR,
+        OpKind.DERIVED_WINDOW,
+    }
+
+
+def _ring_state_kinds():
+    from ..graph.logical import OpKind
+
+    # joins whose hot-partition key runs live in device rings placed
+    # across the mesh at nk > 1 (PR 9: ops/join.stage_ring(device=
+    # shuffle.partition_device(p)) — device p % nk).  Ring partitions
+    # key on the LOW hash bits (subtask ranges own the top bits), so
+    # they never participate in the route-bit funnel check — but their
+    # state IS mesh-resident, so a downstream sticky host edge is the
+    # same device->host mid-chain gather the flip check rejects.
+    return {OpKind.WINDOW_JOIN, OpKind.JOIN_WITH_EXPIRATION,
+            OpKind.MULTI_WAY_JOIN}
+
+
+def _keyed_state_kinds():
+    from .plan_validator import _keyed_state_kinds as kk
+
+    return kk()
+
+
+def _width_slide(node) -> Tuple[int, int]:
+    spec = node.operator.spec
+    w = getattr(spec, "width_micros", 0) or 0
+    s = getattr(spec, "slide_micros", 0) or w
+    if hasattr(spec, "pane_micros") and not w:  # WINDOW_FACTOR
+        w = s = spec.pane_micros
+    return w, s
+
+
+def _parse_keys(key_schema: str) -> Optional[Tuple[str, ...]]:
+    ks = (key_schema or "").strip()
+    if ks in ("", "()"):
+        return None
+    return tuple(c.strip() for c in ks.split(",") if c.strip())
+
+
+def _device_eligible(n: int, nk: int) -> bool:
+    """Mirror of parallel/shuffle.device_shuffle_enabled's structural
+    half: the fan-out a co-located keyed edge needs to ride the device
+    exchange (backend/co-location are runtime facts the static model
+    does not guess)."""
+    return n >= 2 and not (n & (n - 1)) and nk >= n
+
+
+def analyze(program: Any, nk: Optional[int] = None,
+            assume_route_shift: Optional[int] = None,
+            ring_min_w: Optional[int] = None) -> ShardReport:
+    """Run the abstract interpreter over ``program``.
+
+    ``nk``: mesh key-shard count to model (None resolves the live mesh
+    via ``mesh_key_shards()``; falls back to 1 without a usable jax).
+    ``assume_route_shift``: override the modeled route-shift wiring —
+    the seeded-funnel fixtures pass 0 to re-create the PR 9 bug class
+    and require the collision flagged.  Default None models the engine
+    contract (``types.route_shift_for``).
+    """
+    import networkx as nx  # the graph layer already depends on it
+
+    from ..graph.logical import EdgeType, OpKind
+    from ..types import route_shift_for
+
+    if nk is None:
+        try:
+            from ..parallel.mesh_window import mesh_key_shards
+
+            nk = mesh_key_shards()
+        except Exception:
+            nk = 1
+    if ring_min_w is None:
+        try:
+            ring_min_w = int(os.environ.get("ARROYO_RING_MIN_W", 64))
+        except ValueError:
+            ring_min_w = 64
+
+    rep = ShardReport(nk=nk)
+    g = program.graph
+    if not nx.is_directed_acyclic_graph(g):
+        return rep  # the plan validator already rejects cycles
+
+    bin_kinds = _bin_state_kinds()
+    ring_kinds = _ring_state_kinds()
+    keyed_kinds = _keyed_state_kinds()
+    specs: Dict[str, ShardSpec] = {}
+    cols_of: Dict[str, Tuple[Optional[Dict[str, str]], bool]] = {}
+
+    def diag(code: str, severity: str, msg: str, node: str) -> None:
+        rep.diagnostics.append(PlanDiagnostic(code, severity, msg, node))
+
+    def shift_for(p: int) -> int:
+        if assume_route_shift is not None:
+            return assume_route_shift
+        return route_shift_for(p)
+
+    for op_id in program.topo_order():
+        node = program.node(op_id)
+        kind = node.operator.kind
+        P = node.parallelism
+        in_edges = list(g.in_edges(op_id, data=True))
+
+        # ---- per-edge in-specs + edge checks --------------------------
+        in_specs: List[ShardSpec] = []
+        in_cols: List[Tuple[Optional[Dict[str, str]], bool]] = []
+        for src, _dst, data in in_edges:
+            edge = data["edge"]
+            p_spec = specs.get(src, ShardSpec())
+            p_cols, p_open = cols_of.get(src, (None, False))
+            src_p = program.node(src).parallelism
+            if edge.typ is EdgeType.FORWARD:
+                if src_p != P:
+                    # round-robin rebalance: keyed partitioning is gone
+                    spec = ShardSpec(mesh_behind=p_spec.mesh_behind)
+                    if p_spec.device_out:
+                        rep.predicted_reshards += 1
+                        diag("predicted-reshard", "error",
+                             f"{src}->{op_id}: mesh-sharded pane arrays "
+                             f"cross a rebalancing FORWARD edge "
+                             f"(parallelism {src_p}->{P}); every batch "
+                             "would be re-placed", op_id)
+                else:
+                    spec = p_spec
+            else:
+                keys = _parse_keys(edge.key_schema)
+                if p_spec.device_out:
+                    # factor->derived pane arrays are a 1:1 device
+                    # handoff; ANY repartition point between them means
+                    # re-placing every mesh-sharded pane delta
+                    rep.predicted_reshards += 1
+                    diag("predicted-reshard", "error",
+                         f"{src}->{op_id}: mesh-sharded pane arrays "
+                         f"({p_spec.render()}) cross a "
+                         f"{edge.typ.value} repartition point; the "
+                         "producer's out-sharding cannot unify with "
+                         "the consumer's in-sharding", op_id)
+                if keys is None:
+                    # "()"-keyed shuffle (union-style rebalance)
+                    spec = ShardSpec(mesh_behind=p_spec.mesh_behind)
+                else:
+                    sticky = "device"
+                    scol = _has_string(p_cols)
+                    if scol is not None:
+                        sticky = "host"
+                    elif p_cols is None or p_open:
+                        sticky = "open"
+                    spec = ShardSpec(
+                        keys=keys, aligned=True,
+                        part_bits=route_shift_for(P),
+                        sticky=sticky,
+                        mesh_behind=p_spec.mesh_behind)
+                    if _device_eligible(P, nk):
+                        if sticky == "host":
+                            if p_spec.mesh_behind:
+                                diag("sticky-spec-flip", "error",
+                                     f"{src}->{op_id}: sharding spec "
+                                     "flips device->host mid-chain — "
+                                     "state upstream is mesh-sharded "
+                                     f"but string column {scol!r} pins "
+                                     "this keyed edge to the sticky "
+                                     "host route; every batch gathers "
+                                     "back to host", op_id)
+                            else:
+                                diag("sticky-host-edge", "warning",
+                                     f"{src}->{op_id}: string column "
+                                     f"{scol!r} pins this keyed edge "
+                                     "to the host route; the mesh "
+                                     "never carries it", op_id)
+                        elif p_open and sticky == "open":
+                            diag("sharding-instability", "warning",
+                                 f"{src}->{op_id}: open JSON schema "
+                                 "feeds a device-eligible keyed edge; "
+                                 "a late string column would flip the "
+                                 "route mid-stream (the sanitizer "
+                                 "would abort the pipeline)", op_id)
+            in_specs.append(spec)
+            in_cols.append((p_cols, p_open))
+            rep.edge_specs[(src, op_id)] = spec.render()
+
+        # ---- node checks ---------------------------------------------
+        merged = in_specs[0] if len(in_specs) == 1 else ShardSpec(
+            mesh_behind=any(s.mesh_behind for s in in_specs))
+        if kind in keyed_kinds and in_specs and node.max_parallelism != 1:
+            for (src, _d, data), spec in zip(in_edges, in_specs):
+                if data["edge"].typ is EdgeType.FORWARD \
+                        and not spec.aligned:
+                    if program.node(src).operator.kind \
+                            is OpKind.WINDOW_FACTOR:
+                        continue  # 1:1 co-partitioned by construction
+                    diag("shard-unpinned", "error",
+                         f"{op_id} ({kind.value}): keyed-state kernel "
+                         f"entered with an unpinned sharding spec from "
+                         f"{src} ({spec.render()}); rows are not "
+                         "key-range aligned, so the kernel would "
+                         "implicitly transfer/re-key every batch",
+                         op_id)
+
+        mesh_here = False
+        route_shift = 0
+        if kind in bin_kinds and nk > 1:
+            w, s = _width_slide(node)
+            W = w // max(s, 1) if s else 0
+            # mirror make_bin_state's selection exactly: long windows
+            # ring-shard the BIN axis (no key route bits) only while
+            # ARROYO_RING is not forced off — with it off they fall
+            # back to the key-routed mesh state and every mesh check
+            # applies
+            ring_shape = (W and W >= ring_min_w
+                          and os.environ.get("ARROYO_RING", "auto")
+                          != "off")
+            if ring_shape:
+                pass
+            else:
+                mesh_here = True
+                route_shift = shift_for(P)
+                lg = (nk - 1).bit_length()
+                # the top-bit count the INCOMING partitioning actually
+                # consumed, straight off the propagated specs — the
+                # lattice field is load-bearing here, not just rendered
+                # (falls back to the engine contract when no in-edge
+                # declared one)
+                pb = max((s.part_bits for s in in_specs),
+                         default=0) or route_shift_for(P)
+                if P > 1 and route_shift < pb:
+                    diag("route-bit-collision", "error",
+                         f"{op_id} ({kind.value}): mesh route bits "
+                         f"[{route_shift}, {route_shift + lg}) overlap "
+                         f"the top {pb} subtask key-range bits at "
+                         f"parallelism {P}; each subtask's key slice "
+                         f"funnels onto ~{max(nk >> pb, 1)} of {nk} "
+                         "devices (the PR 9 funneling class) — wire "
+                         "set_route_shift(route_shift_for(parallelism))",
+                         op_id)
+                if route_shift + lg > 64:
+                    diag("route-bit-overflow", "error",
+                         f"{op_id}: route shift {route_shift} + "
+                         f"{lg} mesh bits exceeds the 64-bit key hash",
+                         op_id)
+
+        # ---- out-spec -------------------------------------------------
+        if kind is OpKind.CONNECTOR_SOURCE:
+            cols, is_open = _source_cols(node.operator.spec)
+            specs[op_id] = ShardSpec()
+            cols_of[op_id] = (cols, is_open)
+        elif kind in (OpKind.KEY_BY, OpKind.UPDATING_KEY):
+            specs[op_id] = replace(
+                merged, keys=node.operator.key_cols or None,
+                aligned=False, part_bits=0)
+            cols_of[op_id] = _merge_cols(in_cols) if in_cols else (None,
+                                                                  False)
+        elif kind is OpKind.GLOBAL_KEY:
+            specs[op_id] = replace(merged, keys=("__global",),
+                                   aligned=False, part_bits=0)
+            cols_of[op_id] = _merge_cols(in_cols) if in_cols else (None,
+                                                                  False)
+        elif kind in (OpKind.EXPRESSION, OpKind.UDF, OpKind.FLAT_MAP,
+                      OpKind.UPDATING, OpKind.FLATTEN, OpKind.WATERMARK):
+            expr = node.operator.expr
+            specs[op_id] = merged
+            from ..graph.logical import ExprReturnType
+
+            if expr is not None and expr.output_schema:
+                cols_of[op_id] = (dict(expr.output_schema), False)
+            elif (expr is None or expr.return_type
+                    is ExprReturnType.PREDICATE
+                    or kind is OpKind.WATERMARK):
+                cols_of[op_id] = _merge_cols(in_cols) if in_cols \
+                    else (None, False)
+            else:
+                # opaque projection: schema unknown but CLOSED (a
+                # traced fn emits a fixed column set per run)
+                _c, was_open = _merge_cols(in_cols) if in_cols \
+                    else (None, False)
+                cols_of[op_id] = (None, was_open)
+        elif kind is OpKind.UNION:
+            specs[op_id] = ShardSpec(
+                mesh_behind=any(s.mesh_behind for s in in_specs))
+            cols_of[op_id] = _merge_cols(in_cols) if in_cols else (None,
+                                                                  False)
+        elif kind in keyed_kinds:
+            # keyed state emits per owned key: aligned on its key cols.
+            # Join kinds at nk > 1 count as mesh-resident too: their
+            # hot-partition rings spread device p % nk (see
+            # _ring_state_kinds), so downstream sticky edges gather
+            # device state back to host exactly like bin-state panes.
+            ring_here = kind in ring_kinds and nk > 1
+            keys = next((s.keys for s in in_specs if s.keys), None)
+            specs[op_id] = ShardSpec(
+                keys=keys, aligned=True,
+                part_bits=route_shift_for(P),
+                mesh_nk=nk if mesh_here else 1,
+                route_shift=route_shift,
+                device_out=(kind is OpKind.WINDOW_FACTOR and mesh_here),
+                sticky=merged.sticky,
+                mesh_behind=(mesh_here or ring_here
+                             or any(s.mesh_behind for s in in_specs)))
+            cols_of[op_id] = (_agg_out_cols(node, in_cols), False)
+        else:  # sinks and anything unmodeled: pass through conservatively
+            specs[op_id] = merged
+            cols_of[op_id] = _merge_cols(in_cols) if in_cols else (None,
+                                                                  False)
+        rep.node_specs[op_id] = specs[op_id].render()
+
+    return rep
+
+
+def _join_out_cols(spec) -> Optional[Dict[str, str]]:
+    """Output kinds of a join from the spec's declared per-side
+    ``(name, kind)`` schemas (pairwise ``left_cols``/``right_cols``,
+    N-ary ``side_cols``).  Collisions mirror the engine's naming (the
+    right/later side gets the ``r_`` prefix); what downstream checks
+    actually consume is the KINDS — a string column selected through a
+    join must stay visible to the sticky-route checks.  None when the
+    planner declared nothing (unknown, never produces findings)."""
+    if hasattr(spec, "left_cols") or hasattr(spec, "right_cols"):
+        sides = [tuple(getattr(spec, "left_cols", ()) or ()),
+                 tuple(getattr(spec, "right_cols", ()) or ())]
+    elif hasattr(spec, "side_cols"):
+        sides = [tuple(s) for s in (getattr(spec, "side_cols", ()) or ())]
+    else:
+        return None
+    if not any(sides):
+        return None
+    out: Dict[str, str] = {}
+    for i, side in enumerate(sides):
+        for name, kind in side:
+            if i and name in out:
+                name = "r_" + name
+            out.setdefault(name, kind)
+    return out
+
+
+def _agg_out_cols(node, in_cols) -> Optional[Dict[str, str]]:
+    """Output kinds of a window aggregate: key cols (from upstream when
+    known) + numeric agg outputs + window bounds.  None when a
+    projection rewrites the schema opaquely."""
+    spec = node.operator.spec
+    aggs = getattr(spec, "aggs", None)
+    if aggs is None:
+        return _join_out_cols(spec)
+    if getattr(spec, "projection", None) is not None:
+        proj = spec.projection
+        if getattr(proj, "output_schema", None):
+            return dict(proj.output_schema)
+        return None
+    out = {a.output: "f" for a in aggs}
+    out["window_start"] = "t"
+    out["window_end"] = "t"
+    merged, _open = _merge_cols(in_cols) if in_cols else (None, False)
+    if merged:
+        for name, kind in merged.items():
+            out.setdefault(name, kind)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# wiring audit: the engine half of the route-shift contract
+# ---------------------------------------------------------------------------
+
+
+def check_wiring_source(src: str, path: str) -> List[Finding]:
+    """AST audit of the BinAgg wiring file: wherever ``make_bin_state``
+    is used, a guarded ``set_route_shift(route_shift_for(...))`` call
+    must exist — stripping it re-creates the PR 9 funnel (at operator
+    parallelism > 1 the mesh routes on the same top key-hash bits the
+    subtask ranges consume).  The seeded regression test feeds this
+    function the REAL source with the wiring removed and requires the
+    finding back."""
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError as e:
+        return [Finding(PASS_ID, "unparsable", path,
+                        getattr(e, "lineno", 0) or 0,
+                        f"could not parse wiring file: {e}")]
+    make_line = None
+    shift_calls: List[ast.Call] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if name == "make_bin_state" and make_line is None:
+                make_line = node.lineno
+            if name == "set_route_shift":
+                shift_calls.append(node)
+    findings: List[Finding] = []
+    if make_line is None:
+        return findings  # no bin state built here: nothing to wire
+    if not shift_calls:
+        findings.append(Finding(
+            PASS_ID, "route-shift-unwired", path, make_line,
+            "make_bin_state is used here but no set_route_shift(...) "
+            "wiring exists: at parallelism > 1 the mesh routes on the "
+            "top key-hash bits subtask ranges already consumed — every "
+            "subtask's keys funnel onto ~nk/P devices (the PR 9 bug "
+            "class shardcheck exists to catch)"))
+        return findings
+    for call in shift_calls:
+        arg = call.args[0] if call.args else None
+        ok = (isinstance(arg, ast.Call)
+              and isinstance(arg.func, (ast.Name, ast.Attribute))
+              and (arg.func.id if isinstance(arg.func, ast.Name)
+                   else arg.func.attr) == "route_shift_for")
+        if not ok:
+            findings.append(Finding(
+                PASS_ID, "route-shift-contract", path, call.lineno,
+                "set_route_shift is wired with an ad-hoc shift "
+                "expression; use types.route_shift_for so the engine "
+                "and the shardcheck static model cannot drift apart"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# lint repo pass: wiring audit + representative-plan sweep
+# ---------------------------------------------------------------------------
+
+# the canonical shapes the acceptance bar names: q5-shape hop
+# aggregate, two-stream join, factored correlated windows.  Planning
+# never runs a source, so the row counts are irrelevant.
+_SWEEP_SQL: Dict[str, str] = {
+    "q5-shape": """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark', event_rate = '1000', num_events = '1000',
+  rate_limited = 'false', batch_size = '256'
+);
+SELECT bid.auction as auction,
+       HOP(INTERVAL '2' SECOND, INTERVAL '10' SECOND) as window,
+       count(*) AS num
+FROM nexmark WHERE bid is not null GROUP BY 1, 2
+""",
+    "join": """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark', event_rate = '1000', num_events = '1000',
+  rate_limited = 'false', batch_size = '256'
+);
+WITH b AS (SELECT bid.auction AS auction, bid.price AS price
+           FROM nexmark WHERE bid is not null),
+     a AS (SELECT auction.id AS id, auction.reserve AS reserve
+           FROM nexmark WHERE auction is not null)
+SELECT X.auction AS auction, X.price AS price, Y.reserve AS reserve
+FROM b X JOIN a Y ON X.auction = Y.id
+""",
+    "factored": """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark', event_rate = '1000', num_events = '1000',
+  rate_limited = 'false', batch_size = '256'
+);
+CREATE TABLE f1 (auction BIGINT, window_end BIGINT, num BIGINT) WITH (
+  connector = 'memory', name = 'fw_a', type = 'sink');
+CREATE TABLE f2 (auction BIGINT, window_end BIGINT, tot BIGINT) WITH (
+  connector = 'memory', name = 'fw_b', type = 'sink');
+INSERT INTO f1
+SELECT bid.auction as auction,
+       HOP(INTERVAL '2' SECOND, INTERVAL '10' SECOND) as window,
+       count(*) AS num
+FROM nexmark WHERE bid is not null GROUP BY 1, 2;
+INSERT INTO f2
+SELECT bid.auction as auction,
+       HOP(INTERVAL '2' SECOND, INTERVAL '4' SECOND) as window,
+       sum(bid.price) AS tot
+FROM nexmark WHERE bid is not null GROUP BY 1, 2;
+""",
+}
+
+_SWEEP_NK = 8  # symbolic mesh: the checks must hold without devices
+
+
+def check_repo(root: str, full_scan: bool = True) -> List[Finding]:
+    findings: List[Finding] = []
+    wiring = os.path.join(root, "arroyo_tpu", _WIRING_FILE)
+    if os.path.exists(wiring):
+        with open(wiring, encoding="utf-8") as fh:
+            findings.extend(check_wiring_source(fh.read(), wiring))
+    if not full_scan:
+        # single-file/editor invocations skip the representative-plan
+        # sweep: it imports the whole planner stack and plans six SQL
+        # shapes — seconds of wall that can gate an unrelated file on
+        # plan findings; the sweep runs on every whole-package lint
+        return findings
+    self_path = os.path.abspath(__file__)
+    try:
+        from ..sql import plan_sql
+    except Exception as e:  # pragma: no cover - import surface only
+        findings.append(Finding(
+            PASS_ID, "analysis-error", self_path, 1,
+            f"plan sweep unavailable (planner import failed: {e})"))
+        return findings
+    for name, sql in _SWEEP_SQL.items():
+        for par in (1, 2):
+            try:
+                prog = plan_sql(sql, parallelism=par)
+            except Exception as e:
+                findings.append(Finding(
+                    PASS_ID, "analysis-error", self_path, 1,
+                    f"plan sweep: {name}@p{par} failed to plan: {e}"))
+                continue
+            rep = analyze(prog, nk=_SWEEP_NK)
+            for d in rep.errors():
+                findings.append(Finding(
+                    PASS_ID, d.code, self_path, 1,
+                    f"plan sweep {name}@p{par}: {d.render()}"))
+            if rep.predicted_reshards:
+                findings.append(Finding(
+                    PASS_ID, "predicted-reshard", self_path, 1,
+                    f"plan sweep {name}@p{par}: predicted "
+                    f"{rep.predicted_reshards} reshard(s); the sharded "
+                    "data plane contract is 0"))
+    return findings

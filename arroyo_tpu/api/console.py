@@ -361,9 +361,18 @@ async function validateSql() {
   if (r.ok) {
     $('dag').innerHTML = renderDag(j.graph);
     const diags = j.diagnostics || [];
-    if (diags.length) $('planmsg').textContent = diags.map(d =>
+    const lines = diags.map(d =>
       d.severity + ': ' + d.code + (d.node ? ' [' + d.node + ']' : '')
-      + ': ' + d.message).join('\n');
+      + ': ' + d.message);
+    // shardcheck plan report: the sharded data plane's contract is 0
+    // predicted reshards — surface the verifier's number either way
+    // (null means the verifier was disabled: render nothing rather
+    // than a fabricated "proven clean")
+    if (j.predicted_reshards != null)
+      lines.unshift('shardcheck: predicted_reshards='
+        + j.predicted_reshards + ' (mesh_shards=' + j.mesh_shards + ')'
+        + (j.predicted_reshards ? ' — plan pays device transfers' : ''));
+    if (lines.length) $('planmsg').textContent = lines.join('\n');
   }
   else $('planmsg').textContent = j.error;
 }

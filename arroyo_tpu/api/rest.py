@@ -189,19 +189,23 @@ class ApiServer:
 
     @staticmethod
     def _validate_plan(prog, reject: bool):
-        """Plan-time validation (analysis.plan_validator): returns the
-        structured diagnostics for the console's validation endpoint;
-        with ``reject`` a plan with error-severity diagnostics 400s
-        before a job row or running pipeline ever exists."""
-        from ..analysis.plan_validator import errors_of, validate_program
+        """Plan-time validation (analysis.plan_validator + shardcheck):
+        returns the structured plan report for the console's validation
+        endpoint — diagnostics plus the sharding verifier's
+        ``predicted_reshards``/``mesh_shards``; with ``reject`` a plan
+        with error-severity diagnostics 400s before a job row or
+        running pipeline ever exists."""
+        from ..analysis.plan_validator import errors_of, plan_report
 
-        diags = validate_program(prog)
-        errs = errors_of(diags)
+        rep = plan_report(prog)
+        errs = errors_of(rep["diagnostics"])
         if reject and errs:
             raise HttpError(
                 400, "plan validation failed: "
                      + "; ".join(d.render() for d in errs))
-        return [d.to_json() for d in diags]
+        return {"diagnostics": [d.to_json() for d in rep["diagnostics"]],
+                "predicted_reshards": rep["predicted_reshards"],
+                "mesh_shards": rep["mesh_shards"]}
 
     def _install_connection_tables(self, provider: SchemaProvider) -> None:
         """Saved connection tables become CREATE TABLEs the planner sees."""
@@ -280,10 +284,14 @@ class ApiServer:
             prog = self._plan(query, int(body.get("parallelism", 1)))
             # validation endpoint: structured plan diagnostics (errors
             # AND warnings) so the console can render them inline
-            # without attempting a create
+            # without attempting a create, plus shardcheck's plan
+            # report — predicted_reshards is the number the smoke
+            # drift gate holds against the live reshard counter
+            rep = self._validate_plan(prog, reject=False)
             return {"graph": _graph_json(prog),
-                    "diagnostics": self._validate_plan(prog,
-                                                       reject=False)}
+                    "diagnostics": rep["diagnostics"],
+                    "predicted_reshards": rep["predicted_reshards"],
+                    "mesh_shards": rep["mesh_shards"]}
 
         @r.post("/v1/pipelines")
         async def create_pipeline(req: Request):

@@ -58,10 +58,10 @@ def compile_program(program) -> CompileReport:
     report = CompileReport()
     for msg in program.validate():
         report.errors.append(msg)
-    from ..analysis.plan_validator import validate_program
+    from ..analysis.plan_validator import plan_report
 
     report.errors.extend(
-        d.render() for d in validate_program(program)
+        d.render() for d in plan_report(program)["diagnostics"]
         if d.severity == "error")
     if report.errors:
         return report

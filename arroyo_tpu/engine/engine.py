@@ -416,9 +416,19 @@ class RunningEngine:
                 return False
             try:
                 resp = await asyncio.wait_for(
-                    self.engine.control_resp.get(), timeout=remain)
+                    self.engine.control_resp.get(),
+                    timeout=min(remain, 0.25))
             except asyncio.TimeoutError:
-                return False
+                # a barrier that raced a draining bounded stream can
+                # never seal once every subtask has exited — bail
+                # immediately instead of sitting the full deadline on a
+                # queue nobody will ever write to (measured: six fuzz
+                # restore tests each burned the whole 30s here)
+                if self.engine.control_resp.empty() and all(
+                        h.task is None or h.task.done()
+                        for h in self.engine.subtasks.values()):
+                    return False
+                continue
             self.engine.resps.append(resp)
             if (resp.kind == "checkpoint_completed"
                     and resp.subtask_metadata.epoch == epoch):

@@ -180,7 +180,12 @@ class BinAggOperator(Operator):
             # must route on the bits below them or this subtask's whole
             # key slice funnels onto ~nk/parallelism devices.  Must run
             # before register_device: a restore re-shards by _shard_of.
-            self.state.set_route_shift((par - 1).bit_length())
+            # The shift expression is the shared contract in
+            # types.route_shift_for — shardcheck's static model uses the
+            # SAME function and its wiring audit pins this call site.
+            from ..types import route_shift_for
+
+            self.state.set_route_shift(route_shift_for(par))
 
         def snap():
             return self.state.snapshot() | self.keyvals.snapshot()

@@ -782,8 +782,14 @@ class Stream:
     # -- element-wise ------------------------------------------------------
 
     def map(self, fn: Callable, name: str = "map",
-            sql: str = "") -> "Stream":
-        expr = ColumnExpr(name, fn, ExprReturnType.RECORD, sql=sql)
+            sql: str = "", output_schema: Optional[Dict[str, Any]] = None
+            ) -> "Stream":
+        # output_schema ({col -> kind char}) is optional metadata the
+        # SQL planner attaches from its compile-time schema so plan-time
+        # analyses (shardcheck's sticky string-column checks) can see
+        # through projections; execution never reads it
+        expr = ColumnExpr(name, fn, ExprReturnType.RECORD, output_schema,
+                          sql=sql)
         return self._chain(LogicalOperator(OpKind.EXPRESSION, name, expr=expr))
 
     def filter(self, fn: Callable, name: str = "filter") -> "Stream":
@@ -802,8 +808,10 @@ class Stream:
         return self._chain(LogicalOperator(OpKind.FLATTEN, name))
 
     def udf(self, fn: Callable, name: str = "udf",
-            sql: str = "") -> "Stream":
-        expr = ColumnExpr(name, fn, ExprReturnType.RECORD, sql=sql)
+            sql: str = "", output_schema: Optional[Dict[str, Any]] = None
+            ) -> "Stream":
+        expr = ColumnExpr(name, fn, ExprReturnType.RECORD, output_schema,
+                          sql=sql)
         return self._chain(LogicalOperator(OpKind.UDF, name, expr=expr))
 
     # -- time --------------------------------------------------------------

@@ -933,8 +933,14 @@ class Planner:
             passthrough.append(UPDATE_OP_COLUMN)
         fn = _wrap_record(compiled, passthrough)
         name = f"project_{self._next_id()}"
-        stream = (planned.stream.udf(fn, name=name) if needs_host
-                  else planned.stream.map(fn, name=name))
+        # attach the compile-time column kinds so plan-level analyses
+        # (shardcheck's sticky string-column checks) see through the
+        # projection instead of going opaque at the first map
+        kinds = dict(new_schema.columns)
+        stream = (planned.stream.udf(fn, name=name, output_schema=kinds)
+                  if needs_host
+                  else planned.stream.map(fn, name=name,
+                                          output_schema=kinds))
         return Planned(stream, new_schema, updating=planned.updating)
 
     def _infer_kind(self, e: Expr, schema: Schema) -> str:
@@ -1137,9 +1143,20 @@ class Planner:
                            for n, e in group_exprs])
                    + "|" + repr([self._canon_token(fc, schema)
                                  for fc in collector.aggs]))
-        stream = (planned.stream.udf(pre_fn, name=pname, sql=pre_tok)
+        # column kinds of the materialized agg input: group keys keep
+        # their inferred kinds, __ain* inputs are numeric except the
+        # string-aggregate path — shardcheck's sticky-route checks read
+        # this to prove whether the keyed shuffle edge can ride the mesh
+        pre_kinds = dict(key_kinds)
+        for col, _c in pre_compiled:
+            pre_kinds.setdefault(
+                col, "s" if any(a.column == col and a.output in str_outputs
+                                for a in aggs) else "f")
+        stream = (planned.stream.udf(pre_fn, name=pname, sql=pre_tok,
+                                     output_schema=pre_kinds)
                   if pre_host
-                  else planned.stream.map(pre_fn, name=pname, sql=pre_tok))
+                  else planned.stream.map(pre_fn, name=pname, sql=pre_tok,
+                                          output_schema=pre_kinds))
 
         # key + window operator
         if key_cols:
@@ -1237,8 +1254,11 @@ class Planner:
         post_fn = _wrap_record(post_compiled, passthrough)
         post_host = any(c.needs_host for _, c in post_compiled)
         pname2 = f"agg_project_{self._next_id()}"
-        stream = (stream.udf(post_fn, name=pname2) if post_host
-                  else stream.map(post_fn, name=pname2))
+        post_kinds = dict(out_schema.columns)
+        stream = (stream.udf(post_fn, name=pname2,
+                             output_schema=post_kinds) if post_host
+                  else stream.map(post_fn, name=pname2,
+                                  output_schema=post_kinds))
         # TopN fusion rewrites the AGGREGATE node itself; with a HAVING
         # filter between the aggregate and the TopN, fusing would prune
         # groups BEFORE the filter — so HAVING disables the fusion

@@ -58,6 +58,24 @@ def server_for_hash_array(x: np.ndarray, n: int) -> np.ndarray:
     return np.minimum(idx, n - 1)
 
 
+def route_shift_for(parallelism: int) -> int:
+    """Key-hash bits a mesh route step must skip at operator
+    parallelism ``P``: subtask key ranges (:func:`server_for_hash`)
+    consume the top ``ceil(log2(P))`` bits, so device routing has to
+    start below them or every subtask's key slice funnels onto
+    ~``nk/P`` devices (the PR 9 funneling class).
+
+    This is the single source of truth for BOTH the engine wiring
+    (``BinAggOperator.on_start`` -> ``MeshKeyedBinState.set_route_shift``)
+    and the shardcheck static model (``analysis/shardcheck.py``) — the
+    two may never drift apart independently, and the smoke drift gate
+    cross-checks the combined prediction against the live
+    ``reshard_transfers`` counter.
+    """
+    p = int(parallelism)
+    return (p - 1).bit_length() if p > 1 else 0
+
+
 def range_for_server(i: int, n: int) -> Tuple[int, int]:
     """Inclusive [start, end] u64 key range owned by shard ``i`` of ``n``."""
     range_size = int(U64_MAX) // n

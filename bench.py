@@ -357,17 +357,19 @@ def operator_flight_stats(before: dict, after: dict) -> dict:
     return ops
 
 
-def preflight_validate(prog, metric: str) -> None:
+def preflight_validate(prog, metric: str) -> int:
     """Plan-validator pre-flight: a benchmark pipeline that fails
-    graph-level validation must exit non-zero with a structured error
-    line, not run to a recorded 0 events/s (the round-5 failure mode
-    was exactly a broken pipeline scoring zero silently)."""
-    from arroyo_tpu.analysis.plan_validator import (
-        errors_of,
-        validate_program,
-    )
+    graph-level validation OR shardcheck's sharding/transfer
+    verification must exit non-zero with a structured error line, not
+    run to a recorded 0 events/s (the round-5 failure mode was exactly
+    a broken pipeline scoring zero silently).  Returns the plan
+    report's ``predicted_reshards`` so the bench line can carry the
+    static prediction next to the measured ``mesh.reshards`` counter —
+    the same pairing the smoke drift gate asserts on."""
+    from arroyo_tpu.analysis.plan_validator import errors_of, plan_report
 
-    errs = errors_of(validate_program(prog))
+    rep = plan_report(prog)
+    errs = errors_of(rep["diagnostics"])
     if errs:
         print(json.dumps({
             "metric": metric, "value": 0, "unit": "events/sec",
@@ -375,6 +377,7 @@ def preflight_validate(prog, metric: str) -> None:
             "diagnostics": [d.to_json() for d in errs],
         }))
         sys.exit(2)
+    return rep["predicted_reshards"]
 
 
 def run_query(name: str, sql_template: str) -> dict:
@@ -394,7 +397,8 @@ def run_query(name: str, sql_template: str) -> dict:
     # peak sustained throughput is the stable, comparable number
     par = bench_parallelism()
     prog = plan_sql(sql, parallelism=par)
-    preflight_validate(prog, f"nexmark_{name}_events_per_sec")
+    predicted_reshards = preflight_validate(
+        prog, f"nexmark_{name}_events_per_sec")
     clear_sink("results")
     LocalRunner(prog).run()
 
@@ -463,6 +467,9 @@ def run_query(name: str, sql_template: str) -> dict:
         "width": mesh_key_shards(),
         "devices": len(_jax.devices()),
         "reshards": shuffle_delta["reshards"],
+        # shardcheck's plan-time prediction for the same counter — the
+        # pair the smoke drift gate asserts equal in both directions
+        "predicted_reshards": predicted_reshards,
         "shuffle_collectives": shuffle_delta["collectives"],
         "host_shuffle_routes": shuffle_delta["host_routes"],
     }

@@ -729,6 +729,22 @@ def test_cluster_checkpoints_pruned_live(tmp_path, monkeypatch):
         state = await ctrl.wait_for_state(job_id, JobState.FINISHED,
                                           timeout=60)
         job = ctrl.jobs[job_id]
+        # deterministic final prune: the LAST checkpoint's finalize can
+        # still be between its metadata write and its retention pass
+        # when FINISHED lands — on a loaded box, tearing down right
+        # here cancelled that in-flight prune and left retention+1
+        # complete epochs on disk (the long-standing straggler).
+        # _prune_checkpoints is idempotent, so settling it explicitly
+        # removes the race without widening the run; the direct
+        # cleanup_before covers the other half (finalize cancelled
+        # AFTER advancing min_epoch but before the storage pass, where
+        # _prune_checkpoints would early-return on the stale marker).
+        await ctrl._prune_checkpoints(job)
+        from arroyo_tpu.state.backend import ParquetBackend
+
+        backend = ParquetBackend.for_url(job.checkpoint_url)
+        await asyncio.get_running_loop().run_in_executor(
+            None, backend.cleanup_before, job_id, job.min_epoch)
         await ctrl.scheduler.stop_workers(job_id)
         await ctrl.stop()
         return state, job.last_successful_epoch, job_id

@@ -710,11 +710,12 @@ def test_engine_off_means_no_sanitizer(monkeypatch):
 # ---------------------------------------------------------------------------
 
 
-def _keyed_prog(sink_name, n=30_000):
+def _keyed_prog(sink_name, n=30_000, event_rate=0.0):
     from arroyo_tpu import Stream
 
     return (
-        Stream.source("impulse", {"event_rate": 0.0, "message_count": n,
+        Stream.source("impulse", {"event_rate": event_rate,
+                                  "message_count": n,
                                   "batch_size": 256}, parallelism=2)
         .map(lambda c: {"counter": c["counter"],
                         "k": c["counter"] % 17}, name="keyer")
@@ -777,9 +778,14 @@ def test_fuzz_checkpoint_stop_restore_rescale_sanitized(
     clear_sink(name)
     LocalRunner(_keyed_prog(name, n=2_000)).run()
     clear_sink(name)
-    # big enough that the run always outlives the seeded injection
-    # point (a finished job has no sources left to accept the barrier)
-    prog = _keyed_prog(name, n=200_000)
+    # RATE-LIMITED so the stream deterministically outlives the seeded
+    # injection point (<= 0.12s): the old unthrottled 200k-event run
+    # relied on the box being slow enough, and the vectorized ingest
+    # path made it drain in ~0.05s warm — a finished job has no
+    # sources left to accept the barrier, and the checkpoint wait
+    # correctly reports False (same deflake pattern as PR 10's
+    # rate-limited join restore test)
+    prog = _keyed_prog(name, n=60_000, event_rate=50_000.0)
     url = f"file://{tmp_path}/ckpt"
 
     async def phase1():

@@ -1,0 +1,644 @@
+"""shardcheck: plan-time sharding & transfer verification.
+
+The acceptance contract this file pins:
+
+- shardcheck statically proves ``predicted_reshards == 0`` on the q5,
+  q7/q8 join, mesh-sweep and factored correlated-window plans — with
+  the ENGINE NEVER STARTED (these tests only plan and analyze);
+- the seeded PR 9 funnel (mesh route bits colliding with subtask
+  key-range bits) and a sticky string-column mid-chain spec flip are
+  both caught at plan time;
+- the wiring audit rediscovers the funnel when the real engine source
+  has the ``set_route_shift`` call stripped;
+- the drift comparator the smoke gate runs fails on static-vs-runtime
+  disagreement in BOTH directions;
+- ``python -m arroyo_tpu.analysis`` stays green on the repo with the
+  new passes armed (zero unwaived findings), and ``--format json``
+  serves the machine-readable shape;
+- recompile-hazard flags jit cache-key hazards in fixture code while
+  the real ops/ + parallel/ layers analyze clean.
+"""
+
+import ast
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from arroyo_tpu.analysis import recompile_hazard, shardcheck
+from arroyo_tpu.analysis.shardcheck import (
+    _SWEEP_SQL,
+    analyze,
+    check_wiring_source,
+    drift_check,
+)
+
+WIRING_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "arroyo_tpu", "engine", "operators_window.py")
+
+
+def _plan(sql: str, parallelism: int = 1):
+    from arroyo_tpu.sql import plan_sql
+
+    return plan_sql(sql, parallelism=parallelism)
+
+
+# ---------------------------------------------------------------------------
+# the proof: headline plans carry zero predicted reshards
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("query", ["q5", "q7", "q8"])
+@pytest.mark.parametrize("parallelism", [1, 2])
+def test_bench_plans_prove_zero_reshards(query, parallelism):
+    """The REAL bench plans (the mesh-sweep runs q5 at every width)
+    must statically prove the sharded-data-plane invariant on a
+    symbolic 8-shard mesh — no engine, no devices, no kernels."""
+    import bench
+
+    prog = _plan(bench.QUERIES[query].format(n=1000, b=256), parallelism)
+    rep = analyze(prog, nk=8)
+    assert rep.predicted_reshards == 0, rep.to_json()
+    assert not rep.errors(), [d.render() for d in rep.errors()]
+
+
+def test_factored_plan_proves_zero_reshards():
+    """The factor->derived FORWARD pane edges unify 1:1 (same nk, same
+    route shift, equal parallelism): zero predicted reshards, and the
+    plan really is factored (one shared pane ring)."""
+    from arroyo_tpu.graph.logical import OpKind
+
+    prog = _plan(_SWEEP_SQL["factored"], 1)
+    factors = [n for n in prog.nodes()
+               if n.operator.kind is OpKind.WINDOW_FACTOR]
+    assert len(factors) == 1, "fixture did not factor"
+    rep = analyze(prog, nk=8)
+    assert rep.predicted_reshards == 0, rep.to_json()
+    assert not rep.diagnostics, [d.render() for d in rep.diagnostics]
+
+
+def test_sweep_plans_clean_at_both_parallelisms():
+    for name, sql in _SWEEP_SQL.items():
+        for par in (1, 2):
+            rep = analyze(_plan(sql, par), nk=8)
+            assert not rep.diagnostics and not rep.predicted_reshards, (
+                name, par, [d.render() for d in rep.diagnostics])
+
+
+# ---------------------------------------------------------------------------
+# seeded regressions: the PR 9 funnel and the sticky mid-chain flip
+# ---------------------------------------------------------------------------
+
+
+def test_seeded_funnel_caught_statically():
+    """Re-create the PR 9 bug class: at parallelism 2 the subtask key
+    ranges consume the top hash bit; modeling the broken wiring
+    (route shift 0) must flag the route-bit collision — before any
+    kernel compiles."""
+    prog = _plan(_SWEEP_SQL["q5-shape"], 2)
+    rep = analyze(prog, nk=8, assume_route_shift=0)
+    errs = [d for d in rep.errors() if d.code == "route-bit-collision"]
+    assert errs, [d.render() for d in rep.diagnostics]
+    assert "funnel" in errs[0].message
+    # the correct wiring (types.route_shift_for) analyzes clean
+    assert not analyze(prog, nk=8).errors()
+
+
+def test_wiring_audit_clean_then_rediscovers_stripped_funnel():
+    """The engine half of the contract: the REAL operators_window.py
+    wires set_route_shift(route_shift_for(par)); stripping that wiring
+    (exactly the PR 9 defect) must be rediscovered by the audit."""
+    src = open(WIRING_PATH, encoding="utf-8").read()
+    assert check_wiring_source(src, WIRING_PATH) == []
+    stripped = "\n".join(
+        line for line in src.splitlines()
+        if "set_route_shift" not in line and "route_shift_for" not in line)
+    findings = check_wiring_source(stripped, WIRING_PATH)
+    assert any(f.code == "route-shift-unwired" for f in findings), findings
+
+
+def test_wiring_audit_rejects_adhoc_shift_expression():
+    fixture = (
+        "class Op:\n"
+        "    def __init__(self):\n"
+        "        self.state = make_bin_state(())\n"
+        "    def on_start(self, par):\n"
+        "        if par > 1:\n"
+        "            self.state.set_route_shift((par - 1).bit_length())\n")
+    findings = check_wiring_source(fixture, "fixture.py")
+    assert any(f.code == "route-shift-contract" for f in findings)
+
+
+def test_sticky_string_column_mid_chain_flip_caught():
+    """A map that introduces a declared string column BETWEEN two keyed
+    mesh aggregates pins the second keyed edge to the host route while
+    the state upstream is mesh-sharded: the sharding spec flips
+    device->host mid-chain — an error at plan time."""
+    from arroyo_tpu.graph.logical import AggKind, AggSpec, Stream
+
+    s = (Stream.source("impulse", {"event_rate": 1000.0,
+                                   "message_count": 10}, parallelism=2)
+         .watermark()
+         .key_by("counter")
+         .sliding_aggregate(10_000_000, 2_000_000,
+                            [AggSpec(AggKind.COUNT, None, "c")],
+                            parallelism=2))
+    tagged = s.map(lambda c: c, name="tag_it")
+    tagged.program.node(tagged.tail).operator.expr.output_schema = {
+        "counter": "i", "c": "f", "tag": "s"}
+    prog = (tagged.key_by("counter")
+            .sliding_aggregate(20_000_000, 4_000_000,
+                               [AggSpec(AggKind.SUM, "c", "t")],
+                               parallelism=2)
+            .sink("blackhole"))
+    rep = analyze(prog, nk=8)
+    flips = [d for d in rep.errors() if d.code == "sticky-spec-flip"]
+    assert flips, [d.render() for d in rep.diagnostics]
+    assert "'tag'" in flips[0].message
+    # with the mesh off the same plan is merely host-routed: no flip
+    assert not analyze(prog, nk=1).errors()
+
+
+def test_sticky_flip_behind_mesh_join_ring():
+    """Join state is mesh-resident too (hot-partition rings spread
+    device p % nk): a string column pinning a keyed edge host BEHIND a
+    join must flip exactly like the bin-state case."""
+    from arroyo_tpu.graph.logical import (
+        AggKind,
+        AggSpec,
+        JoinType,
+        Stream,
+    )
+
+    left = (Stream.source("impulse", {"event_rate": 1000.0,
+                                      "message_count": 10},
+                          parallelism=2)
+            .watermark()
+            .key_by("counter"))
+    right = (Stream.source("impulse", {"event_rate": 1000.0,
+                                       "message_count": 10},
+                           parallelism=2, program=left.program)
+             .watermark()
+             .key_by("counter"))
+    joined = left.join_with_expiration(
+        right, 1_000_000, 1_000_000, JoinType.INNER, parallelism=2)
+    tagged = joined.map(lambda c: c, name="tag_it")
+    tagged.program.node(tagged.tail).operator.expr.output_schema = {
+        "counter": "i", "tag": "s"}
+    prog = (tagged.key_by("counter")
+            .tumbling_aggregate(1_000_000,
+                                [AggSpec(AggKind.COUNT, None, "n")],
+                                parallelism=2)
+            .sink("blackhole"))
+    rep = analyze(prog, nk=8)
+    assert any(d.code == "sticky-spec-flip" for d in rep.errors()), \
+        [d.render() for d in rep.diagnostics]
+    # mesh off: the ring never leaves the default device — no flip
+    assert not any(d.code == "sticky-spec-flip"
+                   for d in analyze(prog, nk=1).errors())
+
+
+def test_join_declared_string_column_visible_downstream():
+    """The planner attaches (name, kind) side schemas to join specs; a
+    string column selected THROUGH a join must stay visible to the
+    sticky-route checks on the next keyed edge — joins are not a
+    schema-laundering point.  Undeclared sides stay unknown (silent)."""
+    from arroyo_tpu.graph.logical import AggKind, AggSpec, JoinType, Stream
+
+    def build(left_cols, right_cols):
+        left = (Stream.source("impulse", {"event_rate": 1000.0,
+                                          "message_count": 10},
+                              parallelism=2)
+                .watermark()
+                .key_by("counter"))
+        right = (Stream.source("impulse", {"event_rate": 1000.0,
+                                           "message_count": 10},
+                               parallelism=2, program=left.program)
+                 .watermark()
+                 .key_by("counter"))
+        joined = left.join_with_expiration(
+            right, 1_000_000, 1_000_000, JoinType.INNER, parallelism=2)
+        spec = joined.program.node(joined.tail).operator.spec
+        spec.left_cols = left_cols
+        spec.right_cols = right_cols
+        return (joined.key_by("counter")
+                .tumbling_aggregate(1_000_000,
+                                    [AggSpec(AggKind.COUNT, None, "n")],
+                                    parallelism=2)
+                .sink("blackhole"))
+
+    prog = build((("counter", "i"),), (("tag", "s"),))
+    rep = analyze(prog, nk=8)
+    assert any(d.code == "sticky-spec-flip" for d in rep.errors()), \
+        [d.render() for d in rep.diagnostics]
+    # no declared sides: unknown schema, no findings fabricated
+    assert not analyze(build((), ()), nk=8).diagnostics
+    # all-numeric sides: proven device-eligible, still clean
+    assert not analyze(build((("counter", "i"),), (("v", "f"),)),
+                       nk=8).diagnostics
+
+
+def test_long_window_ring_exemption_honors_arroyo_ring(monkeypatch):
+    """Long windows (W >= ring_min) ring-shard the BIN axis and skip
+    the key-route checks — but ONLY while ARROYO_RING is not forced
+    off, mirroring make_bin_state's exact selection: with ring=off the
+    same shape is key-routed mesh state and the funnel check applies."""
+    from arroyo_tpu.graph.logical import AggKind, AggSpec, Stream
+
+    def plan():
+        return (Stream.source("impulse", {"event_rate": 1000.0,
+                                          "message_count": 10},
+                              parallelism=2)
+                .watermark()
+                .key_by("counter")
+                .sliding_aggregate(300_000_000, 1_000_000,  # W = 300
+                                   [AggSpec(AggKind.COUNT, None, "c")],
+                                   parallelism=2)
+                .sink("blackhole"))
+
+    # ring path: no key route bits, so the seeded-funnel model is inert
+    assert not analyze(plan(), nk=8, assume_route_shift=0).errors()
+    monkeypatch.setenv("ARROYO_RING", "off")
+    errs = analyze(plan(), nk=8, assume_route_shift=0).errors()
+    assert any(d.code == "route-bit-collision" for d in errs), \
+        [d.render() for d in errs]
+
+
+def test_sticky_host_edge_warns_without_mesh_state_behind():
+    """A string GROUP BY key straight off the source is stable (host
+    from batch 0) — a warning, not an error, and the plan still
+    predicts zero reshards."""
+    sql = """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark', event_rate = '1000', num_events = '1000',
+  rate_limited = 'false', batch_size = '256'
+);
+SELECT bid.channel as channel, TUMBLE(INTERVAL '2' SECOND) as window,
+       count(*) AS num
+FROM nexmark WHERE bid is not null GROUP BY 1, 2
+"""
+    rep = analyze(_plan(sql, 2), nk=8)
+    assert not rep.errors()
+    assert rep.predicted_reshards == 0
+    warns = [d for d in rep.diagnostics if d.code == "sticky-host-edge"]
+    assert warns and "'channel'" in warns[0].message
+
+
+def test_merge_cols_string_wins_on_conflict():
+    """Merging branch schemas where a column is a string on ANY branch
+    must keep the string kind visible — the sticky host route is forced
+    at runtime whenever string values appear, so a conflicting merge
+    can never launder a column into device-provable; numeric-vs-numeric
+    conflicts promote on device and honestly stay '?'."""
+    from arroyo_tpu.analysis.shardcheck import _has_string, _merge_cols
+
+    merged, is_open = _merge_cols([({"k": "i", "tag": "s"}, False),
+                                   ({"k": "i", "tag": "i"}, False)])
+    assert merged["tag"] == "s" and _has_string(merged) == "tag"
+    assert not is_open
+    merged2, _ = _merge_cols([({"v": "i"}, False), ({"v": "f"}, False)])
+    assert merged2["v"] == "?" and _has_string(merged2) is None
+
+
+def test_shuffled_pane_edge_predicts_reshard():
+    """Mutating the factored plan so the factor's pane arrays cross a
+    repartition point must predict reshards (> 0) and reject."""
+    from arroyo_tpu.graph.logical import EdgeType, OpKind
+
+    prog = _plan(_SWEEP_SQL["factored"], 1)
+    mutated = 0
+    for u, _v, data in prog.graph.edges(data=True):
+        if prog.node(u).operator.kind is OpKind.WINDOW_FACTOR:
+            data["edge"].typ = EdgeType.SHUFFLE
+            mutated += 1
+    assert mutated, "fixture did not factor"
+    rep = analyze(prog, nk=8)
+    assert rep.predicted_reshards >= mutated
+    assert any(d.code == "predicted-reshard" for d in rep.errors())
+
+
+def test_unpinned_spec_flagged_on_rebalanced_keyed_state():
+    """A FORWARD edge into keyed state (the dropped-shuffle mutation
+    class) is an unpinned-spec entry: the kernel would implicitly
+    re-key every batch."""
+    from arroyo_tpu.graph.logical import AggKind, AggSpec, EdgeType, \
+        Stream
+
+    prog = (Stream.source("impulse", {"event_rate": 1000.0,
+                                      "message_count": 10},
+                          parallelism=2)
+            .watermark()
+            .key_by("counter")
+            .tumbling_aggregate(1_000_000,
+                                [AggSpec(AggKind.COUNT, None, "c")])
+            .sink("blackhole"))
+    for _u, _v, data in prog.graph.edges(data=True):
+        if data["edge"].typ is EdgeType.SHUFFLE:
+            data["edge"].typ = EdgeType.FORWARD
+    rep = analyze(prog, nk=8)
+    assert any(d.code == "shard-unpinned" for d in rep.errors())
+
+
+# ---------------------------------------------------------------------------
+# the drift gate comparator
+# ---------------------------------------------------------------------------
+
+
+def test_drift_check_fails_both_directions():
+    assert drift_check(0, 0) is None
+    assert drift_check(3, 3) is None
+    rot = drift_check(0, 2, "q5")
+    assert rot is not None and "model" in rot and "q5" in rot
+    pessimist = drift_check(2, 0, "q5")
+    assert pessimist is not None and rot != pessimist
+
+
+# ---------------------------------------------------------------------------
+# validator-consumer wiring
+# ---------------------------------------------------------------------------
+
+
+def test_plan_report_carries_predicted_reshards():
+    from arroyo_tpu.analysis.plan_validator import plan_report
+
+    rep = plan_report(_plan(_SWEEP_SQL["q5-shape"], 1))
+    assert rep["predicted_reshards"] == 0
+    assert isinstance(rep["mesh_shards"], int)
+
+
+def test_plan_report_null_when_verifier_disabled(monkeypatch):
+    """ARROYO_SHARDCHECK=0 must report null, never a fabricated 0 — a
+    console or bench line must not display 'statically proven clean'
+    for a plan nobody verified."""
+    from arroyo_tpu.analysis.plan_validator import plan_report
+
+    monkeypatch.setenv("ARROYO_SHARDCHECK", "0")
+    rep = plan_report(_plan(_SWEEP_SQL["q5-shape"], 1))
+    assert rep["predicted_reshards"] is None
+    assert rep["mesh_shards"] is None
+
+
+def test_repo_pass_findings_honor_inline_waivers(tmp_path):
+    """A wiring-audit finding anchored to a parsed file picks up that
+    file's inline waiver exactly like AST-pass findings (the documented
+    waiver contract covers the repo pass)."""
+    from arroyo_tpu.analysis.core import run_analysis, unwaived
+
+    pkg = tmp_path / "arroyo_tpu" / "engine"
+    pkg.mkdir(parents=True)
+    wiring = pkg / "operators_window.py"
+    wiring.write_text(
+        "class Op:\n"
+        "    def __init__(self):\n"
+        "        # arroyolint: disable=shardcheck -- fixture: wiring "
+        "intentionally absent\n"
+        "        self.state = make_bin_state(())\n")
+    findings = run_analysis(paths=[str(wiring)], baseline_path=None,
+                            passes=["shardcheck"],
+                            repo_root=str(tmp_path))
+    audit = [f for f in findings if f.code == "route-shift-unwired"]
+    assert audit and audit[0].waived, [f.render() for f in findings]
+    assert not [f for f in unwaived(findings)
+                if f.code == "route-shift-unwired"]
+
+
+def test_repo_pass_waivers_honor_relative_paths(tmp_path, monkeypatch):
+    """Same contract under the documented CLI form: a RELATIVE path on
+    the command line still lands the repo-pass finding on that file's
+    inline waivers (the audit anchors findings at absolute paths; the
+    lookup must normalize both sides)."""
+    from arroyo_tpu.analysis.core import run_analysis, unwaived
+
+    pkg = tmp_path / "arroyo_tpu" / "engine"
+    pkg.mkdir(parents=True)
+    wiring = pkg / "operators_window.py"
+    wiring.write_text(
+        "class Op:\n"
+        "    def __init__(self):\n"
+        "        # arroyolint: disable=shardcheck -- fixture: wiring "
+        "intentionally absent\n"
+        "        self.state = make_bin_state(())\n")
+    monkeypatch.chdir(tmp_path)
+    findings = run_analysis(
+        paths=[os.path.join("arroyo_tpu", "engine",
+                            "operators_window.py")],
+        baseline_path=None, passes=["shardcheck"],
+        repo_root=str(tmp_path))
+    audit = [f for f in findings if f.code == "route-shift-unwired"]
+    assert audit and audit[0].waived, [f.render() for f in findings]
+    assert not [f for f in unwaived(findings)
+                if f.code == "route-shift-unwired"]
+
+
+def test_single_file_lint_skips_plan_sweep():
+    """A lint restricted below the package root must not pay (or gate
+    on) the representative-plan sweep — only whole-package invocations
+    run it; the wiring audit itself still runs either way."""
+    from arroyo_tpu.analysis import core
+
+    findings = core.run_analysis(
+        paths=[os.path.join(core.PKG_ROOT, "analysis", "core.py")],
+        baseline_path=None, passes=["shardcheck"])
+    assert not [f for f in findings if "plan sweep" in f.message], \
+        [f.render() for f in findings]
+
+
+def test_check_program_rejects_flip_plan_and_escape_hatch(monkeypatch):
+    """Engine build preflight (validate_before_build -> check_program)
+    rejects the sticky-flip plan with shardcheck armed and admits it
+    with ARROYO_SHARDCHECK=0 — the engine is never constructed."""
+    from arroyo_tpu.analysis.plan_validator import PlanValidationError
+    from arroyo_tpu.engine.build import validate_before_build
+    from arroyo_tpu.graph.logical import AggKind, AggSpec, Stream
+    from arroyo_tpu.parallel.mesh_window import mesh_key_shards
+
+    if mesh_key_shards() < 2:
+        pytest.skip("needs the suite's multi-device mesh")
+    s = (Stream.source("impulse", {"event_rate": 1000.0,
+                                   "message_count": 10}, parallelism=2)
+         .watermark()
+         .key_by("counter")
+         .sliding_aggregate(10_000_000, 2_000_000,
+                            [AggSpec(AggKind.COUNT, None, "c")],
+                            parallelism=2))
+    tagged = s.map(lambda c: c, name="tag_it")
+    tagged.program.node(tagged.tail).operator.expr.output_schema = {
+        "counter": "i", "c": "f", "tag": "s"}
+    prog = (tagged.key_by("counter")
+            .sliding_aggregate(20_000_000, 4_000_000,
+                               [AggSpec(AggKind.SUM, "c", "t")],
+                               parallelism=2)
+            .sink("blackhole"))
+    with pytest.raises(PlanValidationError) as ei:
+        validate_before_build(prog)
+    assert any(d.code == "sticky-spec-flip" for d in ei.value.diagnostics)
+    monkeypatch.setenv("ARROYO_SHARDCHECK", "0")
+    validate_before_build(prog)  # escape hatch admits it
+
+
+def test_rest_validate_serves_predicted_reshards(run_async):
+    """The REST validate response carries the plan report fields in the
+    same structured-diagnostics shape the console renders."""
+    import httpx
+
+    from arroyo_tpu.api.rest import ApiServer
+    from arroyo_tpu.controller.controller import ControllerServer
+
+    async def scenario():
+        controller = ControllerServer()
+        await controller.start()
+        api = ApiServer(controller)
+        port = await api.start()
+        try:
+            async with httpx.AsyncClient(
+                    base_url=f"http://127.0.0.1:{port}",
+                    timeout=30) as c:
+                r = await c.post("/v1/pipelines/validate", json={
+                    "query": "CREATE TABLE imp WITH "
+                             "(connector='impulse', event_rate='100', "
+                             "message_count='10');"
+                             "SELECT count(*) as c, "
+                             "TUMBLE(INTERVAL '1' SECOND) as w "
+                             "FROM imp GROUP BY 2"})
+                assert r.status_code == 200, r.text
+                out = r.json()
+                assert out["predicted_reshards"] == 0
+                assert out["mesh_shards"] >= 1
+                assert not [d for d in out["diagnostics"]
+                            if d["severity"] == "error"], out
+        finally:
+            await api.stop()
+            await controller.stop()
+
+    run_async(scenario())
+
+
+def test_bench_preflight_returns_prediction():
+    import bench
+
+    prog = _plan(bench.QUERIES["q5"].format(n=1000, b=256), 1)
+    assert bench.preflight_validate(prog, "test_metric") == 0
+
+
+# ---------------------------------------------------------------------------
+# lint integration: repo pass + CLI + --format json
+# ---------------------------------------------------------------------------
+
+
+def test_repo_pass_zero_unwaived_findings():
+    """Clean-repo acceptance: the shardcheck + recompile-hazard passes
+    report zero unwaived findings over the checked-in tree."""
+    from arroyo_tpu.analysis.core import run_analysis, unwaived
+
+    findings = run_analysis(passes=["shardcheck", "recompile-hazard"])
+    bad = unwaived(findings)
+    assert not bad, [f.render() for f in bad]
+
+
+def test_cli_format_json_machine_readable():
+    from arroyo_tpu.analysis import core
+
+    r = subprocess.run(
+        [sys.executable, "-m", "arroyo_tpu.analysis", "--format", "json",
+         "--pass", "recompile-hazard", "--all",
+         os.path.join("arroyo_tpu", "ops")],
+        capture_output=True, text=True, cwd=core.REPO_ROOT)
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = json.loads(r.stdout)
+    assert out["version"] == 1
+    assert "counts" in out and out["counts"]["gate"] == 0
+    for f in out["findings"]:
+        assert {"file", "line", "pass", "code", "fingerprint"} <= set(f)
+
+
+# ---------------------------------------------------------------------------
+# recompile-hazard pass
+# ---------------------------------------------------------------------------
+
+
+_HAZARD_FIXTURE = '''
+import functools, jax
+
+def hot(batch):
+    @jax.jit
+    def step(x):
+        return x + 1
+    return step(batch)
+
+@functools.lru_cache(maxsize=8)
+def factory(n):
+    @jax.jit
+    def run(x):
+        if x.shape[0] > 4:
+            return x
+        return -x
+    return run
+
+def caller(batch):
+    f = factory(len(batch))
+    g = factory([1, 2])
+    return f(batch)
+
+class Op:
+    def get(self, key):
+        f = self._cache.get(key)
+        if f is None:
+            @jax.jit
+            def run(x):
+                return x * 2
+            self._cache[key] = run
+            f = run
+        return f
+'''
+
+
+def test_recompile_hazard_fixture_rules():
+    findings = recompile_hazard.check(
+        ast.parse(_HAZARD_FIXTURE), _HAZARD_FIXTURE.splitlines(),
+        "ops/fixture.py", force=True)
+    codes = sorted(f.code for f in findings)
+    assert codes == ["jit-rebuild", "shape-branch", "unhashable-static",
+                     "varying-static"], [f.render() for f in findings]
+    # the cache-store pattern (class Op.get) is NOT a rebuild: exactly
+    # one rebuild finding, anchored at hot()'s inline jit
+    rebuilds = [f for f in findings if f.code == "jit-rebuild"]
+    assert len(rebuilds) == 1
+    assert "hot()" in rebuilds[0].message
+
+
+def test_recompile_hazard_flags_keyword_args():
+    """The cached-factory scan covers keyword arguments too — the
+    kwarg spelling of a varying/unhashable cache key is the same
+    compile-storm/TypeError class as the positional one."""
+    src = (
+        "import functools\n"
+        "@functools.lru_cache(maxsize=8)\n"
+        "def factory(n, dims=()):\n"
+        "    pass\n"
+        "def hot(batch):\n"
+        "    factory(n=len(batch))\n"
+        "    factory(1, dims=[1, 2])\n")
+    findings = recompile_hazard.check(
+        ast.parse(src), src.splitlines(), "ops/fixture.py", force=True)
+    codes = sorted(f.code for f in findings)
+    assert codes == ["unhashable-static", "varying-static"], \
+        [f.render() for f in findings]
+
+
+def test_recompile_hazard_repo_layers_clean():
+    import glob
+
+    root = os.path.dirname(WIRING_PATH).replace(
+        os.path.join("arroyo_tpu", "engine"), "arroyo_tpu")
+    for sub in ("ops", "parallel"):
+        for path in sorted(glob.glob(os.path.join(root, sub, "*.py"))):
+            src = open(path, encoding="utf-8").read()
+            findings = recompile_hazard.check(
+                ast.parse(src), src.splitlines(), path)
+            assert not findings, [f.render() for f in findings]

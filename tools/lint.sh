@@ -1,15 +1,20 @@
 #!/usr/bin/env bash
 # lint.sh — arroyolint gate: zero unwaived static-analysis findings.
 #
-# Runs every arroyolint pass (checkpoint-state arity, blocking-calls-
-# in-async, implicit host-device syncs, trace purity, proto drift,
-# per-row serde loops, the arroyosan await-point race detector and the
-# barrier/watermark protocol checker) over the package and fails on
-# any finding that is neither inline-waived (# arroyolint:
-# disable=<pass> -- reason) nor accepted in
-# tools/arroyolint_baseline.json.  Wired into tools/smoke.sh so the
-# pre-snapshot gate rejects the round-5 bug class (and the PR 3
-# await-race class) before a commit lands.
+# Runs every arroyolint pass over the package and fails on any finding
+# that is neither inline-waived (# arroyolint: disable=<pass> --
+# reason) nor accepted in tools/arroyolint_baseline.json.  shardcheck
+# (plan-time sharding & transfer verification: the route-shift wiring
+# audit + a representative-plan sweep that must predict 0 reshards)
+# and recompile-hazard (jit cache-key hazards in ops/ and parallel/)
+# run FIRST — a sharding-contract or compile-storm regression
+# invalidates every number the later invariants protect; then
+# checkpoint-state arity, blocking-calls-in-async, implicit
+# host-device syncs, trace purity, proto drift, per-row serde loops,
+# the arroyosan await-point race detector and the barrier/watermark
+# protocol checker.  Wired into tools/smoke.sh so the pre-snapshot
+# gate rejects the round-5 bug class (and the PR 3 await-race class,
+# and the PR 9 funnel class) before a commit lands.
 #
 # The baseline is a ratchet: burned down 57 -> 16 -> 0 — every
 # accepted finding is now a reasoned inline waiver at its site, and

@@ -23,12 +23,17 @@
 #      end-to-end decode-parity gate;
 #   6. mesh-on vs mesh-off: the q5-shaped hop aggregate AND the
 #      two-stream join on an 8-fake-device mesh (ARROYO_MESH=auto vs
-#      off, sanitizer armed) must emit identical rows with the
-#      no-resharding invariant holding (reshard counter == 0);
+#      off, sanitizer armed) must emit identical rows — and the
+#      shardcheck MODEL-DRIFT gate holds: the static plan report must
+#      predict 0 reshards, the live reshard_transfers counter must
+#      agree (drift_check fails on disagreement in EITHER direction),
+#      and the comparator is proven able to fire on seeded
+#      disagreements;
 #   7. factored-vs-unfactored: a two-window correlated query must
 #      actually factor (one shared pane ring), emit identical rows
 #      with ARROYO_FACTOR_WINDOWS=auto vs =0, sanitizer armed, and
-#      hold the no-resharding invariant on the 8-device mesh;
+#      hold the static-vs-runtime reshard drift gate over the
+#      factor->derived pane edges on the 8-device mesh;
 #   8. arroyosan: a sanitized tiny-Nexmark run (ARROYO_SANITIZE=1,
 #      chaining on, periodic checkpoints) must complete with zero
 #      invariant violations — the runtime protocol contract;
@@ -307,6 +312,22 @@ os.environ["ARROYO_MESH"] = "auto"
 if mesh_key_shards() != 8:
     sys.exit("smoke: 8-device CPU mesh did not come up "
              f"(mesh_key_shards={mesh_key_shards()})")
+
+# shardcheck model-drift gate, half 1: the STATIC prediction for the
+# exact plans this gate is about to run live.  The plans must prove
+# predicted_reshards == 0 with zero shardcheck errors BEFORE any
+# engine starts; after the runs, drift_check holds the prediction
+# against the observed reshard_transfers delta in both directions.
+from arroyo_tpu.analysis import shardcheck as _sc
+
+predicted = 0
+for label, sql in (("q5", Q5_SQL), ("join", JOIN_SQL)):
+    rep = _sc.analyze(plan_sql(sql), nk=mesh_key_shards())
+    if rep.errors():
+        sys.exit(f"smoke: shardcheck rejected the {label} smoke plan: "
+                 + "; ".join(d.render() for d in rep.errors()))
+    predicted += rep.predicted_reshards
+
 r0 = perf.counter(RESHARDS)
 q5_mesh = run(Q5_SQL, ("auction", "window_end", "num"), "auto")
 q5_off = run(Q5_SQL, ("auction", "window_end", "num"), "off")
@@ -323,12 +344,18 @@ if j_mesh != j_off:
     sys.exit(f"smoke: mesh-on join diverges from mesh-off "
              f"({len(j_mesh)} vs {len(j_off)} rows)")
 reshards = perf.counter(RESHARDS) - r0
-if reshards:
-    sys.exit(f"smoke: mesh runs recorded {reshards} reshard(s) — "
-             "the no-resharding invariant broke")
+drift = _sc.drift_check(predicted, reshards, "mesh smoke plans")
+if drift is not None:
+    sys.exit(f"smoke: {drift}")
+# half 2: the comparator itself must fail on disagreement in EITHER
+# direction — a gate that cannot fire is no gate
+if _sc.drift_check(0, 1) is None or _sc.drift_check(1, 0) is None:
+    sys.exit("smoke: shardcheck drift_check passed a seeded "
+             "disagreement — the drift gate is toothless")
 os.environ.pop("ARROYO_MESH", None)
 print(f"smoke: mesh equivalence ok (q5 {len(q5_mesh)} rows, join "
-      f"{len(j_mesh)} rows, mesh == single-device, 0 reshards)")
+      f"{len(j_mesh)} rows, mesh == single-device, "
+      f"predicted {predicted} == observed {reshards} reshards)")
 PY
 
 python - <<'PY'
@@ -403,6 +430,18 @@ def run(flag):
     return out
 
 
+# shardcheck drift gate over the FACTORED plan: the factor->derived
+# pane edges are exactly the handoff the static model verifies 1:1 —
+# predicted must be 0 and the live counter must agree
+from arroyo_tpu.analysis import shardcheck as _sc
+from arroyo_tpu.parallel.mesh_window import mesh_key_shards
+
+os.environ["ARROYO_FACTOR_WINDOWS"] = "auto"
+rep = _sc.analyze(plan_sql(SQL), nk=mesh_key_shards())
+if rep.errors():
+    sys.exit("smoke: shardcheck rejected the factored smoke plan: "
+             + "; ".join(d.render() for d in rep.errors()))
+
 r0 = perf.counter(RESHARDS)
 rows_on = run("auto")
 rows_off = run("0")
@@ -414,12 +453,14 @@ if rows_on != rows_off:
              f"({[len(r) for r in rows_on]} vs "
              f"{[len(r) for r in rows_off]} rows)")
 reshards = perf.counter(RESHARDS) - r0
-if reshards:
-    sys.exit(f"smoke: factor gate recorded {reshards} reshard(s) — "
-             "derived consumers must read pre-partitioned pane arrays")
+drift = _sc.drift_check(rep.predicted_reshards, reshards,
+                        "factored correlated-window plan")
+if drift is not None:
+    sys.exit(f"smoke: {drift}")
 print(f"smoke: factor-window equivalence ok "
       f"({len(rows_on[0])}+{len(rows_on[1])} identical rows, 1 shared "
-      "pane ring, 0 reshards)")
+      f"pane ring, predicted {rep.predicted_reshards} == observed "
+      f"{reshards} reshards)")
 PY
 
 python - <<'PY'

@@ -48,6 +48,11 @@ consumer — engine build preflight, REST ``/v1/pipelines/validate``,
 - ``sticky-host-edge`` (warning) — a device-shuffle-eligible keyed
   edge that a declared string column permanently pins to the host
   route (stable, but the mesh never carries it).
+- ``payload-host-gather`` (warning; escalates to the
+  ``sticky-spec-flip`` error under ``ARROYO_JOIN_PAYLOAD_DEVICE=on``)
+  — a string column in a join side's declared schema behind device key
+  rings: the payload planes can never hold it, so every match gathers
+  state from the host mirror (the sticky fallback; PR 15).
 - ``sharding-instability`` (warning) — a device-eligible keyed edge
   fed by an OPEN schema (JSON ingest may grow columns mid-stream): a
   late string column would flip the edge's route mid-stream and trip
@@ -547,6 +552,40 @@ def analyze(program: Any, nk: Optional[int] = None,
             # _ring_state_kinds), so downstream sticky edges gather
             # device state back to host exactly like bin-state panes.
             ring_here = kind in ring_kinds and nk > 1
+            if ring_here:
+                # payload-plane placement (PR 15): a string column in a
+                # join side's declared schema can never ride the device
+                # payload planes, so every match of that side gathers
+                # state from host while keys probe on device.  With
+                # device payloads FORCED on this is the same
+                # device->host flip error class as a string-pinned
+                # keyed edge; under auto it is the designed sticky
+                # fallback — stable, but worth a warning (the "host
+                # gather share high" runbook).
+                scol = _has_string(_join_out_cols(node.operator.spec))
+                if scol is not None:
+                    mode = os.environ.get(
+                        "ARROYO_JOIN_PAYLOAD_DEVICE", "auto").lower()
+                    if mode in ("on", "1", "true", "force"):
+                        diag("sticky-spec-flip", "error",
+                             f"{op_id} ({kind.value}): device payload "
+                             "residency is forced on "
+                             "(ARROYO_JOIN_PAYLOAD_DEVICE=on) but "
+                             f"string column {scol!r} in a join side "
+                             "schema can never ride the payload "
+                             "planes; every match would gather state "
+                             "host-side behind a device key ring — "
+                             "the same device->host mid-chain flip as "
+                             "a string-pinned keyed edge", op_id)
+                    elif mode not in ("off", "0", "false"):
+                        diag("payload-host-gather", "warning",
+                             f"{op_id} ({kind.value}): string column "
+                             f"{scol!r} pins this join's payload to "
+                             "the sticky host gather; device key "
+                             "rings probe on-mesh but every match "
+                             "materializes from the host mirror "
+                             "(join_host_gather_rows will dominate)",
+                             op_id)
             keys = next((s.keys for s in in_specs if s.keys), None)
             specs[op_id] = ShardSpec(
                 keys=keys, aligned=True,

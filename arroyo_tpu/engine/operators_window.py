@@ -1278,6 +1278,11 @@ def join_batches(l: Batch, r: Batch, end: int,
         if len(ridx):
             r_matched[ro[ridx]] = True
         r_un = r.select(~r_matched)
+    from ..state.join_state import _count_gather
+
+    _count_gather(0, len(l_rows) + len(r_rows)
+                  + (len(l_un) if l_un is not None else 0)
+                  + (len(r_un) if r_un is not None else 0))
     return _assemble_join_output(l_rows, r_rows, l_un, r_un, end, how,
                                  l.key_cols, l_prefix, r_prefix, tmpl,
                                  r_fallback=r, l_fallback=l)
@@ -1387,6 +1392,9 @@ class JoinWithExpirationOperator(Operator):
                     opp_all = other.all()
                     padded = opp_all.select(
                         np.isin(opp_all.key_hash, new_keys))
+                    from ..state.join_state import _count_gather
+
+                    _count_gather(0, len(padded))
                 if len(padded):
                     # the hit rows are OPPOSITE-side rows whose padded
                     # (null, row) emission is now stale; my side is the pad
@@ -1415,6 +1423,9 @@ class JoinWithExpirationOperator(Operator):
                 if len(lidx):
                     my_rows = batch.select(lo[lidx])
                     opp_rows = opp.select(ro[ridx])
+                    from ..state.join_state import _count_gather
+
+                    _count_gather(0, len(opp_rows))
                     out = self._orient(my_rows, dict(opp_rows.columns),
                                        side, end, op_create)
                     await ctx.collect(out)

@@ -300,6 +300,23 @@ def shuffle_collective_counter(job_id: str = "") -> Counter:
         "on-device all_to_all shuffle exchanges", job_id)
 
 
+JOIN_DEVICE_GATHER = "arroyo_worker_join_device_gather_rows"
+JOIN_HOST_GATHER = "arroyo_worker_join_host_gather_rows"
+
+
+def join_gather_counter(path: str, job_id: str = "") -> Counter:
+    """Join payload rows materialized per gather path: ``device`` =
+    through resident payload planes (one fused dispatch per partition),
+    ``host`` = numpy fancy-index of the host mirror (cold partitions,
+    keys-only rings, the string sticky fallback, the legacy layout).
+    With device payloads on, hot partitions must report ZERO host rows
+    — the payload-residency invariant as a number."""
+    name = JOIN_DEVICE_GATHER if path == "device" else JOIN_HOST_GATHER
+    return _plain_counter(
+        name, f"join payload rows materialized via the {path} gather",
+        job_id)
+
+
 FACTOR_SHARED_PANES = "arroyo_factor_shared_panes"
 FACTOR_DERIVED_WINDOWS = "arroyo_factor_derived_windows"
 _factor_shared: Optional[Gauge] = None

@@ -71,6 +71,15 @@ def reset_active_task(token) -> None:
     _ACTIVE_TASK.reset(token)
 
 
+def active_operator_id() -> Optional[str]:
+    """Operator id of the current (coroutine) context's task, or None
+    off-task — lets state-layer code (join gather, ring maintenance)
+    attribute profiler phases without threading ids through every
+    call."""
+    acc = _ACTIVE_TASK.get()
+    return acc.operator_id if acc is not None else None
+
+
 def run_offloaded(loop, fn, *args):
     """``loop.run_in_executor`` with contextvars propagated: executor
     threads don't inherit the caller's context, so kernels dispatched

@@ -35,6 +35,11 @@ phase               measured where
 ``shuffle_collective``  on-device ``all_to_all`` exchange carrying a
                     co-located SHUFFLE edge (parallel/shuffle.py route
                     dispatch + per-shard readback)
+``gather``          join payload materialization per emitted match set
+                    (state/join_state.py — device-plane gather dispatch
+                    or host fancy-index, whichever path ran; the
+                    device/host row split rides the
+                    ``join_*_gather_rows`` counters)
 ==================  =========================================================
 
 plus overlapping **wait** phases (reported separately, never summed into
@@ -98,7 +103,7 @@ __all__ = [
 WORK_PHASES = ("source_decode", "proc", "dispatch", "device_execute",
                "shuffle_prep", "coalesce_merge", "watermark", "checkpoint",
                "emit_encode", "frame_encode", "frame_decode", "reshard",
-               "shuffle_collective")
+               "shuffle_collective", "gather")
 WAIT_PHASES = ("queue_wait", "coalesce_wait", "send_wait", "net_flush")
 
 

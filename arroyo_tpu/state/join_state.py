@@ -27,7 +27,19 @@ state:
   power-of-two ring, maintained by a single scatter-merge kernel
   dispatch per append and probed on device (``ops/join.py``); cold
   partitions stay host numpy ("spill").  Promotion/demotion depends
-  only on the observed data sequence, so it is deterministic.
+  only on the observed data sequence, so it is deterministic;
+* hot rings are **fully device-resident** (PR 15): keys store as
+  native-i32 split-hash planes (top-32 sort key + low-32 collision
+  verify — no emulated-u64 argsort on TPU) and, with
+  ``ARROYO_JOIN_PAYLOAD_DEVICE`` on (default auto), the partition's
+  payload columns ride co-located device planes in the same layout,
+  maintained by the SAME scatter-merge dispatch.  Probes then emit
+  matches through ONE fused expand+verify+gather dispatch instead of a
+  host fancy-index per match (``join_device_gather_rows`` vs
+  ``join_host_gather_rows`` count the split).  Object (string) columns
+  cannot ride the device: the first string column observed flips the
+  buffer's STICKY host-gather fallback (rings stay keys-only, the
+  emission layout never flips mid-stream).
 
 Checkpoint contract: :class:`PartitionedJoinBuffer` subclasses
 :class:`BatchBuffer` and keeps its ``snapshot_batch``/``restore_batch``
@@ -41,6 +53,7 @@ Knobs (see docs/operations.md):
   ARROYO_JOIN_PARTITIONS=16              partitions per side (power of two)
   ARROYO_JOIN_HOT_PARTITIONS=4           device-resident partition budget
   ARROYO_JOIN_HOT_MIN_ROWS=4096          EWMA rows to qualify as hot
+  ARROYO_JOIN_PAYLOAD_DEVICE=auto|off    payload planes on hot rings
 """
 
 from __future__ import annotations
@@ -51,11 +64,50 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..obs import perf
+from ..obs import perf, profiler
 from ..types import Batch
 from .tables import BatchBuffer
 
 _NEG_INF = np.iinfo(np.int64).min
+
+# dtype kinds the payload planes can transport (ops/join.payload_plan);
+# anything else — object/str — flips the buffer's sticky host fallback
+_PAYLOAD_KINDS = "fiubMm"
+
+
+def _count_gather(dev_rows: int, host_rows: int) -> None:
+    """Account materialized join rows to the device/host gather split
+    (perf counters + prometheus mirrors) — the payload-residency
+    invariant is a measured number, not an assumption."""
+    from ..obs.metrics import join_gather_counter
+
+    if dev_rows:
+        perf.count("join_device_gather_rows", dev_rows)
+        join_gather_counter("device").inc(dev_rows)
+    if host_rows:
+        perf.count("join_host_gather_rows", host_rows)
+        join_gather_counter("host").inc(host_rows)
+
+
+def _fill_cols(cols: Dict[str, np.ndarray], n: int, sel: Any,
+               pcols: Dict[str, np.ndarray]) -> None:
+    """Fill output rows ``sel`` from one partition's gathered columns,
+    null-initializing and dtype-promoting so a partition lacking a
+    column (late schema drift) can never expose garbage."""
+    for c, v in pcols.items():
+        if c not in cols:
+            if v.dtype == object:
+                cols[c] = np.full(n, None, dtype=object)
+            elif v.dtype.kind == "f":
+                cols[c] = np.full(n, np.nan, dtype=v.dtype)
+            else:
+                cols[c] = np.zeros(n, dtype=v.dtype)
+        tgt = cols[c]
+        if tgt.dtype != v.dtype:
+            cols[c] = tgt = tgt.astype(
+                object if (tgt.dtype == object or v.dtype == object)
+                else np.result_type(tgt.dtype, v.dtype))
+        tgt[sel] = v
 
 
 def partitioned_join_enabled() -> bool:
@@ -91,7 +143,7 @@ class _Partition:
 
     __slots__ = ("cols", "keys", "ts", "n", "cap", "order", "skeys",
                  "sts", "valid_from", "dead", "_evicts_since_scan",
-                 "touches", "dev", "dev_device")
+                 "touches", "dev", "dev_device", "payload_on")
 
     def __init__(self) -> None:
         self.cols: Dict[str, np.ndarray] = {}
@@ -108,11 +160,14 @@ class _Partition:
         self.dead = 0  # estimated rows below valid_from
         self._evicts_since_scan = 0
         self.touches = 0.0  # EWMA of rows handled per operation
-        self.dev: Optional[Any] = None  # device-resident sorted-key ring
+        # device-resident split-hash ring (ops/join.SplitRing): i32 key
+        # planes + optionally the co-located payload stacks
+        self.dev: Optional[Any] = None
         # mesh device owning this partition's ring (None = default chip;
         # parallel.shuffle.partition_device spreads hot rings over the
         # ("keys",) mesh so joins stop funneling through one device)
         self.dev_device: Optional[Any] = None
+        self.payload_on = False  # buffer policy at last promotion
 
     # -- storage -----------------------------------------------------------
 
@@ -200,34 +255,79 @@ class _Partition:
         perf.count("join_state_merges")
         self.touches = 0.9 * self.touches + 0.1 * m * 10  # EWMA over ops
         if self.dev is not None:
-            self._device_merge(dkeys, dpos, keep)
+            dts = ts[dorder]
+            dcols = ({c: self.cols[c][n:n + m][dorder]
+                      for c in self.cols}
+                     if self.dev.plan is not None else None)
+            self._device_merge(dkeys, dpos, keep, dts, dcols)
 
     # -- device residency --------------------------------------------------
 
     def _device_merge(self, dkeys: np.ndarray, dpos: np.ndarray,
-                      keep: np.ndarray) -> None:
+                      keep: np.ndarray, dts: np.ndarray,
+                      dcols: Optional[Dict[str, np.ndarray]]) -> None:
         from ..ops import join as dj
 
-        ring, cap = self.dev
-        if self.n > cap:
-            # ring overflow: regrow to the next power-of-two ring
+        ring = self.dev
+        if self.n > ring.cap:
+            # ring overflow: regrow to the next power-of-two ring — the
+            # restage keeps key AND payload placement in lockstep
+            perf.count("join_state_ring_regrows")
             self.promote()
             return
+        if self.payload_on:
+            # payload plan drift (a column appeared, widened, or went
+            # string): restage so the planes always mirror storage.  A
+            # string schema keeps a KEYS-ONLY ring without restaging
+            # every merge (payload_plan stays None for it).
+            want = {c: v.dtype for c, v in self.cols.items()}
+            want_plan = dj.payload_plan(want)
+            if want_plan is not None and (
+                    ring.plan is None or ring.plan_schema() != want):
+                self.promote()
+                return
+            if want_plan is None and ring.plan is not None:
+                self.promote()
+                return
+        elif ring.plan is not None:
+            self.promote()  # payload switched off: drop the planes
+            return
         res_pos = np.nonzero(keep)[0].astype(np.int64)
-        self.dev = (dj.merge_ring(ring, cap, res_pos, dkeys, dpos), cap)
+        merged = dj.merge_ring(ring, res_pos, dkeys, dpos,
+                               delta_ts=dts, delta_cols=dcols)
+        if merged is None:  # delta hit the top-32 sentinel: exactness
+            self.demote()   # over speed — the host mirror takes over
+            return
+        self.dev = merged
         perf.count("join_state_device_merges")
 
-    def promote(self, device: Any = None) -> None:
-        """Stage this partition's sorted keys into a preallocated
-        power-of-two device ring (idempotent; also used to regrow —
-        regrows keep the mesh device the first promotion pinned)."""
+    def promote(self, device: Any = None,
+                payload: Optional[bool] = None) -> None:
+        """Stage this partition's sorted keys — plus, when the buffer's
+        payload policy is on, its payload columns in the same sorted-run
+        order — into preallocated power-of-two device planes
+        (idempotent; also used to regrow and to re-plan after schema
+        drift — restages keep the mesh device the first promotion
+        pinned)."""
         from ..ops import join as dj
 
         if device is not None:
             self.dev_device = device
-        ring, cap = dj.stage_ring(self.skeys[: self.n],
-                                  device=self.dev_device)
-        self.dev = (ring, cap)
+        if payload is not None:
+            self.payload_on = payload
+        n = self.n
+        cols = None
+        if self.payload_on:
+            order = self.order[:n]
+            cols = {c: v[:n][order] for c, v in self.cols.items()}
+        ring = dj.stage_ring(self.skeys[:n], device=self.dev_device,
+                             sorted_ts=self.sts[:n], sorted_cols=cols)
+        if ring is None:
+            # a key's top-32 bits collide with the ring sentinel
+            # (~2^-32/row): this partition stays host — exactness first
+            self.dev = None
+            return
+        self.dev = ring
         perf.count("join_state_promotions")
 
     def demote(self) -> None:
@@ -297,8 +397,9 @@ class _Partition:
               ) -> Tuple[np.ndarray, np.ndarray]:
         """Match ranges of sorted query keys against the resident run.
         Returns (qidx, spos): for every (query row, live matching state
-        row) pair, the index into ``qkeys_sorted`` and the STORAGE
-        position of the match."""
+        row) pair, the index into ``qkeys_sorted`` and the SORTED-RUN
+        position of the match (``gather`` maps to storage, or straight
+        into the device payload planes)."""
         n = self.n
         if n == 0 or len(qkeys_sorted) == 0:
             z = np.zeros(0, dtype=np.int64)
@@ -307,35 +408,82 @@ class _Partition:
         if self.dev is not None:
             from ..ops import join as dj
 
-            start, counts = dj.probe_ring(self.dev[0], self.dev[1],
-                                          qkeys_sorted, n)
+            hit = dj.probe_ring(self.dev, qkeys_sorted, n)
+            total = int(hit.counts.sum())
+            if total == 0:
+                z = np.zeros(0, dtype=np.int64)
+                return z, z
+            qidx, sidx = dj.expand_hit(self.dev, hit, total)
+            # full-key collision verify on the host mirror: device
+            # candidates are top-32-equal ranges; the rare
+            # i32-equal-but-u64-distinct rows die here
+            ok = self.skeys[sidx] == qkeys_sorted[qidx]
+            if not ok.all():
+                qidx, sidx = qidx[ok], sidx[ok]
         else:
             skeys = self.skeys[:n]
             start = np.searchsorted(skeys, qkeys_sorted, side="left")
             end = np.searchsorted(skeys, qkeys_sorted, side="right")
             counts = end - start
-        if not counts.any():
-            z = np.zeros(0, dtype=np.int64)
-            return z, z
-        from ..ops.join import expand_counts
+            if not counts.any():
+                z = np.zeros(0, dtype=np.int64)
+                return z, z
+            from ..ops.join import expand_counts
 
-        qidx, offs = expand_counts(counts)
-        sidx = np.repeat(start, counts) + offs  # sorted-run positions
-        if self.valid_from != _NEG_INF:
+            qidx, offs = expand_counts(counts)
+            sidx = np.repeat(start, counts) + offs  # sorted-run positions
+        if self.valid_from != _NEG_INF and len(sidx):
             alive = self.sts[sidx] >= self.valid_from
             qidx, sidx = qidx[alive], sidx[alive]
-        return qidx, self.order[sidx]
+        return qidx, sidx
+
+    def probe_rows(self, qkeys_sorted: np.ndarray
+                   ) -> Tuple[np.ndarray, np.ndarray,
+                              Optional[Dict[str, np.ndarray]],
+                              Optional[np.ndarray]]:
+        """Fused probe + payload materialization: like :meth:`probe`
+        but, when this partition's payload planes are resident, the
+        candidate expansion, the full-key collision verify AND the
+        payload gather run as ONE device dispatch
+        (``ops/join.expand_gather``) — no host fancy-index per match.
+        Returns (qidx, spos, cols, ts); cols/ts are None when the
+        caller must host-gather (cold partition or keys-only ring)."""
+        ring = self.dev
+        if ring is None or ring.plan is None:
+            qidx, spos = self.probe(qkeys_sorted)
+            return qidx, spos, None, None
+        n = self.n
+        if n == 0 or len(qkeys_sorted) == 0:
+            z = np.zeros(0, dtype=np.int64)
+            return z, z, None, None
+        self.touches = 0.9 * self.touches + 0.1 * len(qkeys_sorted) * 10
+        from ..ops import join as dj
+
+        hit = dj.probe_ring(ring, qkeys_sorted, n)
+        total = int(hit.counts.sum())
+        if total == 0:
+            z = np.zeros(0, dtype=np.int64)
+            return z, z, None, None
+        qidx, sidx, valid, gf, gi = dj.expand_gather(ring, hit, total)
+        keep = valid
+        if self.valid_from != _NEG_INF:
+            keep = keep & (gi[0] >= self.valid_from)
+        if not keep.all():
+            qidx, sidx = qidx[keep], sidx[keep]
+            gf, gi = gf[:, keep], gi[:, keep]
+        ts, cols = dj.unpack_payload(ring, gf, gi)
+        return qidx, sidx, cols, ts
 
     def range_view(self, start: Optional[int], end: Optional[int]
                    ) -> Tuple[np.ndarray, np.ndarray]:
-        """(keys_sorted, storage_positions) of live rows with
+        """(keys_sorted, sorted_run_positions) of live rows with
         start <= ts < end — mask-compress of the sorted run, which stays
         key-sorted, so fires never re-sort."""
         if self.n == 0:
             return (np.zeros(0, dtype=np.uint64),
                     np.zeros(0, dtype=np.int64))
         m = self.live_mask_sorted(start, end)
-        return self.skeys[: self.n][m], self.order[: self.n][m]
+        return self.skeys[: self.n][m], np.nonzero(m)[0]
 
 
 class PartitionedJoinBuffer(BatchBuffer):
@@ -353,6 +501,11 @@ class PartitionedJoinBuffer(BatchBuffer):
         self._schema: Dict[str, np.dtype] = {}
         self._appends = 0
         self._uid = next(_BUF_UIDS)
+        # STICKY string fallback: the first object/string column flips
+        # payload residency off for this buffer's whole life — the
+        # emission layout (and the edge's sharding spec) never flips
+        # mid-stream (shardcheck's sticky-route contract)
+        self._payload_sticky_host = False
 
     # -- routing -----------------------------------------------------------
 
@@ -364,6 +517,11 @@ class PartitionedJoinBuffer(BatchBuffer):
 
         return device_join_enabled(1 << 30)  # state-resident: size-free
 
+    def _payload_active(self) -> bool:
+        from ..ops.join import payload_device_enabled
+
+        return payload_device_enabled() and not self._payload_sticky_host
+
     def append(self, batch: Batch) -> None:
         if not len(batch):
             return
@@ -371,6 +529,10 @@ class PartitionedJoinBuffer(BatchBuffer):
         if batch.key_cols:
             self.key_cols = batch.key_cols
         self._schema = {c: v.dtype for c, v in batch.columns.items()}
+        if not self._payload_sticky_host and any(
+                dt.kind not in _PAYLOAD_KINDS
+                for dt in self._schema.values()):
+            self._payload_sticky_host = True
         dest = self._route(batch.key_hash)
         order = np.argsort(dest, kind="stable")
         bounds = np.searchsorted(dest[order], np.arange(self.P + 1))
@@ -423,13 +585,15 @@ class PartitionedJoinBuffer(BatchBuffer):
         grace = set(ranked[: budget + 2])
         from ..parallel.shuffle import partition_device
 
+        payload = self._payload_active()
         for p, part in enumerate(self.parts):
             if p in hot and part.dev is None:
                 # sharded device placement over the same ("keys",) mesh
                 # axis the window state uses: partition p's ring lives on
                 # mesh device p % nk (deterministic — promotion stays a
-                # pure function of the observed data sequence)
-                part.promote(device=partition_device(p))
+                # pure function of the observed data sequence); payload
+                # planes ride the same device in lockstep
+                part.promote(device=partition_device(p), payload=payload)
             elif part.dev is not None and p not in hot and (
                     part.touches < floor / 2 or p not in grace):
                 part.demote()
@@ -538,64 +702,130 @@ class PartitionedJoinBuffer(BatchBuffer):
             return z, z
         return np.concatenate(qi_parts), np.concatenate(gp_parts)
 
+    def _empty_rows(self) -> Batch:
+        cols = {c: np.empty(0, dtype=dt)
+                for c, dt in self._schema.items()}
+        return Batch(np.zeros(0, dtype=np.int64), cols,
+                     np.zeros(0, dtype=np.uint64), self.key_cols)
+
     def gather(self, gpos: np.ndarray) -> Batch:
-        """Materialize rows by encoded (part, pos) global positions,
-        preserving the given order (pair alignment)."""
+        """Materialize rows by encoded (part, sorted-run pos) global
+        positions, preserving the given order (pair alignment).  Hot
+        partitions with resident payload planes gather ON DEVICE (one
+        fused dispatch per partition, ``ops/join.gather_ring``); cold
+        partitions host-gather through the sorted-run order mapping —
+        the split is counted (``join_device_gather_rows`` /
+        ``join_host_gather_rows``) and profiled (``gather`` phase)."""
         n = len(gpos)
         if n == 0:
-            cols = {c: np.empty(0, dtype=dt)
-                    for c, dt in self._schema.items()}
-            return Batch(np.zeros(0, dtype=np.int64), cols,
-                         np.zeros(0, dtype=np.uint64), self.key_cols)
+            return self._empty_rows()
         part_of = (gpos >> 48).astype(np.int64)
         pos = (gpos & ((1 << 48) - 1)).astype(np.int64)
         ts = np.empty(n, dtype=np.int64)
         kh = np.empty(n, dtype=np.uint64)
         cols: Dict[str, np.ndarray] = {}
-        for p in np.unique(part_of).tolist():
-            part = self.parts[p]
-            sel = part_of == p
-            rows = pos[sel]
-            ts[sel] = part.ts[rows]
-            kh[sel] = part.keys[rows]
-            for c, v in part.cols.items():
-                if c not in cols:
-                    # null-initialize so a partition lacking this column
-                    # (late schema drift) can never expose garbage
-                    if v.dtype == object:
-                        cols[c] = np.full(n, None, dtype=object)
-                    elif v.dtype.kind == "f":
-                        cols[c] = np.full(n, np.nan, dtype=v.dtype)
-                    else:
-                        cols[c] = np.zeros(n, dtype=v.dtype)
-                tgt = cols[c]
-                if tgt.dtype != v.dtype:
-                    cols[c] = tgt = tgt.astype(
-                        object if (tgt.dtype == object
-                                   or v.dtype == object)
-                        else np.result_type(tgt.dtype, v.dtype))
-                tgt[sel] = v[rows]
+        dev_rows = host_rows = 0
+        prof = profiler.active()
+        frame = (prof.begin(perf.active_operator_id() or "join",
+                            "gather") if prof is not None else None)
+        try:
+            for p in np.unique(part_of).tolist():
+                part = self.parts[p]
+                sel = part_of == p
+                spos = pos[sel]
+                kh[sel] = part.skeys[spos]
+                ring = part.dev
+                if ring is not None and ring.plan is not None:
+                    from ..ops import join as dj
+
+                    gf, gi = dj.gather_ring(ring, spos)
+                    pts, pcols = dj.unpack_payload(ring, gf, gi)
+                    ts[sel] = pts
+                    dev_rows += len(spos)
+                else:
+                    ts[sel] = part.sts[spos]
+                    rows = part.order[spos]
+                    pcols = {c: v[rows] for c, v in part.cols.items()}
+                    host_rows += len(spos)
+                _fill_cols(cols, n, sel, pcols)
+        finally:
+            if frame is not None:
+                prof.end(frame)
+        _count_gather(dev_rows, host_rows)
         return Batch(ts, cols, kh, self.key_cols)
 
     def probe_batch(self, batch: Batch
                     ) -> Tuple[np.ndarray, Batch, np.ndarray]:
         """Join an arriving batch against this (opposite-side) state
         WITHOUT materializing or re-sorting the state: sort only the
-        batch's keys, probe each partition's resident run.
+        batch's keys, probe each partition's resident run.  Hot
+        partitions with payload planes take the fused
+        probe->expand->gather device path (:meth:`_Partition.probe_rows`)
+        so matched state rows materialize without a host fancy-index.
 
         Returns ``(bsel, state_rows, counts)``: matched-pair batch row
         indices, the aligned state rows, and per-batch-row live match
         counts (original batch order) for outer-join unmatched masks."""
         kh = batch.key_hash
+        nq = len(kh)
         sorter = np.argsort(kh, kind="stable")
-        qidx, gpos = self.probe_positions(kh[sorter], pre_sorted=True)
-        counts = np.zeros(len(kh), dtype=np.int64)
-        if len(qidx):
-            bsel = sorter[qidx]
-            np.add.at(counts, bsel, 1)
-        else:
-            bsel = np.zeros(0, dtype=np.int64)
-        return bsel, self.gather(gpos), counts
+        qk = kh[sorter]
+        dest = self._route(qk)
+        counts = np.zeros(nq, dtype=np.int64)
+        qi_parts: List[np.ndarray] = []
+        blocks: List[Tuple[_Partition, np.ndarray,
+                           Optional[Dict[str, np.ndarray]],
+                           Optional[np.ndarray]]] = []
+        total = 0
+        for p in range(self.P):
+            sel = np.nonzero(dest == p)[0]
+            if not len(sel) or self.parts[p].n == 0:
+                continue
+            qidx, spos, dcols, dts = self.parts[p].probe_rows(qk[sel])
+            if not len(qidx):
+                continue
+            qi_parts.append(sel[qidx])
+            blocks.append((self.parts[p], spos, dcols, dts))
+            total += len(qidx)
+        if not total:
+            return np.zeros(0, dtype=np.int64), self._empty_rows(), counts
+        bsel = sorter[np.concatenate(qi_parts)]
+        np.add.at(counts, bsel, 1)
+        return bsel, self._assemble_blocks(blocks, total), counts
+
+    def _assemble_blocks(self, blocks, total: int) -> Batch:
+        """One output batch from per-partition probe results, device- or
+        host-gathered per block (same null-init/promotion rules as
+        :meth:`gather`)."""
+        ts = np.empty(total, dtype=np.int64)
+        kh = np.empty(total, dtype=np.uint64)
+        cols: Dict[str, np.ndarray] = {}
+        dev_rows = host_rows = 0
+        at = 0
+        prof = profiler.active()
+        frame = (prof.begin(perf.active_operator_id() or "join",
+                            "gather") if prof is not None else None)
+        try:
+            for part, spos, dcols, dts in blocks:
+                m = len(spos)
+                sel = slice(at, at + m)
+                kh[sel] = part.skeys[spos]
+                if dcols is not None:
+                    ts[sel] = dts
+                    pcols = dcols
+                    dev_rows += m
+                else:
+                    ts[sel] = part.sts[spos]
+                    rows = part.order[spos]
+                    pcols = {c: v[rows] for c, v in part.cols.items()}
+                    host_rows += m
+                _fill_cols(cols, total, sel, pcols)
+                at += m
+        finally:
+            if frame is not None:
+                prof.end(frame)
+        _count_gather(dev_rows, host_rows)
+        return Batch(ts, cols, kh, self.key_cols)
 
     def rows_with_keys(self, keys: np.ndarray) -> Batch:
         """Live rows whose key is in ``keys`` (each row once)."""
@@ -668,9 +898,24 @@ class PartitionedJoinBuffer(BatchBuffer):
         ring_devs = {str(part.dev_device) for part in self.parts
                      if part.dev is not None
                      and part.dev_device is not None}
+        # payload residency shape: rings carrying co-located payload
+        # planes, their device bytes, and total ring capacity — bench's
+        # state_bounded check holds these against the TTL horizon so a
+        # regrow leak is a failed gate, not a silent OOM
+        payload_rings = ring_cap = payload_bytes = 0
+        for part in self.parts:
+            if part.dev is None:
+                continue
+            ring_cap += part.dev.cap
+            if part.dev.plan is not None:
+                payload_rings += 1
+                payload_bytes += part.dev.payload_bytes()
         return {"partitions": self.P, "hot_partitions": hot,
                 "spill_bytes": host_bytes, "rows": rows,
-                "ring_devices": len(ring_devs)}
+                "ring_devices": len(ring_devs),
+                "payload_rings": payload_rings,
+                "payload_ring_bytes": payload_bytes,
+                "ring_cap_rows": ring_cap}
 
 
 _BUF_UIDS = itertools.count()
@@ -686,7 +931,8 @@ def aggregate_stats_registry(reg: Optional[Dict[Any, Dict[str, Any]]]
         return {}
     out = {"partitions": max(e.get("partitions", 0) for e in entries),
            "buffers": len(entries)}
-    for k in ("hot_partitions", "spill_bytes", "rows"):
+    for k in ("hot_partitions", "spill_bytes", "rows", "payload_rings",
+              "payload_ring_bytes", "ring_cap_rows"):
         out[k] = int(sum(e.get(k, 0) for e in entries))
     # mesh spread is per buffer; the fold reports the widest one
     out["ring_devices"] = int(max(e.get("ring_devices", 0)

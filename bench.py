@@ -311,8 +311,20 @@ def run_control(name: str) -> dict:
 JOIN_STATE_COUNTERS = (
     "join_state_merges", "join_state_resorts", "join_state_compactions",
     "join_state_promotions", "join_state_demotions",
-    "join_state_device_merges",
+    "join_state_device_merges", "join_state_ring_regrows",
+    "join_device_gather_rows", "join_host_gather_rows",
 )
+
+
+def _gather_share(stats: dict) -> dict:
+    """Device-gather share of materialized join rows (PR 15's payload
+    residency as a measured number): rows emitted through resident
+    payload planes over all rows emitted.  ``None`` when the run
+    materialized no join rows at all."""
+    dev = stats.get("join_device_gather_rows", 0)
+    host = stats.get("join_host_gather_rows", 0)
+    return {"device_gather_share":
+            (round(dev / (dev + host), 4) if dev + host else None)}
 
 
 def bench_parallelism() -> int:
@@ -486,6 +498,11 @@ def run_query(name: str, sql_template: str) -> dict:
 
         join_stats.update(aggregate_stats_registry(
             perf.get_note("join_state_registry")))
+        # payload-residency evidence for the q7/q8 headline lines: with
+        # device payloads on, hot partitions must emit through the
+        # resident planes (host rows come only from cold partitions,
+        # keys-only rings, and the string sticky fallback)
+        join_stats.update(_gather_share(join_stats))
         result["join_state"] = join_stats
     ctl = run_control(name)
     result.update(ctl)
@@ -1185,6 +1202,81 @@ def run_kernel_microbench() -> dict:
     out["join_step_ms"] = round(dt * 1e3, 3)
     out["join_rows_per_sec"] = round((nl + nr) / dt, 1)
 
+    # resident-ring probe + payload materialization (PR 15): the
+    # pre-PR-15 hot path — emulated-u64 ring probe, pair readback, host
+    # fancy-index payload gather — vs the split-hash i32 ring with the
+    # fused expand+verify+gather dispatch.  On an accelerator the new
+    # path must win >= 5x (the u64 compares are emulated there and the
+    # per-match readback pays d2h_lat_ms); on CPU the pair of numbers
+    # still records and ``ring_probe_parity`` carries the gate.
+    ns = nq = 16384
+    from arroyo_tpu.types import hash_u64
+
+    srng = np.random.default_rng(3)
+    # realistic keys: full-entropy u64 hashes of an 8k id space (~2
+    # state rows per key), exactly what key_by feeds the join state —
+    # the split-hash layout relies on top-32 entropy, which real
+    # key_hash columns always have
+    skeys = np.sort(hash_u64(srng.integers(0, 8192, ns)))
+    sts = srng.integers(0, 1 << 40, ns)
+    scols = {"v0": srng.standard_normal(ns),
+             "v1": srng.integers(0, 1 << 50, ns),
+             "v2": srng.standard_normal(ns),
+             "v3": srng.integers(0, 1 << 30, ns)}
+    qk = np.sort(hash_u64(srng.integers(0, 8192, nq)))
+    cap = dj._bucket(ns)
+    mq = dj._bucket(nq)
+    # baseline ring: u64 keys, probe kernels on u64, gather on host
+    ring64 = np.full(cap, dj.SENTINEL, np.uint64)
+    ring64[:ns] = skeys
+    ring64_d = jax.device_put(ring64, dev)
+    qp = np.full(mq, dj.SENTINEL, np.uint64)
+    qp[:nq] = qk
+    pk64 = dj._probe_kernel(mq, cap, dj._merged_probe())
+    start0, counts0, _ = pk64(qp, ring64_d, nq, ns)
+    total = int(np.asarray(counts0)[:nq].sum())
+    mb = dj._bucket(total)
+    ex64 = dj._expand_kernel(mq, mb)
+
+    def u64_host():
+        start_d, cnt_d, cum_d = pk64(qp, ring64_d, nq, ns)
+        lidx_d, ridx_d = ex64(start_d, cum_d)
+        lidx = np.asarray(lidx_d)[:total]
+        ridx = np.asarray(ridx_d)[:total]
+        rows = {c: v[ridx] for c, v in scols.items()}
+        rows["ts"] = sts[ridx]
+        return lidx, ridx, rows
+
+    dt = timeit(u64_host, warmup=3, iters=10)
+    out["ring_probe_u64_host_ms"] = round(dt * 1e3, 3)
+
+    # payload planes engage because sorted_cols is passed explicitly —
+    # the ARROYO_JOIN_PAYLOAD_DEVICE knob gates the buffer layer, not
+    # these kernel-level calls
+    ring = dj.stage_ring(skeys, device=dev, sorted_ts=sts,
+                         sorted_cols=scols)
+
+    def split_fused():
+        hit = dj.probe_ring(ring, qk, ns)
+        t = int(hit.counts.sum())
+        lidx, ridx, valid, gf, gi = dj.expand_gather(ring, hit, t)
+        ts2, cols2 = dj.unpack_payload(ring, gf, gi)
+        return lidx, ridx, valid, ts2, cols2
+
+    dt2 = timeit(split_fused, warmup=3, iters=10)
+    out["ring_probe_split_fused_ms"] = round(dt2 * 1e3, 3)
+    out["ring_probe_rows"] = total
+    out["ring_probe_speedup"] = round(dt / dt2, 2)
+    # parity: the fused path must emit exactly the baseline's pairs and
+    # payload bytes (the verify plane may only kill non-matches; this
+    # fixture has none by construction of the exact u64 baseline probe)
+    bl, br, brows = u64_host()
+    fl, fr, fvalid, fts, fcols = split_fused()
+    out["ring_probe_parity"] = bool(
+        fvalid.all() and (bl == fl).all() and (br == fr).all()
+        and (brows["ts"] == fts).all()
+        and all((brows[c] == fcols[c]).all() for c in scols))
+
     # ring-pane emission kernel (long-window bin-sharded sweep): on a
     # single chip the mesh degenerates to 1 shard but the kernel (cumsum
     # sweep + halo plumbing) is the one the engine runs at W>=64
@@ -1314,7 +1406,13 @@ def run_join_stress() -> dict:
     stats = {k.replace("join_state_", ""):
              perf.counter(k) - before[k] for k in JOIN_STATE_COUNTERS}
     snap = aggregate_stats_registry(perf.get_note("join_state_registry"))
+    stats.update(_gather_share(stats))
     live_rows = snap.get("rows")
+    # payload rings are pow2(partition rows incl. the <= 2x dead-row
+    # estimate lag), so their summed capacity must ALSO track the TTL
+    # horizon: a ring that regrows without demoting/compacting (a
+    # payload-plane leak) blows this bound long before host state does
+    ring_cap = snap.get("ring_cap_rows", 0)
     return {
         "metric": "join_stress_events_per_sec",
         "value": round(2 * n / dt, 1), "unit": "events/sec",
@@ -1323,9 +1421,11 @@ def run_join_stress() -> dict:
         "join_state": {**stats, **snap},
         # bounded-state check: resident rows (both sides summed, with
         # the dead-estimate's up-to-8-eviction lag) must track the TTL
-        # horizon (~ttl/interval per side), not the stream length
+        # horizon (~ttl/interval per side), not the stream length —
+        # and so must the device payload-ring capacity
         "state_bounded": (live_rows is not None
-                          and live_rows < 6 * (ttl // 1000)),
+                          and live_rows < 6 * (ttl // 1000)
+                          and ring_cap < 12 * (ttl // 1000)),
     }
 
 

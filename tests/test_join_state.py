@@ -170,6 +170,205 @@ def test_hot_promotion_deterministic_and_probe_parity(monkeypatch):
         "device rings must not change probe results"
 
 
+# -- PR 15: split-hash rings + device-resident payload planes ----------------
+
+
+def _dev_rows():
+    from arroyo_tpu.obs import perf
+
+    return perf.counter("join_device_gather_rows")
+
+
+def _payload_buf(monkeypatch, payload="auto", parts=1):
+    """A buffer whose partitions promote on the first append (floor 1)
+    with the requested payload policy."""
+    monkeypatch.setenv("ARROYO_DEVICE_JOIN", "on")
+    monkeypatch.setenv("ARROYO_JOIN_HOT_MIN_ROWS", "1")
+    monkeypatch.setenv("ARROYO_JOIN_PAYLOAD_DEVICE", payload)
+    return PartitionedJoinBuffer(n_partitions=parts)
+
+
+def test_split_hash_helpers_preserve_order_and_pad_exactness():
+    """The biased-i32 image of the top 32 hash bits must sort exactly
+    like the u64 keys, and runs whose keys collide with the hi pad must
+    refuse staging (exactness over speed)."""
+    from arroyo_tpu.ops.join import ring_stageable, split_hi32, split_lo32
+
+    rng = np.random.default_rng(5)
+    keys = rng.integers(0, 1 << 63, 4096, dtype=np.uint64) * np.uint64(3)
+    hi = split_hi32(keys)
+    order_u64 = np.argsort(keys >> np.uint64(32), kind="stable")
+    order_i32 = np.argsort(hi, kind="stable")
+    np.testing.assert_array_equal(order_u64, order_i32)
+    # lo plane is a bit-view: hi+lo reconstructs the key
+    lo = split_lo32(keys).view(np.uint32).astype(np.uint64)
+    hi_u = ((hi.view(np.uint32) ^ np.uint32(0x80000000))
+            .astype(np.uint64) << np.uint64(32))
+    np.testing.assert_array_equal(hi_u | lo, keys)
+    assert ring_stageable(keys)
+    assert not ring_stageable(
+        np.array([np.uint64(0xFFFFFFFF) << np.uint64(32)], np.uint64))
+
+
+def test_i32_collision_rows_die_in_the_verify(monkeypatch):
+    """Keys equal in the top 32 bits but distinct in the low 32 are
+    probe CANDIDATES on the hi plane; the full-key verify must kill
+    them — on device in the fused expand+gather dispatch (payload
+    rings) and against the host mirror on the keys-only path."""
+    twin_a = (np.uint64(0x42) << np.uint64(32)) | np.uint64(5)
+    twin_b = (np.uint64(0x42) << np.uint64(32)) | np.uint64(9)
+
+    for payload in ("auto", "off"):
+        buf = _payload_buf(monkeypatch, payload)
+        buf.append(_mk_batch(np.array([twin_a] * 3 + [7], np.uint64)))
+        ring = buf.parts[0].dev
+        assert ring is not None
+        assert (ring.plan is not None) == (payload == "auto")
+        probe = _mk_batch(np.array([twin_b], np.uint64))
+        bsel, rows, counts = buf.probe_batch(probe)
+        assert len(bsel) == 0 and counts.tolist() == [0], \
+            f"i32-collision row survived the {payload} verify"
+        bsel, rows, _c = buf.probe_batch(
+            _mk_batch(np.array([twin_a], np.uint64)))
+        assert len(bsel) == 3 and set(rows.key_hash) == {twin_a}
+
+
+def test_hi_pad_collision_keeps_partition_host(monkeypatch):
+    """A key whose top 32 bits are all ones is ambiguous with the ring
+    pad: the partition must refuse staging and stay exact on host."""
+    buf = _payload_buf(monkeypatch)
+    bad = (np.uint64(0xFFFFFFFF) << np.uint64(32)) | np.uint64(3)
+    buf.append(_mk_batch(np.array([bad, 11, 11], np.uint64)))
+    assert buf.parts[0].dev is None, "unstageable run got a ring"
+    bsel, rows, _c = buf.probe_batch(_mk_batch(np.array([bad], np.uint64)))
+    assert len(bsel) == 1 and rows.key_hash[0] == bad
+
+
+def test_payload_probe_batch_parity_and_counters(monkeypatch):
+    """The fused device gather must emit bit-identical rows (every
+    dtype kind the planes transport: f8/f4/i8/i4/u8/bool) to the host
+    fancy-index across appends, TTL eviction and regrows — and the
+    device/host split must land in the gather counters."""
+    rng = np.random.default_rng(23)
+
+    def extra(n):
+        return {
+            "f8": rng.normal(size=n),
+            "f4": rng.normal(size=n).astype(np.float32),
+            "i4": rng.integers(-50, 50, n).astype(np.int32),
+            "u8": rng.integers(0, 1 << 60, n).astype(np.uint64),
+            "b": rng.integers(0, 2, n).astype(bool),
+        }
+
+    def run(payload):
+        rng.bit_generator.state = state0
+        buf = _payload_buf(monkeypatch, payload, parts=4)
+        d0 = _dev_rows()
+        outs = []
+        for step in range(6):
+            n = int(rng.integers(50, 300))
+            keys = rng.integers(0, 60, n).astype(np.uint64)
+            buf.append(_mk_batch(keys, ts=rng.integers(0, 1000, n),
+                                 extra=extra(n)))
+            if step == 3:
+                buf.evict_before(400)
+            probe = _mk_batch(rng.integers(0, 80, 70).astype(np.uint64))
+            bsel, rows, counts = buf.probe_batch(probe)
+            order = np.lexsort((rows.timestamp, rows.key_hash, bsel))
+            outs.append((bsel[order].tolist(), counts.tolist(),
+                         rows.timestamp[order].tolist(),
+                         {c: v[order].tolist()
+                          for c, v in sorted(rows.columns.items())},
+                         {c: str(v.dtype)
+                          for c, v in rows.columns.items()}))
+        return outs, _dev_rows() - d0
+
+    state0 = rng.bit_generator.state
+    outs_on, dev_on = run("auto")
+    outs_off, dev_off = run("off")
+    assert outs_on == outs_off
+    assert dev_on > 0, "payload rings never emitted through the device"
+    assert dev_off == 0, "payload=off still device-gathered"
+
+
+def test_string_payload_sticky_host_fallback(monkeypatch):
+    """The first string column flips the buffer's STICKY host-gather
+    fallback: rings stay keys-only for the buffer's whole life (even
+    for later all-numeric batches), every match host-gathers, and the
+    stats report zero payload rings."""
+    buf = _payload_buf(monkeypatch)
+    d0 = _dev_rows()
+    tags = np.array(["a", "b", "c", "a"], dtype=object)
+    buf.append(_mk_batch([1, 2, 3, 1], extra={"tag": tags}))
+    buf.append(_mk_batch([4, 5]))  # numeric-only later batch
+    ring = buf.parts[0].dev
+    assert ring is not None and ring.plan is None, \
+        "string schema must keep rings keys-only"
+    assert buf.stats()["payload_rings"] == 0
+    bsel, rows, _c = buf.probe_batch(_mk_batch([1, 9]))
+    assert len(bsel) == 2
+    assert sorted(rows.columns["tag"].tolist()) == ["a", "a"]
+    assert _dev_rows() == d0, "sticky-host buffer used the device gather"
+
+
+def test_payload_checkpoint_roundtrip_with_resident_rings(monkeypatch):
+    """snapshot_batch with payload rings resident must capture exactly
+    the live rows (the host mirror is authoritative), and the restored
+    buffer re-promotes payload rings and answers probes identically."""
+    rng = np.random.default_rng(31)
+    buf = _payload_buf(monkeypatch, parts=4)
+    for _ in range(3):
+        n = 200
+        buf.append(_mk_batch(rng.integers(0, 40, n).astype(np.uint64),
+                             ts=rng.integers(0, 1000, n),
+                             extra={"f8": rng.normal(size=n)}))
+    buf.evict_before(300)
+    assert buf.stats()["payload_rings"] >= 1
+    snap = buf.snapshot_batch()
+    back = PartitionedJoinBuffer(n_partitions=4)
+    back.restore_batch(snap)
+    assert len(back) == len(buf)
+    assert back.stats()["payload_rings"] >= 1, \
+        "restore must re-promote payload rings"
+    probe = _mk_batch(rng.integers(0, 50, 64).astype(np.uint64))
+    bsel_a, rows_a, counts_a = buf.probe_batch(probe)
+    bsel_b, rows_b, counts_b = back.probe_batch(probe)
+    key_a = sorted(zip(bsel_a.tolist(), rows_a.timestamp.tolist(),
+                       rows_a.columns["f8"].tolist()))
+    key_b = sorted(zip(bsel_b.tolist(), rows_b.timestamp.tolist(),
+                       rows_b.columns["f8"].tolist()))
+    assert counts_a.tolist() == counts_b.tolist()
+    assert key_a == key_b
+
+
+def test_payload_rings_spread_over_mesh(monkeypatch):
+    """Payload planes ride the SAME mesh device their partition's key
+    ring pinned (shuffle.partition_device): hot partitions spread over
+    the fake 8-device mesh instead of funneling through chip 0."""
+    import jax
+
+    monkeypatch.setenv("ARROYO_MESH", "auto")
+    rng = np.random.default_rng(17)
+    buf = _payload_buf(monkeypatch, parts=8)
+    monkeypatch.setenv("ARROYO_JOIN_HOT_PARTITIONS", "8")
+    n = 4000
+    keys = rng.integers(0, 3000, n).astype(np.uint64)
+    b = _mk_batch(keys, ts=rng.integers(0, 1000, n),
+                  extra={"f8": rng.normal(size=n)})
+    for lo in range(0, n, 1024):
+        buf.append(b.select(np.arange(lo, min(lo + 1024, n))))
+    stats = buf.stats()
+    assert stats["payload_rings"] >= 2, stats
+    assert stats["ring_devices"] >= 2, stats
+    assert stats["payload_ring_bytes"] > 0
+    for p in buf.parts:
+        if p.dev is not None and p.dev.plan is not None:
+            assert p.dev_device in jax.devices()
+            for plane in (p.dev.hi, p.dev.fstack, p.dev.istack):
+                assert next(iter(plane.devices())) == p.dev_device, \
+                    "payload plane drifted off its key ring's device"
+
+
 # -- end-to-end parity -------------------------------------------------------
 
 JOIN_SQL = """
@@ -195,16 +394,28 @@ def _run_join_sql(sql=JOIN_SQL, cols=("auction", "price", "reserve")):
         for b in sink_output("results") for i in range(len(b)))
 
 
-@pytest.mark.parametrize("device,probe", [
-    ("off", "search"), ("on", "search"), ("on", "merged")])
-def test_partitioned_vs_legacy_identical_rows(monkeypatch, device, probe):
+@pytest.mark.parametrize("device,probe,payload", [
+    ("off", "search", "off"), ("on", "search", "off"),
+    ("on", "merged", "auto"), ("on", "search", "auto")])
+def test_partitioned_vs_legacy_identical_rows(monkeypatch, device, probe,
+                                              payload):
     """The sanitized parity matrix: partitioned and legacy join state
-    must emit identical rows under every device/probe configuration
-    (tier-1 conftest keeps ARROYO_SANITIZE armed)."""
+    must emit identical rows under every device/probe/payload-residency
+    configuration (tier-1 conftest keeps ARROYO_SANITIZE armed); the
+    hot floor is lowered so the payload combos actually emit through
+    resident planes (counter-asserted) instead of vacuously passing."""
+    from arroyo_tpu.obs import perf
+
     monkeypatch.setenv("ARROYO_DEVICE_JOIN", device)
     monkeypatch.setenv("ARROYO_JOIN_PROBE", probe)
+    monkeypatch.setenv("ARROYO_JOIN_PAYLOAD_DEVICE", payload)
+    monkeypatch.setenv("ARROYO_JOIN_HOT_MIN_ROWS", "16")
     monkeypatch.setenv("ARROYO_JOIN_STATE", "partitioned")
+    d0 = perf.counter("join_device_gather_rows")
     part = _run_join_sql()
+    dev_rows = perf.counter("join_device_gather_rows") - d0
+    assert (dev_rows > 0) == (payload == "auto" and device == "on"), \
+        f"device gather rows {dev_rows} vs payload={payload}"
     monkeypatch.setenv("ARROYO_JOIN_STATE", "legacy")
     legacy = _run_join_sql()
     assert part and part == legacy

@@ -244,6 +244,60 @@ def test_join_declared_string_column_visible_downstream():
                        nk=8).diagnostics
 
 
+def test_string_payload_column_pins_host_gather(monkeypatch):
+    """Payload-plane placement in the spec lattice (PR 15): a string
+    column in a join side's declared schema behind mesh-resident key
+    rings can never ride the device payload planes.  Under the default
+    auto policy that is the designed sticky fallback — a warning
+    pointing at the host-gather-share runbook; with device payloads
+    FORCED on it is the same device->host mid-chain flip error class
+    as a string-pinned keyed edge; with payloads off (or the mesh off)
+    there is nothing to flag."""
+    from arroyo_tpu.graph.logical import JoinType, Stream
+
+    def build(right_cols):
+        left = (Stream.source("impulse", {"event_rate": 1000.0,
+                                          "message_count": 10},
+                              parallelism=2)
+                .watermark()
+                .key_by("counter"))
+        right = (Stream.source("impulse", {"event_rate": 1000.0,
+                                           "message_count": 10},
+                               parallelism=2, program=left.program)
+                 .watermark()
+                 .key_by("counter"))
+        joined = left.join_with_expiration(
+            right, 1_000_000, 1_000_000, JoinType.INNER, parallelism=2)
+        spec = joined.program.node(joined.tail).operator.spec
+        spec.left_cols = (("counter", "i"),)
+        spec.right_cols = right_cols
+        return joined.sink("blackhole")
+
+    prog = build((("tag", "s"),))
+    rep = analyze(prog, nk=8)
+    assert not rep.errors(), [d.render() for d in rep.errors()]
+    warns = [d for d in rep.diagnostics
+             if d.code == "payload-host-gather"]
+    assert warns and "'tag'" in warns[0].message, \
+        [d.render() for d in rep.diagnostics]
+
+    monkeypatch.setenv("ARROYO_JOIN_PAYLOAD_DEVICE", "on")
+    errs = [d for d in analyze(prog, nk=8).errors()
+            if d.code == "sticky-spec-flip"]
+    assert errs and "payload" in errs[0].message, \
+        "forced payload residency must escalate to the flip error"
+
+    monkeypatch.setenv("ARROYO_JOIN_PAYLOAD_DEVICE", "off")
+    assert not analyze(prog, nk=8).diagnostics, \
+        "payloads off: rings are keys-only by design, nothing to flag"
+
+    monkeypatch.delenv("ARROYO_JOIN_PAYLOAD_DEVICE")
+    assert not analyze(prog, nk=1).diagnostics, \
+        "mesh off: no device rings, no payload placement question"
+    # all-numeric sides ride the planes: clean under every policy
+    assert not analyze(build((("v", "f"),)), nk=8).diagnostics
+
+
 def test_long_window_ring_exemption_honors_arroyo_ring(monkeypatch):
     """Long windows (W >= ring_min) ring-shard the BIN axis and skip
     the key-route checks — but ONLY while ARROYO_RING is not forced

@@ -144,15 +144,27 @@ print(f"smoke: chain equivalence ok ({len(rows_on)} rows; "
 PY
 
 python - <<'PY'
-# partitioned-vs-legacy join-state equivalence gate: a tiny two-stream
-# join must produce IDENTICAL rows with the partition-adaptive sorted-run
-# state (default) and the legacy flat-buffer state — the same-rows
-# contract that lets the layouts share checkpoints
+# join-state equivalence gate: a tiny two-stream join must produce
+# IDENTICAL rows with (a) the partition-adaptive sorted-run state
+# (default) vs the legacy flat-buffer state — the same-rows contract
+# that lets the layouts share checkpoints — and (b) device payload
+# rings ON vs OFF (ARROYO_JOIN_PAYLOAD_DEVICE, sanitizer armed, hot
+# floor lowered so rings actually promote): PR 15's fully
+# device-resident emission path against the host gather, with the
+# join_device_gather_rows counter proving which path each run took
 import os
 import sys
 
+os.environ["ARROYO_SANITIZE"] = "1"
+os.environ["ARROYO_DEVICE_JOIN"] = "on"
+# tiny stream: ~8 rows land per partition per append, so the default
+# 4096-row EWMA hot floor would never promote a ring — drop it so the
+# device path actually engages inside the smoke budget
+os.environ["ARROYO_JOIN_HOT_MIN_ROWS"] = "16"
+
 from arroyo_tpu.connectors.memory import clear_sink, sink_output
 from arroyo_tpu.engine.engine import LocalRunner
+from arroyo_tpu.obs import perf
 from arroyo_tpu.sql import plan_sql
 
 SQL = """
@@ -170,27 +182,50 @@ FROM b X JOIN a Y ON X.auction = Y.id
 """
 
 
-def run(layout: str):
+def run(layout: str, payload: str):
     os.environ["ARROYO_JOIN_STATE"] = layout
+    os.environ["ARROYO_JOIN_PAYLOAD_DEVICE"] = payload
     clear_sink("results")
-    LocalRunner(plan_sql(SQL)).run()
-    return sorted(
+    d0 = perf.counter("join_device_gather_rows")
+    runner = LocalRunner(plan_sql(SQL))
+    runner.run()
+    san = runner.engine.sanitizer
+    if san is None or san.violations:
+        sys.exit(f"smoke: join gate sanitizer problem (layout={layout}, "
+                 f"payload={payload}, "
+                 f"violations={getattr(san, 'violations', None)})")
+    dev_rows = perf.counter("join_device_gather_rows") - d0
+    return dev_rows, sorted(
         (int(a), int(p), int(r))
         for b in sink_output("results")
         for a, p, r in zip(b.columns["auction"], b.columns["price"],
                            b.columns["reserve"]))
 
 
-rows_part = run("partitioned")
-rows_legacy = run("legacy")
-os.environ.pop("ARROYO_JOIN_STATE", None)
-if not rows_part:
+dev_on, rows_on = run("partitioned", "auto")
+dev_off, rows_off = run("partitioned", "off")
+_, rows_legacy = run("legacy", "off")
+for k in ("ARROYO_JOIN_STATE", "ARROYO_JOIN_PAYLOAD_DEVICE",
+          "ARROYO_JOIN_HOT_MIN_ROWS", "ARROYO_DEVICE_JOIN"):
+    os.environ.pop(k, None)
+if not rows_on:
     sys.exit("smoke: partitioned join produced no output")
-if rows_part != rows_legacy:
+if rows_on != rows_off:
+    sys.exit(f"smoke: device-payload join output diverges from host "
+             f"gather ({len(rows_on)} vs {len(rows_off)} rows)")
+if rows_on != rows_legacy:
     sys.exit(f"smoke: partitioned join state diverges from legacy "
-             f"({len(rows_part)} vs {len(rows_legacy)} rows)")
-print(f"smoke: join-state equivalence ok ({len(rows_part)} rows, "
-      "partitioned == legacy)")
+             f"({len(rows_on)} vs {len(rows_legacy)} rows)")
+if dev_on <= 0:
+    sys.exit("smoke: payload-on join never emitted through the device "
+             "gather (join_device_gather_rows == 0 — the payload rings "
+             "did not engage)")
+if dev_off != 0:
+    sys.exit(f"smoke: payload-off join still device-gathered "
+             f"{dev_off} rows (the knob does not disarm the planes)")
+print(f"smoke: join-state equivalence ok ({len(rows_on)} rows, "
+      f"device-payload == host-gather == legacy; {dev_on} rows via "
+      "device planes when armed)")
 PY
 
 python - <<'PY'

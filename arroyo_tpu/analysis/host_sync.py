@@ -30,7 +30,12 @@ PASS_ID = "host-sync"
 
 _SCOPE_RE = re.compile(r"(^|/)(ops/[^/]+\.py|engine/operators_[^/]+\.py)$")
 _EXEMPT_FN_RE = re.compile(
-    r"checkpoint|snapshot|restore|debug|on_start|on_close|handle_commit")
+    r"checkpoint|snapshot|restore|debug|on_start|on_close|handle_commit"
+    # latency-observatory stamp sites (obs/latency.py): _lat_track /
+    # _lat_consume read the host wall clock (now_micros / monotonic) to
+    # stamp or judge a sampled batch — host-clock reads, never a
+    # device readback, so new flag kinds must not indict them
+    r"|_lat_")
 
 
 def in_scope(path: str) -> bool:

@@ -239,10 +239,20 @@ def _merge_cols(sides: List[Tuple[Optional[Dict[str, str]], bool]]
     return out, is_open
 
 
+# the latency observatory's reserved ingest-stamp column name
+# (obs/latency.py STAMP_COLUMN — tests pin the two in sync): an i64
+# wall-clock by construction, so if it ever surfaces as a real column
+# it is transportable (packs on the device shuffle) and must NEVER
+# force the sticky host route the way an unknown/string column would
+_LAT_STAMP_COLUMN = "__lat_ingest"
+
+
 def _has_string(cols: Optional[Dict[str, str]]) -> Optional[str]:
     if not cols:
         return None
     for name, kind in cols.items():
+        if name == _LAT_STAMP_COLUMN:
+            continue
         if kind == "s":
             return name
     return None

@@ -144,6 +144,9 @@ FROM nexmark WHERE bid is not null GROUP BY 1, 2</textarea>
                 onclick="toggleAutoscaler()" id="as_toggle">enable</button>
       </span></h2>
       <pre id="autoscaler">decision ledger: watch a job…</pre></div>
+    <div style="margin-top:10px"><h2>Latency
+      <span id="lat_state" style="color:var(--dim)"></span></h2>
+      <pre id="latency">latency observatory: watch a job…</pre></div>
   </section>
 </main>
 <script>
@@ -575,6 +578,7 @@ async function pollJob() {
       .join('\\n') || '—';
   }
   pollAutoscaler(jid);
+  pollLatency(jid);
 }
 
 // ---- autoscaler decision ledger -------------------------------------------
@@ -625,6 +629,44 @@ function fmtBytes(b) {
   if (b >= 1e6) return (b / 1e6).toFixed(2) + ' MB';
   if (b >= 1e3) return (b / 1e3).toFixed(1) + ' kB';
   return b + ' B';
+}
+
+// ---- latency observatory panel --------------------------------------------
+
+async function pollLatency(jid) {
+  // per-sink e2e quantiles + critical-path decomposition + SLO verdict
+  // (obs/latency.py); empty unless a worker samples
+  // (ARROYO_LATENCY_SAMPLE_N>0)
+  const r = await fetch(`/v1/jobs/${jid}/latency`).catch(() => null);
+  if (!r || !r.ok) return;
+  const a = await r.json();
+  const slo = a.slo || {};
+  const last = slo.last || {};
+  $('lat_state').textContent = !a.sample_n
+    ? '(sampling off: set ARROYO_LATENCY_SAMPLE_N)'
+    : `(1-in-${a.sample_n} sampling · ` +
+      (slo.configured
+        ? `SLO ${last.violating ? 'VIOLATING' : 'ok'} · ` +
+          `burn ${last.burn_rate ?? 0} · ` +
+          `${slo.violations_total ?? 0} violations`
+        : 'no SLO') + ')';
+  const lines = [];
+  for (const [op, q] of Object.entries(a.sinks || {}))
+    lines.push(`${op}  p50 ${q.p50_ms}ms  p99 ${q.p99_ms}ms` +
+               `  (${q.count} samples)`);
+  for (const [op, age] of Object.entries(a.watermark_age_ms || {}))
+    lines.push(`${op}  watermark age ${age}ms`);
+  const cp = a.critical_path || {};
+  if (cp.dominant) {
+    lines.push(`critical path: ${cp.dominant} ` +
+               `(${((cp.dominant_share || 0) * 100).toFixed(0)}% of ` +
+               `${(cp.total_secs || 0).toFixed(2)}s measured)`);
+    for (const [st, secs] of Object.entries(cp.stages || {}))
+      lines.push(`  ${st}: ${secs.toFixed(3)}s`);
+  }
+  for (const [t, b] of Object.entries(a.device_state_bytes || {}))
+    lines.push(`device ${t}: ${fmtBytes(b)}`);
+  $('latency').textContent = lines.join('\\n') || '—';
 }
 
 async function ckptDetail(epoch) {

@@ -522,6 +522,87 @@ class ApiServer:
             self.controller.persist_autoscaler(jid)
             return scaler.status()
 
+        @r.get("/v1/jobs/{jid}/latency")
+        async def job_latency(req: Request):
+            """End-to-end latency observatory view (obs/latency.py):
+            per-sink e2e quantiles, per-operator watermark ages, the
+            critical-path stage decomposition, the device-memory ledger
+            and the SLO verdict — aggregated from worker heartbeats,
+            with the in-process registry as the embedded/LocalRunner
+            fallback.  Empty quantiles unless a worker runs with
+            sampling armed (ARROYO_LATENCY_SAMPLE_N>0)."""
+            jid = req.params["jid"]
+            data = self.controller.job_latency(jid)
+            source = "heartbeat"
+            if data is None or (not data["sinks"]
+                                and not data["watermark_age_ms"]):
+                # embedded/LocalRunner fallback: shape the in-process
+                # registry summary the same way
+                from ..obs.latency import Slo, SloEvaluator
+                from ..obs.metrics import job_operator_summary
+
+                rows = self.controller.rollup_from_summary(
+                    job_operator_summary(jid))
+                local = self.controller.latency_shape(rows)
+                if local["sinks"] or local["watermark_age_ms"] \
+                        or data is None:
+                    if (not local["sinks"]
+                            and jid not in self.controller.jobs):
+                        raise HttpError(404, "no such job")
+                    job = self.controller.jobs.get(jid)
+                    local["slo"] = (job.slo_eval.to_json() if job is not None
+                                    else SloEvaluator(
+                                        jid, Slo.from_config()).to_json())
+                    data, source = local, "local_registry"
+            data["source"] = source
+            return data
+
+        @r.get("/v1/jobs/{jid}/slo")
+        async def slo_status(req: Request):
+            """The job's declared latency SLO plus the evaluator's
+            verdict: burn rate, violation counters and the recent
+            violation events (decision-ledger style)."""
+            jid = req.params["jid"]
+            job = self.controller.jobs.get(jid)
+            if job is None:
+                raise HttpError(404, "no such job")
+            return job.slo_eval.to_json()
+
+        @r.put("/v1/jobs/{jid}/slo")
+        async def slo_update(req: Request):
+            """Replace the job's latency SLO live
+            ({"p99_ms": float, "staleness_ms": float,
+            "burn_window_secs": float} — 0 unsets a dimension).  The
+            whole body validates before any side effect."""
+            from ..obs.latency import Slo
+
+            jid = req.params["jid"]
+            job = self.controller.jobs.get(jid)
+            if job is None:
+                raise HttpError(404, "no such job")
+            body = req.json()
+            if not isinstance(body, dict):
+                raise HttpError(422, "body must be an object")
+            cur = job.slo
+            vals = {}
+            for key, default in (("p99_ms", cur.p99_ms),
+                                 ("staleness_ms", cur.staleness_ms),
+                                 ("burn_window_secs",
+                                  cur.burn_window_secs)):
+                v = body.get(key, default)
+                if not isinstance(v, (int, float)) or v < 0:
+                    raise HttpError(422,
+                                    f"'{key}' must be a number >= 0")
+                vals[key] = float(v)
+            unknown = set(body) - {"p99_ms", "staleness_ms",
+                                   "burn_window_secs"}
+            if unknown:
+                raise HttpError(422, f"unknown keys: {sorted(unknown)}")
+            if vals["burn_window_secs"] == 0:
+                vals["burn_window_secs"] = 60.0
+            job.set_slo(Slo(**vals))
+            return job.slo_eval.to_json()
+
         @r.get("/v1/pipelines/{pid}/jobs/{jid}/errors")
         async def job_errors(req: Request):
             rows = self.db.execute(

@@ -166,6 +166,11 @@ class EvalInput:
     # present): rescale_job would silently clamp past these, so a
     # recommendation beyond them is a disruptive full-job no-op
     hard_max: Dict[str, int] = field(default_factory=dict)
+    # latency SLO burn rate (obs/latency.py SloEvaluator, 0..1): the
+    # fraction of recent evaluations out of budget.  Folded into sink
+    # pressure so a latency-violating pipeline scales up even when
+    # throughput signals (backpressure, watermark lag) look calm.
+    slo_burn: float = 0.0
 
 
 @dataclass
@@ -242,12 +247,21 @@ class BacklogDrainPolicy:
             lag = self._lag_of(roll)
             score = self._lag_score(lag)
             rising = lag >= self._prev_lag.get(op, 0.0) - 0.5
+            # SLO burn lands as pressure on the operators that REPORT
+            # e2e latency (the sinks): the end of the critical path is
+            # where the whole chain's latency debt is observable, and
+            # pressuring it walks the scale-up back through its
+            # upstreams on later ticks if the sink wasn't the cause
+            slo_score = (min(max(inp.slo_burn, 0.0), 1.0)
+                         if "e2e_latency.p99_ms" in roll else 0.0)
             out[op] = {
                 "pressure": (0.0 if starving
-                             else max(bp_in, score if rising else 0.0)),
+                             else max(bp_in, slo_score,
+                                      score if rising else 0.0)),
                 # full (trend-free) pressure gates scale-down: a falling
-                # but still-large lag must keep the operator hot
-                "calm_pressure": max(bp_in, score),
+                # but still-large lag must keep the operator hot — and a
+                # burning SLO blocks scale-down outright
+                "calm_pressure": max(bp_in, score, slo_score),
                 # absent from the rollup != calm: a heartbeat-dead
                 # worker's hot operator simply vanishes from job_rollup
                 # while livelier siblings keep the rollup fresh —

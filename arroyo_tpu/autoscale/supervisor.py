@@ -144,7 +144,14 @@ class JobAutoscaler:
             # them would checkpoint-stop the whole job for a no-op
             hard_max={n.operator_id: n.max_parallelism
                       for n in job.program.nodes()
-                      if n.max_parallelism is not None}))
+                      if n.max_parallelism is not None},
+            # latency signal (obs/latency.py): the controller's SLO
+            # evaluator keeps the burn-rate ring fresh every supervise
+            # tick — 0.0 when no SLO is configured (or on job doubles
+            # built without one)
+            slo_burn=(job.slo_eval.current_burn_rate
+                      if getattr(job, "slo_eval", None) is not None
+                      else 0.0)))
         self.ledger.append(decision)
         m.autoscaler_counter(m.AUTOSCALER_DECISIONS, self.job_id,
                              decision.action).inc()

@@ -127,6 +127,24 @@ class Config:
         default_factory=lambda: _env_float("AUTOSCALE_INTERVAL_SECS", 15.0)
     )
 
+    # End-to-end latency observatory (obs/latency.py): deterministic
+    # 1-in-N record-level sampling at sources (0 = observatory off), and
+    # the per-pipeline declarative SLO the controller evaluates against
+    # rollup quantiles (0 = that SLO dimension unset).  REST can override
+    # the SLO per job after start.
+    latency_sample_n: int = field(
+        default_factory=lambda: _env_int("ARROYO_LATENCY_SAMPLE_N", 0)
+    )
+    slo_p99_ms: float = field(
+        default_factory=lambda: _env_float("ARROYO_SLO_P99_MS", 0.0)
+    )
+    slo_staleness_ms: float = field(
+        default_factory=lambda: _env_float("ARROYO_SLO_STALENESS_MS", 0.0)
+    )
+    slo_burn_window_secs: float = field(
+        default_factory=lambda: _env_float("ARROYO_SLO_BURN_WINDOW_SECS", 60.0)
+    )
+
     # Telemetry
     disable_telemetry: bool = field(
         default_factory=lambda: _env_bool("DISABLE_TELEMETRY", True)

@@ -65,6 +65,7 @@ class ImpulseSource(SourceOperator):
                                  else now_micros()))
 
         runner = getattr(ctx, "_runner", None)
+        from ..obs import latency as _latency
         from ..obs import profiler
 
         prof = profiler.active()
@@ -83,6 +84,7 @@ class ImpulseSource(SourceOperator):
             })
             if frame is not None:
                 prof.end(frame)
+            _latency.maybe_stamp(ctx.task_info.operator_id, batch)
             await ctx.collect(batch)
             self.counter += n
             state.insert(ctx.task_info.task_index,

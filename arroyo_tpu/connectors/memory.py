@@ -44,7 +44,9 @@ class MemorySource(SourceOperator):
         if ctx.task_info.task_index != 0:
             return SourceFinishType.FINAL  # single-reader source
         runner = getattr(ctx, "_runner", None)
+        from ..obs import latency as _latency
         for b in self.batches:
+            _latency.maybe_stamp(ctx.task_info.operator_id, b)
             await ctx.collect(b)
             if runner is not None:
                 cm = await runner.poll_source_control()

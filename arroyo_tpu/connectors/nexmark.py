@@ -459,6 +459,7 @@ class NexmarkSource(SourceOperator):
                     "with the current batch size")
         runner = getattr(ctx, "_runner", None)
         wall_base = _time.monotonic() - (gen.inter_event_delay * count) / 1e6
+        from ..obs import latency as _latency
         from ..obs import perf, profiler
 
         prof = profiler.active()
@@ -504,6 +505,7 @@ class NexmarkSource(SourceOperator):
             batch, nums, count_after, rng_snap = await fut
             fut = (loop.run_in_executor(None, gen_next)
                    if gen.has_next else None)
+            _latency.maybe_stamp(ctx.task_info.operator_id, batch)
             await ctx.collect(batch)
             if self.cfg.rate_limited and len(batch):
                 mx = int(np.max(batch.timestamp))

@@ -85,6 +85,7 @@ class SingleFileSource(SourceOperator):
         # while it loads — read it off the event loop
         lines = await asyncio.get_event_loop().run_in_executor(
             None, _read_lines)
+        from ..obs import latency as _latency
         from ..obs import profiler
 
         prof = profiler.active()
@@ -109,6 +110,7 @@ class SingleFileSource(SourceOperator):
             if frame is not None:
                 prof.end(frame)
             if batch is not None:
+                _latency.maybe_stamp(ctx.task_info.operator_id, batch)
                 await ctx.collect(batch)
             i += len(chunk)
             state.insert("lines_read", i)

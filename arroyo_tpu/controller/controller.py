@@ -90,6 +90,18 @@ class Job:
         # pipelines.rs ttl_micros); persisted so a restarted controller
         # still reaps resumed previews
         self.ttl_deadline: Optional[float] = None
+        # latency SLO (obs/latency.py): seeded from config env, REST PUT
+        # can replace it live; the evaluator keeps the burn-rate ring
+        from ..obs.latency import Slo, SloEvaluator
+
+        self.slo = Slo.from_config()
+        self.slo_eval = SloEvaluator(job_id, self.slo)
+
+    def set_slo(self, slo) -> None:
+        """Replace the job's SLO live (REST PUT): the evaluator keeps
+        its sample/event history — only the targets change."""
+        self.slo = slo
+        self.slo_eval.slo = slo
 
     @property
     def slots_needed(self) -> int:
@@ -228,11 +240,27 @@ class ControllerServer:
         for job in self.jobs.values():
             if job.supervisor:
                 job.supervisor.cancel()
+            await self._close_worker_clients(job)
         for scaler in self.autoscalers.values():
             scaler.stop()
         await self.rpc.stop()
         if self.store is not None:
             self.store.close()
+
+    @staticmethod
+    async def _close_worker_clients(job: "Job") -> None:
+        """Close per-worker grpc channels before dropping WorkerInfo refs.
+        An unclosed aio channel's completion-queue dealloc joins its poller
+        thread from whatever thread GC happens to run on — after the owning
+        event loop is gone that join can block forever, so the channel must
+        be closed while the loop is still alive."""
+        for w in list(job.workers.values()):
+            if w.client is not None:
+                try:
+                    await w.client.close()
+                except Exception:
+                    pass
+                w.client = None
 
     def _attach_autoscaler(self, job_id: str) -> None:
         """One JobAutoscaler per accepted job (ledger + REST surface);
@@ -522,6 +550,7 @@ class ControllerServer:
         """JobController::progress (job_controller/mod.rs:460-584)."""
         cfg = config()
         last_ckpt = time.monotonic()
+        last_slo = 0.0
         while True:
             await asyncio.sleep(0.1)
             state = job.fsm.state
@@ -571,6 +600,19 @@ class ControllerServer:
                     await self._recover(
                         job, f"worker {w.worker_id} heartbeat timeout")
                     break
+            # SLO burn evaluation (obs/latency.py): judge the rollup's
+            # headline p99/staleness against the job's declared targets
+            # about once a second — violations land in the evaluator's
+            # event ring + metrics, and the burn rate feeds the
+            # autoscaler's latency signal
+            if job.slo.configured() and now - last_slo >= 1.0:
+                last_slo = now
+                try:
+                    lat = self.latency_shape(self.job_rollup(job.job_id))
+                    job.slo_eval.evaluate(lat["p99_ms"], lat["staleness_ms"])
+                except Exception:
+                    logger.warning("slo evaluation for %s failed",
+                                   job.job_id, exc_info=True)
             # periodic checkpoints
             if now - last_ckpt >= cfg.checkpoint_interval_secs:
                 last_ckpt = now
@@ -593,6 +635,7 @@ class ControllerServer:
         """Shared stop -> clear -> Scheduling -> start -> schedule -> Running
         tail of recovery and rescale (single source for slot sizing)."""
         await self.scheduler.stop_workers(job.job_id, force=force_stop)
+        await self._close_worker_clients(job)
         job.workers.clear()
         job.finished_tasks.clear()
         job.trackers.clear()
@@ -739,9 +782,15 @@ class ControllerServer:
             # lag quantile gauges take the worst worker — one stalled
             # loop is the signal, averaging would hide it
             if k.startswith(("phase_seconds.", "wait_seconds.")) \
-                    or k.startswith("event_loop_stalls"):
+                    or k.startswith("event_loop_stalls") \
+                    or k.startswith(("critical_path.", "device_bytes.")) \
+                    or k == "e2e_latency.count":
                 agg[k] = agg.get(k, 0.0) + v
-            elif k.startswith("event_loop_lag"):
+            elif k.startswith("event_loop_lag") \
+                    or k.startswith("e2e_latency.") \
+                    or k in ("wm_age_ms", "latency_sample_n"):
+                # latency quantiles / watermark ages: the worst worker
+                # is the signal, summing would fabricate latencies
                 agg[k] = max(agg.get(k, 0.0), v)
         # per-subtask queue pairs → worst-subtask backpressure (same
         # rationale as the lag families below: the summed gauges dilute
@@ -913,6 +962,69 @@ class ControllerServer:
             o["job_share"] = (round(o["host_seconds"] / total, 4)
                               if total > 0 else 0.0)
         return {"operators": ops, "worker": worker}
+
+    @staticmethod
+    def latency_shape(rows: List[Dict[str, Any]]) -> Dict[str, Any]:
+        """Reshape job-rollup rows into the latency view (REST
+        ``/v1/jobs/{id}/latency`` and the console latency panel):
+        per-sink e2e quantiles, per-operator watermark ages, the
+        worker-level critical-path stage decomposition and the
+        device-memory ledger, plus the headline p99/staleness the SLO
+        evaluator judges."""
+        sinks: Dict[str, Dict[str, float]] = {}
+        wm_ages: Dict[str, float] = {}
+        critical: Dict[str, float] = {}
+        device: Dict[str, int] = {}
+        sample_n = 0
+        for row in rows:
+            op = row.get("operator_id", "")
+            if op == "__worker__":
+                for k, v in row.items():
+                    if k.startswith("critical_path."):
+                        critical[k[len("critical_path."):]] = round(v, 6)
+                    elif k.startswith("device_bytes."):
+                        device[k[len("device_bytes."):]] = int(v)
+                sample_n = int(row.get("latency_sample_n", 0))
+            if "e2e_latency.p99_ms" in row:
+                sinks[op] = {
+                    "p50_ms": round(row.get("e2e_latency.p50_ms", 0.0), 3),
+                    "p99_ms": round(row.get("e2e_latency.p99_ms", 0.0), 3),
+                    "last_ms": round(row.get("e2e_latency.last_ms", 0.0), 3),
+                    "count": int(row.get("e2e_latency.count", 0)),
+                }
+            if "wm_age_ms" in row:
+                wm_ages[op] = round(row["wm_age_ms"], 3)
+        total = sum(critical.values())
+        dominant = (max(critical, key=critical.__getitem__)
+                    if critical else None)
+        p99 = max((q["p99_ms"] for q in sinks.values()), default=None)
+        stale = max(wm_ages.values(), default=None)
+        return {
+            "sample_n": sample_n,
+            "sinks": sinks,
+            "watermark_age_ms": wm_ages,
+            "critical_path": {
+                "stages": critical,
+                "total_secs": round(total, 6),
+                "dominant": dominant,
+                "dominant_share": (round(critical[dominant] / total, 4)
+                                   if dominant and total > 0 else 0.0),
+            },
+            "device_state_bytes": device,
+            "p99_ms": p99,
+            "staleness_ms": stale,
+        }
+
+    def job_latency(self, job_id: str) -> Optional[Dict[str, Any]]:
+        """Latency view + SLO verdict for one controller-owned job
+        (None when the job is unknown — REST falls back to the local
+        in-process registry there)."""
+        job = self.jobs.get(job_id)
+        if job is None:
+            return None
+        out = self.latency_shape(self.job_rollup(job_id))
+        out["slo"] = job.slo_eval.to_json()
+        return out
 
     def job_profile_rollup(self, job_id: str) -> Dict[str, Any]:
         """Phase-profile view of one job's heartbeat rollups (empty

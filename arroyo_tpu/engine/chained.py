@@ -268,6 +268,13 @@ class ChainedOperator(Operator):
         self._steps: List[Tuple[Operator, List[int], int]] = []
         self._step_by_start: Dict[int, Tuple[Operator, List[int], int]] = {}
         self._lat_stack: List[float] = []  # child-inclusive seconds
+        # latency observatory: when this chain ends the dataflow (tail
+        # Collector has no outgoing edges), the feed into the tail
+        # member is the sink boundary — observing there (not at chain
+        # input) means a window fire inside the chain is measured at
+        # its actual emission, watermark hold included
+        self._lat: Optional[Any] = None
+        self._lat_tail_start: Optional[int] = None
 
     # -- wiring ------------------------------------------------------------
 
@@ -283,6 +290,12 @@ class ChainedOperator(Operator):
         self._accs = [perf.KernelAccumulator(ti, c.metrics)
                       for ti, c in zip(self.infos, ctxs)]
         self._build_steps()
+        from ..obs import latency as _latency
+
+        self._lat = _latency.active()
+        if (self._lat is not None
+                and not self.tail_ctx.collector.edge_groups):
+            self._lat_tail_start = self._steps[-1][1][0]
 
     def _build_steps(self) -> None:
         from ..ops.expr import _host_eval_device
@@ -361,6 +374,12 @@ class ChainedOperator(Operator):
 
     async def _feed(self, start: int, batch: Batch, side: int = 0) -> None:
         step_op, idxs, ectx_idx = self._step_by_start[start]
+        if (self._lat_tail_start is not None
+                and start == self._lat_tail_start
+                and batch.lat_stamp is not None):
+            # sink boundary of a terminal chain: one emit-minus-ingest
+            # observation per sampled batch reaching the tail member
+            self._lat.observe_sink(self.infos[-1], batch.lat_stamp)
         if self.sanitizer is not None and start > 0:
             # interior chain edges keep the same per-edge schema
             # stability contract as real queues (the head edge is

@@ -245,4 +245,16 @@ class SourceBatcher:
             finally:
                 self.prof.end(frame)
         if batch is not None and len(batch):
+            from ..obs import latency as _latency
+
+            lat = _latency.active()
+            if lat is not None:
+                # latency sampling at the source boundary: stamp the
+                # batch carrying the next 1-in-N sampled record with its
+                # ingest wall-clock (side-channel annotation — the
+                # schema signature above never sees it)
+                stamp = lat.source_stamp(self.prof_op or "source",
+                                         len(batch))
+                if stamp is not None:
+                    batch.lat_stamp = stamp
             await self.ctx.collect(batch)

@@ -339,10 +339,23 @@ class Context:
         self.control_tx = control_tx  # ControlResp -> worker control thread
         self.last_watermark: Optional[int] = restore_watermark
         self.n_inputs = n_inputs
+        # latency observatory: None unless armed at engine build — the
+        # emission/watermark hooks are then one `is not None` test
+        from ..obs import latency as _latency
+
+        self.lat = _latency.active()
 
     # -- emission ----------------------------------------------------------
 
     async def collect(self, batch: Batch) -> None:
+        if self.lat is not None and batch.lat_stamp is None:
+            # re-attach the current input batch's stamp to operator-built
+            # batches (maps/filters/chain tails rebuild Batch objects
+            # without the side-channel annotation); window fires carry
+            # their own inherited stamp and skip this
+            from ..obs import latency as _latency
+
+            batch.lat_stamp = _latency.current()
         await self.collector.collect(batch)
 
     async def broadcast(self, msg: Message) -> None:
@@ -363,6 +376,13 @@ class Context:
             return None
         if self.last_watermark is None or combined > self.last_watermark:
             self.last_watermark = combined
+            if self.lat is not None:
+                # watermark lineage: the age of the watermark this
+                # operator just advanced to — a consumer whose age keeps
+                # growing relative to its producers is downstream of the
+                # held stage
+                self.lat.note_edge_watermark(
+                    self.task_info.operator_id, combined)
             return combined
         return None
 

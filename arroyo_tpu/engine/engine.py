@@ -160,6 +160,13 @@ class Engine:
         from ..obs import profiler as _profiler
 
         prof = _profiler.ensure_armed(self.job_id)
+        # latency observatory (obs/latency.py): armed by
+        # ARROYO_LATENCY_SAMPLE_N>0 or an explicit latency.arm() — same
+        # before-subtask-construction + None-when-disarmed contract as
+        # the profiler
+        from ..obs import latency as _latency
+
+        _latency.ensure_armed(self.job_id)
         g = self.program.graph
         # operator chaining (graph/chaining.py): maximal linear runs of
         # same-parallelism forward-edge operators execute inside ONE

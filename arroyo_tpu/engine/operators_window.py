@@ -32,6 +32,7 @@ from __future__ import annotations
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import asyncio
+import time as _time
 
 import numpy as np
 
@@ -68,6 +69,37 @@ def _window_params(typ) -> Tuple[int, int]:
     if isinstance(typ, InstantWindow):
         return 1, 1
     raise TypeError(f"not a uniform window: {typ}")
+
+
+def _lat_track(pending: Optional[Tuple[int, float]], batch: Batch
+               ) -> Optional[Tuple[int, float]]:
+    """Latency-observatory pane inheritance, input side: fold one
+    incoming batch's ingest stamp into the operator's pending
+    ``(max_stamp, arrival_monotonic)``.  A fired pane inherits the MAX
+    contributing stamp (the newest sampled record still waiting — the
+    conservative bound on how fresh the pane's output can claim to be)."""
+    if batch.lat_stamp is None:
+        return pending
+    stamp = (batch.lat_stamp if pending is None
+             else max(pending[0], batch.lat_stamp))
+    return (stamp, _time.monotonic())
+
+
+def _lat_consume(pending: Optional[Tuple[int, float]]) -> Optional[int]:
+    """Latency-observatory pane inheritance, fire side: consume the
+    pending max-stamp.  Returns the stamp to attach to the fired batch
+    and charges the ``watermark_hold`` critical-path stage with how
+    long the sample sat in pane state waiting for the watermark."""
+    if pending is None:
+        return None
+    from ..obs import latency as _latency
+
+    lat = _latency.active()
+    stamp, arrival = pending
+    if lat is not None:
+        lat.note_stage("watermark_hold",
+                       max(_time.monotonic() - arrival, 0.0))
+    return stamp
 
 
 def _first_occurrence_cols(batch: Batch, uniq_keys: np.ndarray
@@ -155,6 +187,10 @@ class BinAggOperator(Operator):
         self.top_n = top_n  # (partition_cols, sort_column, max_elements)
         self._key_cols: Tuple[str, ...] = ()
         self._offload: Optional[bool] = None  # decided at first batch
+        # latency-observatory pane inheritance: (max contributing ingest
+        # stamp, monotonic arrival) pending until the next pane fire
+        self._lat_pending: Optional[Tuple[int, float]] = None
+        self._ledger_updates = 0  # throttles the pane_state_registry note
 
     def _offload_transfers(self) -> bool:
         """Run device update/emit in an executor thread on accelerators:
@@ -188,9 +224,19 @@ class BinAggOperator(Operator):
             self.state.set_route_shift(route_shift_for(par))
 
         def snap():
-            return self.state.snapshot() | self.keyvals.snapshot()
+            out = self.state.snapshot() | self.keyvals.snapshot()
+            if self._lat_pending is not None:
+                # pending pane stamp survives checkpoint/restore so a
+                # sampled record held in pane state at barrier time is
+                # still measured after recovery (restart cost included)
+                out["__lat_stamp"] = np.array([self._lat_pending[0]],
+                                              np.int64)
+            return out
 
         def restore(arrays, _kr=ctx.task_info.key_range):
+            st = arrays.pop("__lat_stamp", None)
+            if st is not None:
+                self._lat_pending = (int(st[0]), _time.monotonic())
             # rescale re-partitioning: keep only the keys this subtask owns
             arrays = filter_canonical_snapshot(arrays, _kr)
             self.state.restore(arrays)
@@ -203,6 +249,7 @@ class BinAggOperator(Operator):
 
     async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
         assert batch.key_hash is not None, f"{self.name} requires keyed input"
+        self._lat_pending = _lat_track(self._lat_pending, batch)
         self._key_cols = batch.key_cols
         prev = self.state.next_slot
         slots = self.state._lookup_or_insert(batch.key_hash)
@@ -217,6 +264,18 @@ class BinAggOperator(Operator):
                 batch.key_hash, batch.timestamp, batch.columns)
         else:
             self.state.update(batch.key_hash, batch.timestamp, batch.columns)
+        self._ledger_updates += 1
+        if self._ledger_updates % 16 == 1 and hasattr(self.state,
+                                                      "device_bytes"):
+            # throttled device-memory ledger note (join_state_registry
+            # idiom): one entry per operator instance, metadata-only
+            from ..obs import perf
+
+            reg = perf.get_note("pane_state_registry")
+            if not isinstance(reg, dict):
+                reg = {}
+                perf.note("pane_state_registry", reg)
+            reg[self.name] = self.state.device_bytes()
 
     async def handle_watermark(self, watermark: int, ctx: Context) -> None:
         from ..obs import tracing
@@ -254,7 +313,9 @@ class BinAggOperator(Operator):
         cols.update(out_cols)
         ts = window_end - 1  # emit at w.end - 1ns analog (windows.rs:95)
         key_cols = self._key_cols or tuple(self.keyvals.cols)
-        out = Batch(ts, cols, keys.astype(np.uint64), key_cols)
+        out = Batch(ts, cols, keys.astype(np.uint64), key_cols,
+                    lat_stamp=_lat_consume(self._lat_pending))
+        self._lat_pending = None
 
         if self.top_n is not None:
             out = _apply_top_n(out, *self.top_n)
@@ -376,7 +437,8 @@ def _apply_top_n(batch: Batch, partition_cols: Tuple[str, ...],
     ranks[order] = np.arange(len(order)) - seg_start[seg_id] + 1
     cols = dict(batch.columns)
     cols[rank_column] = ranks
-    return Batch(batch.timestamp, cols, batch.key_hash, batch.key_cols)
+    return Batch(batch.timestamp, cols, batch.key_hash, batch.key_cols,
+                 lat_stamp=batch.lat_stamp)
 
 
 class WindowOperator(Operator):
@@ -399,9 +461,11 @@ class WindowOperator(Operator):
 
     async def on_start(self, ctx: Context) -> None:
         self.buffer = ctx.state.get_batch_buffer("w")
+        self._lat_pending: Optional[Tuple[int, float]] = None
 
     async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
         assert batch.key_hash is not None
+        self._lat_pending = _lat_track(self._lat_pending, batch)
         self.buffer.append(batch)
         # one timer per distinct window end (not per key): rows at ts belong
         # to windows ending at slide-aligned points in (ts, ts+width]
@@ -436,6 +500,8 @@ class WindowOperator(Operator):
                 cols.update(agg_cols)
                 out = Batch(np.full(len(uniq), end - 1, np.int64), cols,
                             uniq.astype(np.uint64), rows.key_cols)
+            out.lat_stamp = _lat_consume(self._lat_pending)
+            self._lat_pending = None
             if self.projection is not None:
                 out = eval_record_expr(self.projection, out)
             await ctx.collect(out)
@@ -467,6 +533,7 @@ class SessionWindowOperator(Operator):
     async def on_start(self, ctx: Context) -> None:
         self.buffer = ctx.state.get_batch_buffer("s")
         self.windows = ctx.state.get_keyed_state("v")
+        self._lat_pending: Optional[Tuple[int, float]] = None
 
     def _merge_key(self, kh: int, times: np.ndarray, ctx: Context) -> None:
         """handle_event extend/merge/create (windows.rs:232-302)."""
@@ -501,6 +568,7 @@ class SessionWindowOperator(Operator):
 
     async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
         assert batch.key_hash is not None
+        self._lat_pending = _lat_track(self._lat_pending, batch)
         self.buffer.append(batch)
         # collapse events -> candidate session intervals for the WHOLE
         # batch in three vector ops (events within gap of their
@@ -675,6 +743,8 @@ class SessionWindowOperator(Operator):
             cols["window_end"] = seg_e_a[ui]
             cols.update(agg_cols)
             out = Batch(seg_e_a[ui] - 1, cols, seg_kh_a[ui], sub.key_cols)
+        out.lat_stamp = _lat_consume(self._lat_pending)
+        self._lat_pending = None
         if self.projection is not None:
             out = eval_record_expr(self.projection, out)
         await ctx.collect(out)
@@ -910,6 +980,7 @@ class WindowJoinOperator(Operator):
         self.right = ctx.state.get_join_buffer("r")
         self._partitioned = isinstance(self.left, PartitionedJoinBuffer) \
             and isinstance(self.right, PartitionedJoinBuffer)
+        self._lat_pending: Optional[Tuple[int, float]] = None
 
     def _drop_never_emitting(self, batch: Batch,
                              side: int) -> Optional[Batch]:
@@ -925,6 +996,7 @@ class WindowJoinOperator(Operator):
 
     async def process_batch(self, batch: Batch, ctx: Context, side: int = 0) -> None:
         assert batch.key_hash is not None, "window join requires keyed inputs"
+        self._lat_pending = _lat_track(self._lat_pending, batch)
         self._tmpl[side].observe(batch)
         buffered = self._drop_never_emitting(batch, side)
         if buffered is not None and len(buffered):
@@ -975,6 +1047,8 @@ class WindowJoinOperator(Operator):
                     l_rows, r_rows, l_un, r_un, end, how, key_cols,
                     tmpl=(self._tmpl[0], self._tmpl[1]))
                 if len(out):
+                    out.lat_stamp = _lat_consume(self._lat_pending)
+                    self._lat_pending = None
                     await ctx.collect(out)
         else:
             l = self.left.query_range(start, end)
@@ -992,6 +1066,8 @@ class WindowJoinOperator(Operator):
                 out = join_batches(l, r, end, how=how,
                                    tmpl=(self._tmpl[0], self._tmpl[1]))
                 if len(out):
+                    out.lat_stamp = _lat_consume(self._lat_pending)
+                    self._lat_pending = None
                     await ctx.collect(out)
         evict_to = end - self.width + self.slide
         self.left.evict_before(evict_to)
@@ -1322,6 +1398,7 @@ class JoinWithExpirationOperator(Operator):
         self.right = ctx.state.get_join_buffer("r")
         self._partitioned = isinstance(self.left, PartitionedJoinBuffer) \
             and isinstance(self.right, PartitionedJoinBuffer)
+        self._lat_pending: Optional[Tuple[int, float]] = None
 
     def _orient(self, mine_rows: Batch, opp_cols: Dict[str, np.ndarray],
                 side: int, end: int, op: Optional[int],

@@ -99,6 +99,18 @@ class TaskRunner:
         # phase profiler (obs/profiler.py): None unless armed at engine
         # build — every hook site guards on a local `is not None`
         self._prof = profiler.active()
+        # latency observatory (obs/latency.py): same None-when-disarmed
+        # contract.  A terminal task (no outgoing edges) is where sampled
+        # stamps are turned into emit-minus-ingest observations; chained
+        # terminal tasks observe at the chain-tail feed instead so a
+        # window fire inside the chain is measured at its actual
+        # emission, not at pane input (engine/chained.py).
+        from ..obs import latency as _latency
+
+        self._lat = _latency.active()
+        self._lat_terminal = (self._lat is not None
+                              and not self.out_ctx.collector.edge_groups
+                              and not operator.own_batch_metrics)
         self.pumps: List[_Pump] = []
         self.finished = asyncio.Event()
         self.failed: Optional[BaseException] = None
@@ -390,6 +402,25 @@ class TaskRunner:
         operator with the task-level flight-recorder observations —
         unless the operator attributes per-member metrics itself
         (ChainedOperator)."""
+        lat = self._lat
+        if lat is not None:
+            from ..obs import latency as _latency
+
+            if self._lat_terminal and batch.lat_stamp is not None:
+                # sink boundary: a sampled stamp becomes one
+                # emit-minus-ingest observation
+                lat.observe_sink(self.task_info, batch.lat_stamp)
+            # park the input stamp for the duration of process_batch so
+            # Context.collect re-attaches it to operator-built batches
+            # (chain tails included — each member's Context reads it)
+            _latency.set_current(batch.lat_stamp)
+        try:
+            await self._process_record_inner(batch, side)
+        finally:
+            if lat is not None:
+                _latency.set_current(None)
+
+    async def _process_record_inner(self, batch, side: int) -> None:
         metrics = self.ctx.metrics
         if metrics is None or self.operator.own_batch_metrics:
             # a ChainedOperator opens its own per-member `proc` phases
@@ -470,9 +501,15 @@ class TaskRunner:
         tid = self.task_info.task_id
         align_start = self._align_start.pop(barrier.epoch, None)
         if align_start is not None:
+            align_us = tracing.now_us() - align_start
             tracing.record_span("barrier.align", "checkpoint", align_start,
-                                tracing.now_us() - align_start, tid=tid,
+                                align_us, tid=tid,
                                 args={"epoch": barrier.epoch})
+            if self._lat is not None:
+                # critical path: records queued behind this alignment
+                # waited exactly this long (the profiler has no phase
+                # for it — pumps park outside any frame)
+                self._lat.note_stage("barrier_align", align_us / 1e6)
         await self._report_event(barrier, CheckpointEventType.STARTED_CHECKPOINTING)
         # snapshot state (per member for chained operators — the
         # controller's epoch tracker expects one completion per logical

@@ -55,6 +55,12 @@ KIND_CONTROL = 1
 # Frames on one TCP connection arrive in order, so the receiver's cached
 # per-edge schema is always the one this batch was encoded under.
 KIND_DATA_BATCH = 2
+# latency-observatory stamp flag on the u16 frame kind: when set, an
+# 8-byte little-endian ingest stamp (micros) rides between the frame
+# header and the Arrow payload.  A side-channel prefix — NOT schema
+# metadata — so a sampled batch never flips the per-edge schema cache
+# and the KIND_DATA_BATCH continuation fast path is undisturbed.
+KIND_STAMP_FLAG = 0x100
 
 Quad = Tuple[str, int, str, int]
 
@@ -160,23 +166,29 @@ def decode_message(kind: int, data: bytes) -> Message:
 
 
 def _write_frame(writer: asyncio.StreamWriter, quad: Quad, kind: int,
-                 payload) -> None:
+                 payload, stamp: Optional[int] = None) -> None:
     """``payload`` may be any bytes-like (bytes, memoryview over an
     Arrow buffer): header and payload go out as two writes so a large
     batch payload is never copied into a concatenated frame — the
     transport buffer is the only copy between Arrow memory and the
-    socket."""
+    socket.  ``stamp`` (latency-observatory ingest micros) sets the
+    KIND_STAMP_FLAG bit and rides as 8 extra bytes between header and
+    payload — outside ``plen`` and outside the Arrow stream."""
     src_op, src_idx, dst_op, dst_idx = quad
     so, do = src_op.encode(), dst_op.encode()
+    if stamp is not None:
+        kind |= KIND_STAMP_FLAG
     header = struct.pack(
         f"<IHI{len(so)}sII{len(do)}sIQ",
         MAGIC, kind, len(so), so, src_idx, len(do), do, dst_idx, len(payload))
     writer.write(header)
+    if stamp is not None:
+        writer.write(struct.pack("<q", stamp))
     writer.write(payload)
 
 
 async def _read_frame(reader: asyncio.StreamReader
-                      ) -> Optional[Tuple[Quad, int, bytes]]:
+                      ) -> Optional[Tuple[Quad, int, bytes, Optional[int]]]:
     try:
         head = await reader.readexactly(10)
         magic, kind, so_len = struct.unpack("<IHI", head)
@@ -186,8 +198,13 @@ async def _read_frame(reader: asyncio.StreamReader
         src_idx, do_len = struct.unpack("<II", await reader.readexactly(8))
         do = (await reader.readexactly(do_len)).decode()
         dst_idx, plen = struct.unpack("<IQ", await reader.readexactly(12))
+        stamp: Optional[int] = None
+        if kind & KIND_STAMP_FLAG:
+            kind &= ~KIND_STAMP_FLAG
+            stamp = struct.unpack("<q",
+                                  await reader.readexactly(8))[0]
         payload = await reader.readexactly(plen)
-        return (so, src_idx, do, dst_idx), kind, payload
+        return (so, src_idx, do, dst_idx), kind, payload, stamp
     except (asyncio.IncompleteReadError, ConnectionResetError):
         return None
 
@@ -251,20 +268,21 @@ class NetworkManager:
         for msg in self._pending.pop(quad, []):
             queue.put_nowait(msg)
 
-    def _decode_frame(self, quad: Quad, kind: int, payload: bytes) -> Message:
+    def _decode_frame(self, quad: Quad, kind: int, payload: bytes,
+                      stamp: Optional[int] = None) -> Message:
         prof = profiler.active()
         if prof is None:
-            return self._decode_frame_inner(quad, kind, payload)
+            return self._decode_frame_inner(quad, kind, payload, stamp)
         # receive-side Arrow decode: the egress/ingest host cost of a
         # cross-worker edge, charged to the DESTINATION operator
         frame = prof.begin(quad[2], "frame_decode")
         try:
-            return self._decode_frame_inner(quad, kind, payload)
+            return self._decode_frame_inner(quad, kind, payload, stamp)
         finally:
             prof.end(frame)
 
-    def _decode_frame_inner(self, quad: Quad, kind: int,
-                            payload: bytes) -> Message:
+    def _decode_frame_inner(self, quad: Quad, kind: int, payload: bytes,
+                            stamp: Optional[int] = None) -> Message:
         san = self.sanitizer
         if kind == KIND_DATA:
             batch, schema = _decode_batch_full(payload)
@@ -276,6 +294,7 @@ class NetworkManager:
             self._edge_schemas[quad] = schema
             if san is not None:
                 san.on_record(quad, batch)
+            batch.lat_stamp = stamp
             return Message.record(batch)
         if kind == KIND_DATA_BATCH:
             schema = self._edge_schemas.get(quad)
@@ -290,6 +309,7 @@ class NetworkManager:
                 # continuation batches decode against the cached schema:
                 # any layout drift here is wire corruption
                 san.on_record(quad, batch)
+            batch.lat_stamp = stamp
             return Message.record(batch)
         msg = decode_message(kind, payload)
         if san is not None and msg.kind == MessageKind.WATERMARK:
@@ -304,10 +324,10 @@ class NetworkManager:
                     frame = await _read_frame(reader)
                     if frame is None:
                         break
-                    quad, kind, payload = frame
+                    quad, kind, payload, stamp = frame
                     self._bytes_counter(BYTES_RECV, quad[2], quad[3]).inc(
                         len(payload))
-                    msg = self._decode_frame(quad, kind, payload)
+                    msg = self._decode_frame(quad, kind, payload, stamp)
                     q = self.senders.get(quad)
                     if q is None:
                         # receiver engine not built yet: park the frame
@@ -376,7 +396,9 @@ class NetworkManager:
             enc = (prof.begin(quad[0], "frame_encode")
                    if prof is not None else None)
             try:
+                stamp = None
                 if msg.kind == MessageKind.RECORD:
+                    stamp = msg.batch.lat_stamp
                     schema, rb = _arrow_parts(msg.batch)
                     prev = state["schema"]
                     if prev is not None and schema.equals(
@@ -396,7 +418,7 @@ class NetworkManager:
                 # frames never interleave: _write_frame is one
                 # synchronous writer.write call, so no lock is needed
                 # for atomicity
-                _write_frame(writer, quad, kind, payload)
+                _write_frame(writer, quad, kind, payload, stamp)
             finally:
                 # an encode failure must not leak the open frame: an
                 # unclosed frame would absorb every later span on this

@@ -77,6 +77,22 @@ class AdminServer:
                     for ts, kind, task, detail in recent_events(limit)],
             }
 
+        # latency-observatory export (obs/latency.py): per-sink e2e
+        # quantiles, per-edge watermark ages, critical-path stage
+        # decomposition and the device-memory ledger — the "p99 is
+        # high, where is the time?" first stop.  Empty/disabled until
+        # sampling is armed (ARROYO_LATENCY_SAMPLE_N>0 at engine build).
+        @router.get("/latency")
+        async def latency_snapshot(req: Request):
+            from . import latency
+
+            lat = latency.active()
+            if lat is None:
+                return {"enabled": False}
+            snap = lat.snapshot()
+            snap["enabled"] = True
+            return snap
+
         # phase-profiler export (obs/profiler.py): the measured phase
         # table as pprof/flamegraph folded stacks (`job;operator;phase
         # micros` lines — feed to flamegraph.pl / speedscope), or the

@@ -370,6 +370,94 @@ def mesh_carried_gauge(job_id: str) -> Gauge:
     return _mesh_carried.labels(job_id=job_id)
 
 
+# -- latency-observatory instruments (obs/latency.py) ------------------------
+
+SINK_E2E_LATENCY = "arroyo_sink_e2e_latency_seconds"
+SINK_E2E_QUANTILE = "arroyo_sink_e2e_latency_quantile_seconds"
+DEVICE_STATE_BYTES = "arroyo_device_state_bytes"
+SLO_VIOLATIONS = "arroyo_slo_violations_total"
+SLO_BURN_RATE = "arroyo_slo_burn_rate"
+
+# e2e latency spans sub-ms (hot chained path) to tens of seconds (a
+# held watermark on a wide window) — the lag buckets fit
+_BUCKETS[SINK_E2E_LATENCY] = LAG_BUCKETS
+
+_SINK_QUANTILE_LABELS = ("job_id", "operator_id", "operator_name",
+                         "quantile")
+_sink_quantile_gauge: Optional[Gauge] = None
+_device_state_gauge: Optional[Gauge] = None
+_slo_violations: Optional[Counter] = None
+_slo_burn: Optional[Gauge] = None
+
+
+def sink_latency_histogram(task_info) -> Histogram:
+    """Per-sink end-to-end (emit-minus-ingest) latency of sampled
+    records — the measurement behind the ROADMAP-4 SLO."""
+    return histogram_for_task(
+        task_info, SINK_E2E_LATENCY,
+        "sampled record end-to-end latency (sink emit minus source "
+        "ingest wall-clock)")
+
+
+def sink_latency_quantile_gauge(task_info, quantile: str) -> Gauge:
+    """Rolling-window p50/p99 gauges the observatory refreshes per
+    sampled observation (histogram_quantile needs a scraper; these are
+    readable in-process and ride the heartbeat rollup)."""
+    global _sink_quantile_gauge
+    with _lock:
+        if _sink_quantile_gauge is None:
+            _sink_quantile_gauge = Gauge(
+                SINK_E2E_QUANTILE,
+                "rolling-window end-to-end latency quantile per sink",
+                _SINK_QUANTILE_LABELS, registry=REGISTRY)
+    return _sink_quantile_gauge.labels(
+        job_id=task_info.job_id, operator_id=task_info.operator_id,
+        operator_name=getattr(task_info, "operator_name",
+                              task_info.operator_id),
+        quantile=quantile)
+
+
+def device_state_bytes_gauge(job_id: str, table: str) -> Gauge:
+    """Per-job device-resident state bytes by table (join payload
+    rings, keys-only ring slots, pane planes, shuffle stacks…) — the
+    device-memory ledger groundwork for co-scheduled-job accounting
+    (ROADMAP-1)."""
+    global _device_state_gauge
+    with _lock:
+        if _device_state_gauge is None:
+            _device_state_gauge = Gauge(
+                DEVICE_STATE_BYTES,
+                "device-resident state bytes by table",
+                ("job_id", "table"), registry=REGISTRY)
+    return _device_state_gauge.labels(job_id=job_id or "", table=table)
+
+
+def slo_violations_counter(job_id: str) -> Counter:
+    """SLO evaluations that found a dimension out of budget (each one
+    also lands in the controller's violation ledger with the measured
+    vs target numbers)."""
+    global _slo_violations
+    with _lock:
+        if _slo_violations is None:
+            _slo_violations = Counter(
+                SLO_VIOLATIONS, "latency-SLO violation evaluations",
+                ("job_id",), registry=REGISTRY)
+    return _slo_violations.labels(job_id=job_id or "")
+
+
+def slo_burn_rate_gauge(job_id: str) -> Gauge:
+    """Violating fraction of SLO evaluations over the trailing burn
+    window (0 = healthy, 1 = burning the whole budget every tick) —
+    the autoscaler's latency signal."""
+    global _slo_burn
+    with _lock:
+        if _slo_burn is None:
+            _slo_burn = Gauge(
+                SLO_BURN_RATE, "SLO burn rate over the trailing window",
+                ("job_id",), registry=REGISTRY)
+    return _slo_burn.labels(job_id=job_id or "")
+
+
 # -- autoscaler instruments --------------------------------------------------
 
 # controller-side: every policy evaluation lands in decisions (labeled by
@@ -498,4 +586,10 @@ def job_operator_summary(job_id: str) -> Dict[str, Dict[str, float]]:
             out.setdefault(op, {})[f"phase_seconds.{phase}"] = round(secs, 6)
         for (op, phase), secs in prof.wait_snapshot().items():
             out.setdefault(op, {})[f"wait_seconds.{phase}"] = round(secs, 6)
+    # latency-observatory ride-alongs (e2e_latency.*, wm_age_ms,
+    # critical_path.*, device_bytes.*) — same mechanism as the profiler's
+    from . import latency as _latency
+
+    for op, keys in _latency.summary_ride_alongs(job_id).items():
+        out.setdefault(op, {}).update(keys)
     return out

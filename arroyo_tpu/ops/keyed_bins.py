@@ -1319,6 +1319,12 @@ class KeyedBinState:
     # onto any mesh and vice versa (restore-time re-partitioning,
     # parquet.rs:194-218 analog).
 
+    def device_bytes(self) -> int:
+        """Resident device footprint of the bin planes (metadata-only:
+        reads ``.nbytes`` off the array handles, no transfer) — feeds
+        the per-job device-memory ledger (obs/latency.py)."""
+        return int(self.values.nbytes) + int(self.counts.nbytes)
+
     def snapshot(self) -> Dict[str, np.ndarray]:
         self.flush_updates()  # buffered cells belong to this epoch
         n = self.next_slot

@@ -494,6 +494,13 @@ class MeshKeyedBinState:
         self.d_of = put(jnp.zeros((self.nk, 2), jnp.int32),
                         NamedSharding(self.mesh, P("keys", None)))
 
+    def device_bytes(self) -> int:
+        """Resident device footprint of the sharded planes (metadata
+        only — ``.nbytes`` off the handles, no transfer); feeds the
+        per-job device-memory ledger (obs/latency.py)."""
+        return (int(self.d_keys.nbytes) + int(self.d_bins.nbytes)
+                + int(self.d_counts.nbytes) + int(self.d_of.nbytes))
+
     def set_route_shift(self, shift: int) -> None:
         """Skip the top ``shift`` key-hash bits when routing rows to
         shards (host directory AND device route step stay in lockstep).

@@ -372,6 +372,10 @@ class DeviceShuffle:
                 prof.end(frame)
         perf.count(COLLECTIVES)
         perf.count(COLLECTIVE_ROWS, m)
+        # device-memory ledger (obs/latency.py): the staging stacks are
+        # the shuffle's transient device footprint for this batch
+        perf.note("shuffle_stack_bytes",
+                  int(kh_p.nbytes + ok_p.nbytes + fv.nbytes + iv.nbytes))
         from ..obs.metrics import shuffle_collective_counter
 
         shuffle_collective_counter().inc()
@@ -394,6 +398,6 @@ class DeviceShuffle:
                     cols[name] = _from_i64(i_h[idx][idxs], dt)
             sub = Batch(i_h[0][idxs], cols,
                         _from_i64(i_h[1][idxs], np.dtype(np.uint64)),
-                        batch.key_cols)
+                        batch.key_cols, lat_stamp=batch.lat_stamp)
             parts.append((d, sub))
         return parts

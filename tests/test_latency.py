@@ -1,0 +1,540 @@
+"""End-to-end latency observatory (obs/latency.py): deterministic
+1-in-N sampling, stamp survival across chain / coalesce / wire / window
+fire / join / checkpoint-restore (with the sanitizer armed by conftest,
+so any schema-signature flip fails loudly), critical-path attribution,
+SLO burn math, controller rollup + REST round-trip, and the off-path
+discipline (disarmed records nothing)."""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from arroyo_tpu import AggKind, AggSpec, Batch, Stream, TumblingWindow
+from arroyo_tpu.config import reset_config
+from arroyo_tpu.connectors.memory import clear_sink, sink_output
+from arroyo_tpu.engine.engine import LocalRunner
+from arroyo_tpu.obs import latency
+from arroyo_tpu.types import TaskInfo, hash_columns
+
+SEC = 1_000_000
+
+
+@pytest.fixture(autouse=True)
+def _observatory_guard():
+    """Torn down LAST (autouse set up first): after monkeypatch undoes
+    env edits, re-read config so no latency/SLO setting leaks into the
+    rest of the suite."""
+    latency.disarm()
+    reset_config()
+    yield
+    latency.disarm()
+    reset_config()
+
+
+@pytest.fixture
+def sampled(monkeypatch):
+    """Arm sampling at 1-in-1 (every batch stamps) the way a real run
+    does: env -> config -> engine ensure_armed picks it up."""
+    monkeypatch.setenv("ARROYO_LATENCY_SAMPLE_N", "1")
+    reset_config()
+    lat = latency.arm("test-job", 1)
+    yield lat
+    latency.disarm()
+
+
+def _events(rng, n=400, n_keys=8, span=4 * SEC):
+    ts = np.sort(rng.integers(0, span, n)).astype(np.int64)
+    return Batch(ts, {"k": rng.integers(0, n_keys, n).astype(np.int64),
+                      "v": rng.integers(1, 100, n).astype(np.int64)})
+
+
+def run_pipeline(batches, build, sink="out"):
+    clear_sink(sink)
+    prog = build(Stream.source("memory", {"batches": batches})
+                 .watermark(max_lateness_micros=0))
+    LocalRunner(prog).run()
+    return sink_output(sink)
+
+
+# -- deterministic sampling ---------------------------------------------------
+
+
+def test_source_stamp_deterministic_1_in_n():
+    obs = latency.LatencyObservatory("j", sample_n=10)
+    # 25 batches x 4 rows = 100 rows -> exactly 10 crossings of a
+    # multiple of 10, at positions independent of wall clock
+    fired = [obs.source_stamp("s", 4) is not None for _ in range(25)]
+    assert sum(fired) == 10
+    obs2 = latency.LatencyObservatory("j", sample_n=10)
+    assert [obs2.source_stamp("s", 4) is not None
+            for _ in range(25)] == fired
+    # a single batch spanning several multiples still yields one stamp
+    obs3 = latency.LatencyObservatory("j", sample_n=10)
+    assert obs3.source_stamp("s", 35) is not None
+    assert obs3.snapshot()["records_sampled"] == 1
+    # empty batches never sample
+    assert obs3.source_stamp("s", 0) is None
+
+
+def test_maybe_stamp_never_overwrites(sampled):
+    b = Batch(np.array([1], dtype=np.int64),
+              {"v": np.array([7], dtype=np.int64)})
+    b.lat_stamp = 12345
+    latency.maybe_stamp("src", b)
+    assert b.lat_stamp == 12345  # caller's stamp (replays/tests) wins
+    b2 = Batch(np.array([1], dtype=np.int64),
+               {"v": np.array([7], dtype=np.int64)})
+    latency.maybe_stamp("src", b2)
+    assert b2.lat_stamp is not None  # sample_n=1: every batch stamps
+
+
+# -- side-channel schema stability -------------------------------------------
+
+
+def test_stamp_is_schema_invisible(rng):
+    """The stamp is a batch annotation, not a column: the coalescer
+    signature (what arroyosan's schema-stability check keys on) must be
+    identical with and without it."""
+    from arroyo_tpu.engine.coalesce import _signature
+
+    mk = lambda: Batch(np.array([1, 2], dtype=np.int64),
+                       {"k": np.array([3, 4], dtype=np.int64)})
+    plain, stamped = mk(), mk()
+    stamped.lat_stamp = 777
+    assert _signature(plain) == _signature(stamped)
+    assert latency.STAMP_COLUMN not in stamped.columns
+
+
+def test_stamp_transform_and_concat_semantics(rng):
+    keys = rng.integers(0, 5, 16).astype(np.int64)
+    b = Batch(np.arange(16, dtype=np.int64), {"k": keys},
+              hash_columns([keys]), ("k",), lat_stamp=500)
+    assert b.select(np.arange(4)).lat_stamp == 500
+    a = Batch(np.array([1], dtype=np.int64),
+              {"k": np.array([1], dtype=np.int64)}, lat_stamp=900)
+    c = Batch(np.array([2], dtype=np.int64),
+              {"k": np.array([2], dtype=np.int64)})  # unstamped
+    merged = Batch.concat([a, c,
+                           Batch(np.array([3], dtype=np.int64),
+                                 {"k": np.array([3], dtype=np.int64)},
+                                 lat_stamp=200)])
+    # coalescing keeps the OLDEST stamp: linger is charged, never hidden
+    assert merged.lat_stamp == 200
+    assert Batch.concat([c]).lat_stamp is None
+
+
+def test_device_shuffle_threads_stamp(rng, monkeypatch):
+    monkeypatch.setenv("ARROYO_SHUFFLE_DEVICE", "on")
+    from arroyo_tpu.parallel import shuffle as shf
+
+    keys = rng.integers(0, 300, 2000).astype(np.int64)
+    kh = hash_columns([keys])
+    b = Batch(np.sort(rng.integers(0, SEC, 2000)).astype(np.int64),
+              {"k": keys, "v": rng.standard_normal(2000)}, kh, ("k",),
+              lat_stamp=4242)
+    parts = shf.DeviceShuffle(4, op_id="t").route(b)
+    assert parts is not None and len(parts) > 0
+    for _dest, sub in parts:
+        assert sub.lat_stamp == 4242
+
+
+def test_wire_frame_stamp_roundtrip():
+    """The stamp rides as a frame-flag + 8 bytes OUTSIDE the Arrow
+    payload — framing must round-trip it and hand back the unflagged
+    kind, and stampless frames must be byte-identical to before."""
+    from arroyo_tpu.network import data_plane as dp
+
+    class _W:
+        def __init__(self):
+            self.buf = bytearray()
+
+        def write(self, b):
+            self.buf += bytes(b)
+
+    async def roundtrip(stamp):
+        w = _W()
+        dp._write_frame(w, ("src", 0, "dst", 1), dp.KIND_DATA,
+                        b"payload", stamp)
+        r = asyncio.StreamReader()
+        r.feed_data(bytes(w.buf))
+        r.feed_eof()
+        return await dp._read_frame(r), len(w.buf)
+
+    loop = asyncio.new_event_loop()
+    try:
+        (frame, n_stamped) = loop.run_until_complete(roundtrip(123456789))
+        quad, kind, payload, stamp = frame
+        assert quad == ("src", 0, "dst", 1)
+        assert kind == dp.KIND_DATA  # flag stripped
+        assert payload == b"payload" and stamp == 123456789
+        (frame, n_plain) = loop.run_until_complete(roundtrip(None))
+        assert frame[1] == dp.KIND_DATA and frame[3] is None
+        assert n_stamped == n_plain + 8  # stamp is exactly 8 extra bytes
+    finally:
+        loop.close()
+
+
+def test_shardcheck_models_stamp_as_transportable():
+    from arroyo_tpu.analysis import shardcheck
+
+    # the constants are pinned in sync across the two layers
+    assert shardcheck._LAT_STAMP_COLUMN == latency.STAMP_COLUMN
+    # even a mis-modeled stamp kind can never pin an edge to the
+    # sticky host route
+    assert shardcheck._has_string({latency.STAMP_COLUMN: "s"}) is None
+    assert shardcheck._has_string({"name": "s"}) == "name"
+
+
+# -- stamp survival: end-to-end pipelines (sanitizer armed via conftest) -----
+
+
+def test_e2e_chain_coalesce_sink_latency(rng, sampled):
+    batches = [_events(rng, n=64) for _ in range(6)]
+    outs = run_pipeline(
+        batches,
+        lambda s: s.map(lambda c: {"k": c["k"], "v2": c["v"] * 2}, name="m1")
+                   .map(lambda c: {"k": c["k"], "v2": c["v2"]}, name="m2")
+                   .sink("memory", {"name": "out"}))
+    assert outs and any(b.lat_stamp is not None for b in outs)
+    q = sampled.sink_quantiles()
+    assert q, "sink recorded no latency samples"
+    (stats,) = q.values()
+    assert stats["count"] >= 1 and stats["p99_ms"] >= 0.0
+    snap = sampled.snapshot()
+    assert snap["records_sampled"] >= 1
+    assert snap["records_seen"] >= 6 * 64
+
+
+def test_e2e_unchained_stamp_survives(rng, sampled, monkeypatch):
+    """ARROYO_CHAIN=0 reproduces the pre-chaining per-operator queue
+    topology — the stamp must survive the queue hops too."""
+    monkeypatch.setenv("ARROYO_CHAIN", "0")
+    reset_config()
+    outs = run_pipeline(
+        [_events(rng, n=64) for _ in range(4)],
+        lambda s: s.map(lambda c: {"k": c["k"], "v": c["v"]}, name="m")
+                   .sink("memory", {"name": "out"}))
+    assert outs and any(b.lat_stamp is not None for b in outs)
+    assert sampled.sink_quantiles()
+
+
+def test_e2e_window_fire_inherits_stamp(rng, sampled):
+    outs = run_pipeline(
+        [_events(rng, n=200) for _ in range(3)],
+        lambda s: s.key_by("k")
+                   .tumbling_aggregate(SEC, [AggSpec(AggKind.SUM, "v", "s"),
+                                             AggSpec(AggKind.COUNT, None,
+                                                     "cnt")])
+                   .sink("memory", {"name": "out"}))
+    assert outs and any(b.lat_stamp is not None for b in outs)
+    q = sampled.sink_quantiles()
+    assert q and next(iter(q.values()))["count"] >= 1
+    # the fired pane charged its hold time to the watermark_hold stage
+    assert sampled._stage_counts.get("watermark_hold", 0) >= 1
+    assert sampled.critical_path()["stages"]["watermark_hold"] >= 0.0
+
+
+def test_e2e_join_inherits_stamp(rng, sampled):
+    t = lambda s: int(s * SEC)
+    l = Batch(np.array([t(0.1), t(0.2)], dtype=np.int64),
+              {"pid": np.array([1, 2], dtype=np.int64),
+               "lv": np.array([10, 20], dtype=np.int64)})
+    r = Batch(np.array([t(0.3), t(0.4)], dtype=np.int64),
+              {"pid": np.array([1, 2], dtype=np.int64),
+               "rv": np.array([100, 200], dtype=np.int64)})
+    clear_sink("out")
+    left = (Stream.source("memory", {"batches": [l]})
+            .watermark(max_lateness_micros=0).key_by("pid"))
+    right = (Stream.source("memory", {"batches": [r]},
+                           program=left.program)
+             .watermark(max_lateness_micros=0).key_by("pid"))
+    prog = (left.window_join(right, TumblingWindow(SEC))
+            .sink("memory", {"name": "out"}))
+    LocalRunner(prog).run()
+    outs = sink_output("out")
+    assert outs and any(b.lat_stamp is not None for b in outs)
+    assert sampled.sink_quantiles()
+
+
+def test_pane_stamp_survives_checkpoint_restore_rescale(sampled):
+    """A sampled record held in pane state at barrier time is still
+    measured after recovery: the pending (max-stamp) rides the canonical
+    snapshot as ``__lat_stamp`` and is popped back out BEFORE the
+    rescale re-partition filter ever sees it."""
+    from arroyo_tpu.engine.operators_window import BinAggOperator
+
+    class _Store:
+        def __init__(self):
+            self.tables = {}
+
+        def register_device(self, desc, table):
+            self.tables[desc.name] = table
+            return None
+
+    class _Ctx:
+        def __init__(self, idx, par):
+            self.task_info = TaskInfo("j", "w", "w", idx, par)
+            self.state = _Store()
+
+    aggs = (AggSpec(AggKind.SUM, "v", "s"),)
+    loop = asyncio.new_event_loop()
+    try:
+        op = BinAggOperator("w", SEC, SEC, aggs)
+        ctx = _Ctx(0, 1)
+        loop.run_until_complete(op.on_start(ctx))
+        table = ctx.state.tables["a"]
+        # no pending sample -> canonical snapshot format is unchanged
+        assert "__lat_stamp" not in table.snapshot()
+        op._lat_pending = (987654321, time.monotonic())
+        arrays = table.snapshot()
+        assert int(arrays["__lat_stamp"][0]) == 987654321
+
+        # restore into a RESCALED successor (parallelism 2): the stamp
+        # comes back and filter_canonical_snapshot still sees a clean
+        # canonical dict
+        op2 = BinAggOperator("w", SEC, SEC, aggs)
+        ctx2 = _Ctx(0, 2)
+        loop.run_until_complete(op2.on_start(ctx2))
+        ctx2.state.tables["a"].restore(dict(arrays))
+        assert op2._lat_pending is not None
+        assert op2._lat_pending[0] == 987654321
+    finally:
+        loop.close()
+
+
+# -- watermark lineage / critical path ---------------------------------------
+
+
+def test_lineage_attribution_seeded_slow_stage(sampled):
+    """Seed a slow stage and check the decomposition names it dominant
+    with the right share."""
+    sampled.note_stage("watermark_hold", 3.0)
+    sampled.note_stage("barrier_align", 1.0)
+    cp = sampled.critical_path()
+    assert cp["dominant"] == "watermark_hold"
+    assert cp["dominant_share"] == pytest.approx(0.75)
+    assert cp["total_secs"] == pytest.approx(4.0)
+    sampled.note_edge_watermark("agg", latency.now_micros() - 2_000_000)
+    wm = sampled.snapshot()["watermarks"]
+    assert wm["agg"]["age_ms"] >= 2000.0
+
+
+def test_summary_ride_alongs_shape(sampled):
+    ti = TaskInfo("test-job", "sink-1", "sink", 0, 1)
+    sampled.observe_sink(ti, latency.now_micros() - 5000)
+    sampled.note_edge_watermark("agg", latency.now_micros())
+    sampled.note_stage("watermark_hold", 0.5)
+    out = latency.summary_ride_alongs("test-job")
+    assert out["sink-1"]["e2e_latency.count"] == 1.0
+    assert out["sink-1"]["e2e_latency.p99_ms"] >= 5.0
+    assert "wm_age_ms" in out["agg"]
+    w = out["__worker__"]
+    assert w["critical_path.watermark_hold"] == pytest.approx(0.5)
+    assert w["latency_sample_n"] == 1.0
+    # a different job's heartbeat gets nothing from this observatory
+    assert latency.summary_ride_alongs("other-job") == {}
+
+
+# -- SLO engine ---------------------------------------------------------------
+
+
+def test_burn_rate_pure_math():
+    assert latency.burn_rate([], 100.0, 60.0) == 0.0
+    samples = [(10.0, True), (50.0, True), (90.0, False), (95.0, True)]
+    # window [40, 100]: True, False, True -> 2/3
+    assert latency.burn_rate(samples, 100.0, 60.0) == pytest.approx(2 / 3)
+    # tiny window sees only the newest sample
+    assert latency.burn_rate(samples, 100.0, 5.0) == 1.0
+    # everything aged out reads healthy, not violating
+    assert latency.burn_rate(samples, 1000.0, 60.0) == 0.0
+
+
+def test_slo_evaluator_verdicts():
+    ev = latency.SloEvaluator("j", latency.Slo(p99_ms=100.0,
+                                               staleness_ms=500.0,
+                                               burn_window_secs=60.0))
+    # no measurements yet: absence of evidence never violates
+    v = ev.evaluate(None, None, now=1.0)
+    assert not v["violating"] and ev.violations_total == 0
+    v = ev.evaluate(150.0, 100.0, now=2.0)
+    assert v["violating"] and list(v["violated_dims"]) == ["p99"]
+    assert ev.violations_total == 1
+    v = ev.evaluate(50.0, 900.0, now=3.0)
+    assert v["violating"] and list(v["violated_dims"]) == ["staleness"]
+    v = ev.evaluate(50.0, 100.0, now=4.0)
+    assert not v["violating"]
+    assert v["burn_rate"] == pytest.approx(0.5)  # 2 of 4 in window
+    assert ev.current_burn_rate == pytest.approx(0.5)
+    j = ev.to_json()
+    assert j["configured"] and j["violations_total"] == 2
+    assert len(j["recent_violations"]) == 2
+    # unconfigured SLO never violates no matter the measurement
+    idle = latency.SloEvaluator("j", latency.Slo())
+    assert not idle.evaluate(1e9, 1e9, now=1.0)["violating"]
+    assert not latency.Slo().configured()
+
+
+def test_slo_from_config(monkeypatch):
+    monkeypatch.setenv("ARROYO_SLO_P99_MS", "250")
+    monkeypatch.setenv("ARROYO_SLO_BURN_WINDOW_SECS", "0")
+    reset_config()
+    slo = latency.Slo.from_config()
+    assert slo.p99_ms == 250.0 and slo.configured()
+    assert slo.burn_window_secs == 60.0  # 0 falls back to the default
+
+
+def test_autoscaler_slo_pressure():
+    """The burn rate pressures only operators that report sink latency
+    (that's where the debt is observable), and blocks scale-down."""
+    from arroyo_tpu.autoscale.policy import (BacklogDrainPolicy, EvalInput,
+                                             PolicyConfig)
+
+    pol = BacklogDrainPolicy(PolicyConfig())
+    mk = lambda burn: EvalInput(
+        now=10.0,
+        rollups=[{"operator_id": "sink-1", "e2e_latency.p99_ms": 500.0},
+                 {"operator_id": "map-1"}],
+        parallelism={"sink-1": 1, "map-1": 1},
+        upstream={"sink-1": ["map-1"], "map-1": []},
+        slo_burn=burn)
+    sig = pol.signals(mk(1.0))
+    assert sig["sink-1"]["pressure"] == 1.0
+    assert sig["sink-1"]["calm_pressure"] == 1.0  # blocks scale-down
+    assert sig["map-1"]["pressure"] == 0.0  # burn lands on sinks only
+    assert pol.signals(mk(0.0))["sink-1"]["pressure"] == 0.0
+
+
+# -- rollup + REST round-trip -------------------------------------------------
+
+
+def test_rollup_latency_key_semantics():
+    from arroyo_tpu.controller.controller import ControllerServer
+
+    agg = {}
+    ControllerServer._rollup_op(agg, {
+        "e2e_latency.p99_ms": 120.0, "e2e_latency.p50_ms": 40.0,
+        "e2e_latency.count": 5.0, "wm_age_ms": 30.0,
+        "critical_path.fire": 1.0, "device_bytes.panes": 100.0,
+        "latency_sample_n": 64.0}, None, 0.0)
+    ControllerServer._rollup_op(agg, {
+        "e2e_latency.p99_ms": 80.0, "e2e_latency.p50_ms": 60.0,
+        "e2e_latency.count": 3.0, "wm_age_ms": 50.0,
+        "critical_path.fire": 2.0, "device_bytes.panes": 50.0,
+        "latency_sample_n": 64.0}, None, 0.0)
+    # quantiles/ages: worst worker (summing would fabricate latency)
+    assert agg["e2e_latency.p99_ms"] == 120.0
+    assert agg["e2e_latency.p50_ms"] == 60.0
+    assert agg["wm_age_ms"] == 50.0
+    assert agg["latency_sample_n"] == 64.0
+    # stage seconds / byte tables / sample counts: sum across workers
+    assert agg["e2e_latency.count"] == 8.0
+    assert agg["critical_path.fire"] == 3.0
+    assert agg["device_bytes.panes"] == 150.0
+
+
+def test_latency_shape():
+    from arroyo_tpu.controller.controller import ControllerServer
+
+    rows = [
+        {"operator_id": "__worker__", "critical_path.fire": 2.0,
+         "critical_path.compute": 6.0, "device_bytes.panes": 512.0,
+         "latency_sample_n": 64.0},
+        {"operator_id": "sink-1", "e2e_latency.p50_ms": 5.0,
+         "e2e_latency.p99_ms": 42.0, "e2e_latency.last_ms": 6.0,
+         "e2e_latency.count": 9.0},
+        {"operator_id": "agg-1", "wm_age_ms": 17.0},
+    ]
+    shape = ControllerServer.latency_shape(rows)
+    assert shape["p99_ms"] == 42.0 and shape["staleness_ms"] == 17.0
+    assert shape["sample_n"] == 64
+    assert shape["sinks"]["sink-1"]["count"] == 9
+    assert shape["critical_path"]["dominant"] == "compute"
+    assert shape["critical_path"]["dominant_share"] == pytest.approx(0.75)
+    assert shape["device_state_bytes"]["panes"] == 512
+    # empty rollup: headline dims are None -> the SLO never judges them
+    empty = ControllerServer.latency_shape([])
+    assert empty["p99_ms"] is None and empty["staleness_ms"] is None
+
+
+def test_rest_latency_and_slo_roundtrip(tmp_path, monkeypatch):
+    import httpx
+
+    from arroyo_tpu.api.rest import ApiServer
+    from arroyo_tpu.controller.controller import ControllerServer, Job
+
+    monkeypatch.setenv("CHECKPOINT_URL", f"file://{tmp_path}/ckpt")
+    reset_config()
+
+    async def scenario():
+        controller = ControllerServer()
+        api = ApiServer(controller)
+        port = await api.start()
+        prog = (Stream.source("impulse", {"event_rate": 0.0,
+                                          "message_count": 1,
+                                          "batch_size": 1})
+                .sink("blackhole", {}))
+        job = Job("j-lat", prog, f"file://{tmp_path}/ckpt", 1)
+        controller.jobs["j-lat"] = job
+        base = f"http://127.0.0.1:{port}"
+        try:
+            async with httpx.AsyncClient(base_url=base, timeout=30) as c:
+                r = await c.get("/v1/jobs/j-lat/slo")
+                assert r.status_code == 200
+                assert not r.json()["configured"]
+
+                r = await c.put("/v1/jobs/j-lat/slo",
+                                json={"p99_ms": 100.0,
+                                      "burn_window_secs": 30})
+                assert r.status_code == 200
+                assert r.json()["slo"]["p99_ms"] == 100.0
+                assert job.slo.p99_ms == 100.0
+
+                # unknown keys are a validation error, not a silent drop
+                r = await c.put("/v1/jobs/j-lat/slo", json={"bogus": 1})
+                assert r.status_code == 422
+                r = await c.put("/v1/jobs/j-lat/slo", json={"p99_ms": -5})
+                assert r.status_code == 422
+                assert job.slo.p99_ms == 100.0  # rejected PUTs change nothing
+
+                job.slo_eval.evaluate(250.0, None)
+                r = await c.get("/v1/jobs/j-lat/slo")
+                body = r.json()
+                assert body["last"]["violating"]
+                assert body["violations_total"] == 1
+
+                r = await c.get("/v1/jobs/j-lat/latency")
+                assert r.status_code == 200
+                data = r.json()
+                # which path answered depends on whether the process-
+                # wide metrics registry holds rows from earlier tests;
+                # both shapes carry the same contract
+                assert data["source"] in ("heartbeat", "local_registry")
+                assert "sinks" in data and "critical_path" in data
+                assert data["slo"]["last"]["violating"]
+
+                r = await c.get("/v1/jobs/no-such-job/latency")
+                assert r.status_code == 404
+                r = await c.get("/v1/jobs/no-such-job/slo")
+                assert r.status_code == 404
+        finally:
+            await api.stop()
+
+    asyncio.new_event_loop().run_until_complete(scenario())
+
+
+# -- off-path discipline ------------------------------------------------------
+
+
+def test_off_path_records_nothing(rng):
+    assert latency.active() is None
+    assert not latency.sampling_enabled()
+    outs = run_pipeline(
+        [_events(rng, n=64) for _ in range(3)],
+        lambda s: s.map(lambda c: {"k": c["k"], "v": c["v"]}, name="m")
+                   .sink("memory", {"name": "out"}))
+    assert outs and all(b.lat_stamp is None for b in outs)
+    # the engine must not have armed it as a side effect
+    assert latency.active() is None
+    assert latency.summary_ride_alongs("any") == {}

@@ -41,8 +41,14 @@
 #      attribute >=85% of wall time to named phases (best-of-2) with
 #      zero event-loop stalls (unattributed time means the
 #      instrumentation drifted off the hot path);
-#  10. tests/test_obs.py + tests/test_profiler.py — the observability
-#      contract suites.
+#  10. the latency observatory: a sanitized tiny-Nexmark run with
+#      1-in-N sampling armed and an SLO configured must record >=1
+#      sampled e2e latency per sink with zero sanitizer violations
+#      (the stamp never flips a schema signature), attribute the
+#      critical path to a named stage, and round-trip the SLO verdict
+#      through REST GET/PUT /v1/jobs/{id}/slo + GET .../latency;
+#  11. tests/test_obs.py + tests/test_profiler.py +
+#      tests/test_latency.py — the observability contract suites.
 #
 # Budget: the whole gate stays under ~90s.
 #
@@ -670,5 +676,123 @@ asyncio.run(rest_check())
 print("smoke: autoscaler simulator + REST surface ok")
 PY
 
-exec python -m pytest tests/test_obs.py tests/test_profiler.py -q \
+python - <<'PY'
+# latency-observatory gate: sampling armed + SLO configured on a
+# sanitized tiny-Nexmark run — every sink must record sampled e2e
+# latencies (stamps survived source -> coalesce -> window fire -> sink
+# with the sanitizer proving no schema signature flipped), the
+# critical path must attribute to a named stage, and the SLO verdict
+# must round-trip through the REST surface
+import asyncio
+import os
+import sys
+
+os.environ["ARROYO_SANITIZE"] = "1"
+os.environ["ARROYO_LATENCY_SAMPLE_N"] = "64"
+os.environ["ARROYO_SLO_P99_MS"] = "60000"
+
+from arroyo_tpu.config import reset_config
+
+reset_config()
+
+from arroyo_tpu.connectors.memory import clear_sink, sink_output
+from arroyo_tpu.engine.engine import LocalRunner
+from arroyo_tpu.obs import latency, profiler
+from arroyo_tpu.sql import plan_sql
+
+SQL = """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark', event_rate = '1000000', num_events = '30000',
+  rate_limited = 'false', batch_size = '2048',
+  base_time_micros = '1700000000000000'
+);
+SELECT bid.auction as auction,
+       TUMBLE(INTERVAL '2' SECOND) as window,
+       count(*) AS num
+FROM nexmark WHERE bid is not null GROUP BY 1, 2
+"""
+
+profiler.arm("local-job")  # folds compute/queue phases into the path
+clear_sink("results")
+runner = LocalRunner(plan_sql(SQL))
+runner.run()
+rows = sum(len(b) for b in sink_output("results"))
+if rows <= 0:
+    sys.exit("smoke: latency-gate nexmark produced no output")
+san = runner.engine.sanitizer
+if san is None or san.violations:
+    sys.exit(f"smoke: latency gate sanitizer problem (violations="
+             f"{getattr(san, 'violations', None)}) — the stamp side "
+             "channel broke a runtime invariant")
+lat = latency.active()
+if lat is None:
+    sys.exit("smoke: ARROYO_LATENCY_SAMPLE_N did not arm the "
+             "observatory")
+snap = lat.snapshot()
+if snap["records_sampled"] <= 0:
+    sys.exit("smoke: sources sampled no records")
+sinks = snap["sinks"]
+if not sinks or any(q["count"] < 1 for q in sinks.values()):
+    sys.exit(f"smoke: a sink recorded no sampled e2e latency "
+             f"(sinks={sinks}) — the stamp died in transit")
+cp = snap["critical_path"]
+if cp["total_secs"] <= 0 or not cp["dominant"]:
+    sys.exit(f"smoke: critical path attributed nothing ({cp})")
+profiler.disarm()
+
+
+async def rest_check():
+    import httpx
+
+    from arroyo_tpu import Stream
+    from arroyo_tpu.api.rest import ApiServer
+    from arroyo_tpu.controller.controller import ControllerServer, Job
+    from arroyo_tpu.controller.scheduler import InProcessScheduler
+
+    ctrl = ControllerServer(InProcessScheduler())
+    api = ApiServer(ctrl)
+    port = await api.start()
+    prog = Stream.source("impulse", {"message_count": 10}).sink(
+        "blackhole", {})
+    ctrl.jobs["smoke"] = Job("smoke", prog, "file:///tmp/smoke-ckpt", 1)
+    job = ctrl.jobs["smoke"]
+    assert job.slo.configured(), "env SLO did not seed the job"
+    try:
+        async with httpx.AsyncClient(
+                base_url=f"http://127.0.0.1:{port}", timeout=10) as c:
+            r = await c.get("/v1/jobs/smoke/slo")
+            assert r.status_code == 200, r.text
+            assert r.json()["slo"]["p99_ms"] == 60000.0
+            r = await c.put("/v1/jobs/smoke/slo",
+                            json={"p99_ms": 0.25})
+            assert r.status_code == 200, r.text
+            job.slo_eval.evaluate(1.0, None)  # 1ms > 0.25ms: violates
+            r = await c.get("/v1/jobs/smoke/slo")
+            body = r.json()
+            assert body["last"]["violating"], body
+            assert body["violations_total"] == 1, body
+            r = await c.get("/v1/jobs/smoke/latency")
+            assert r.status_code == 200, r.text
+            data = r.json()
+            assert data["slo"]["last"]["violating"], data
+            assert "critical_path" in data and "sinks" in data
+    finally:
+        await api.stop()
+
+
+asyncio.run(rest_check())
+latency.disarm()
+for k in ("ARROYO_LATENCY_SAMPLE_N", "ARROYO_SLO_P99_MS"):
+    os.environ.pop(k, None)
+sink_stats = "; ".join(
+    f"{op}: p50={q['p50_ms']}ms p99={q['p99_ms']}ms n={int(q['count'])}"
+    for op, q in sinks.items())
+print(f"smoke: latency observatory ok ({snap['records_sampled']} "
+      f"sampled of {snap['records_seen']} records; {sink_stats}; "
+      f"dominant stage {cp['dominant']} "
+      f"{cp['dominant_share']:.0%}; SLO REST round-trip ok)")
+PY
+
+exec python -m pytest tests/test_obs.py tests/test_profiler.py \
+    tests/test_latency.py -q \
     -p no:cacheprovider

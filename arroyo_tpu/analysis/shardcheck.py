@@ -57,6 +57,15 @@ consumer — engine build preflight, REST ``/v1/pipelines/validate``,
   fed by an OPEN schema (JSON ingest may grow columns mid-stream): a
   late string column would flip the edge's route mid-stream and trip
   the runtime sanitizer.
+- ``session-host-aggregate`` (warning) — a string column feeding a
+  session-window aggregate behind device session runs
+  (state/session_state.py): interval merges ride the device union
+  kernel but every fire for that aggregate replays the counted host
+  segment loop (the f64 UDAF channels can never hold it), so
+  ``udaf_host_rows``/``session_host_merge_rows`` carry the cost — the
+  session analog of ``payload-host-gather`` (PR 19).  Suppressed
+  entirely under ``ARROYO_SESSION_STATE=legacy`` (everything is host
+  there by design).
 
 ``ARROYO_SHARDCHECK=0`` disables the gate at every consumer (triage
 only — a plan that fails here pays real transfers).
@@ -64,9 +73,9 @@ only — a plan that fails here pays real transfers).
 The lint integration (``python -m arroyo_tpu.analysis``) runs this as
 a repo-level pass: the wiring audit over ``engine/operators_window.py``
 plus a representative-plan sweep (q5-shape hop aggregate, two-stream
-join, factored correlated windows, at parallelism 1 and 2 on a
-symbolic 8-shard mesh) that must report zero errors and zero predicted
-reshards.
+join, factored correlated windows, config5-shape session windows, at
+parallelism 1 and 2 on a symbolic 8-shard mesh) that must report zero
+errors and zero predicted reshards.
 """
 
 from __future__ import annotations
@@ -245,6 +254,23 @@ def _merge_cols(sides: List[Tuple[Optional[Dict[str, str]], bool]]
 # it is transportable (packs on the device shuffle) and must NEVER
 # force the sticky host route the way an unknown/string column would
 _LAT_STAMP_COLUMN = "__lat_ingest"
+
+
+def _session_window_here(node) -> bool:
+    """True when ``node`` is a session-window aggregate that will run
+    on the device session-run state (state/session_state.py).  Under
+    ``ARROYO_SESSION_STATE=legacy`` everything is host per-key dicts by
+    design, so the session-specific findings are suppressed."""
+    from ..graph.logical import OpKind, SessionWindow
+
+    if node.operator.kind is not OpKind.WINDOW:
+        return False
+    if not isinstance(getattr(node.operator.spec, "typ", None),
+                      SessionWindow):
+        return False
+    from ..state.session_state import session_state_enabled
+
+    return session_state_enabled()
 
 
 def _has_string(cols: Optional[Dict[str, str]]) -> Optional[str]:
@@ -596,6 +622,39 @@ def analyze(program: Any, nk: Optional[int] = None,
                              "materializes from the host mirror "
                              "(join_host_gather_rows will dominate)",
                              op_id)
+            # session run state (PR 19): session windows keep (key,
+            # start, end) interval runs in state/session_state.py,
+            # partitioned on the LOW key-hash bits (kh & (P-1)) while
+            # subtask key ranges own the TOP bits — orthogonal by
+            # construction, so rescale never re-partitions session runs
+            # and they never enter the route-bit funnel check.  Hot
+            # partitions stage (st, en) planes on mesh devices, so a
+            # session node at nk > 1 is mesh-resident like a join ring.
+            session_win = _session_window_here(node)
+            session_here = session_win and nk > 1
+            if session_win:
+                # fire-time aggregation replays buffered rows through
+                # ops/segment.py: a string input column can never ride
+                # the f64 UDAF/partial channels, so every fire for that
+                # aggregate runs the counted per-segment host loop
+                # behind device interval merges — the designed sticky
+                # fallback (stable, but the "config5 slow — sessions
+                # riding host" runbook wants it surfaced at plan time).
+                merged_in, _oin = _merge_cols(in_cols) if in_cols \
+                    else (None, False)
+                for a in getattr(node.operator.spec, "aggs", ()) or ():
+                    ak = (merged_in or {}).get(a.column or "")
+                    if ak == "s":
+                        diag("session-host-aggregate", "warning",
+                             f"{op_id} ({kind.value}): string column "
+                             f"{a.column!r} feeds session aggregate "
+                             f"{a.output!r}; interval merges ride the "
+                             "device union kernel but every fire for "
+                             "this aggregate replays the host segment "
+                             "loop (udaf_host_rows / "
+                             "session_host_merge_rows carry the cost)",
+                             op_id)
+                        break
             keys = next((s.keys for s in in_specs if s.keys), None)
             specs[op_id] = ShardSpec(
                 keys=keys, aligned=True,
@@ -604,7 +663,7 @@ def analyze(program: Any, nk: Optional[int] = None,
                 route_shift=route_shift,
                 device_out=(kind is OpKind.WINDOW_FACTOR and mesh_here),
                 sticky=merged.sticky,
-                mesh_behind=(mesh_here or ring_here
+                mesh_behind=(mesh_here or ring_here or session_here
                              or any(s.mesh_behind for s in in_specs)))
             cols_of[op_id] = (_agg_out_cols(node, in_cols), False)
         else:  # sinks and anything unmodeled: pass through conservatively
@@ -772,6 +831,21 @@ SELECT bid.auction as auction,
        sum(bid.price) AS tot
 FROM nexmark WHERE bid is not null GROUP BY 1, 2;
 """,
+    # config5-shape session windows on built-in aggregates (UDAF
+    # registration is a runtime act, so the sweep uses count/avg; the
+    # session RUN STATE placement is what this shape pins — the plan
+    # must stay aligned with zero predicted reshards whether the runs
+    # live on host dicts or device partitions)
+    "sessions": """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark', event_rate = '1000', num_events = '1000',
+  rate_limited = 'false', batch_size = '256'
+);
+SELECT bid.auction as auction,
+       session(INTERVAL '1' SECOND) as window,
+       count(*) AS num, avg(bid.price) AS mean_price
+FROM nexmark WHERE bid is not null GROUP BY 1, 2
+""",
 }
 
 _SWEEP_NK = 8  # symbolic mesh: the checks must hold without devices
@@ -785,9 +859,9 @@ def check_repo(root: str, full_scan: bool = True) -> List[Finding]:
             findings.extend(check_wiring_source(fh.read(), wiring))
     if not full_scan:
         # single-file/editor invocations skip the representative-plan
-        # sweep: it imports the whole planner stack and plans six SQL
-        # shapes — seconds of wall that can gate an unrelated file on
-        # plan findings; the sweep runs on every whole-package lint
+        # sweep: it imports the whole planner stack and plans each SQL
+        # shape twice — seconds of wall that can gate an unrelated file
+        # on plan findings; the sweep runs on every whole-package lint
         return findings
     self_path = os.path.abspath(__file__)
     try:

@@ -531,8 +531,14 @@ class SessionWindowOperator(Operator):
         ]
 
     async def on_start(self, ctx: Context) -> None:
+        from ..state.session_state import SessionRunState
+
         self.buffer = ctx.state.get_batch_buffer("s")
-        self.windows = ctx.state.get_keyed_state("v")
+        # partition-adaptive sorted interval runs unless
+        # ARROYO_SESSION_STATE=legacy; both layouts speak the KeyedState
+        # interface, so the per-key clamp path below runs unchanged
+        self.windows = ctx.state.get_session_state("v")
+        self._device_state = isinstance(self.windows, SessionRunState)
         self._lat_pending: Optional[Tuple[int, float]] = None
 
     def _merge_key(self, kh: int, times: np.ndarray, ctx: Context) -> None:
@@ -591,6 +597,13 @@ class SessionWindowOperator(Operator):
         kb = np.append(kb, len(ikh))
         span_ok = (ien - ist) <= MAX_SESSION_SIZE_MICROS
         key_starts = np.append(newkey.nonzero()[0], n)
+        if self._device_state:
+            await self._merge_batch_device(kh, ts, ikh, ist, ien, kb,
+                                           span_ok, key_starts, ctx)
+            return
+        from ..state.session_state import _count_merge
+
+        _count_merge(0, n)  # legacy layout: every event merges on host
         for i in range(len(kb) - 1):
             k = int(ikh[kb[i]])
             lo, hi = kb[i], kb[i + 1]
@@ -604,6 +617,52 @@ class SessionWindowOperator(Operator):
                 # the incremental-clamp-splitting path is authoritative
                 self._merge_key(k, ts[key_starts[i]:key_starts[i + 1]],
                                 ctx)
+
+    async def _merge_batch_device(self, kh, ts, ikh, ist, ien, kb,
+                                  span_ok, key_starts, ctx: Context) -> None:
+        """Device-state merge: ONE vectorized interval-union dispatch
+        covers every in-bounds key; keys the clamp touches (overlong
+        bursts, or merged spans crossing MAX_SESSION_SIZE) re-run the
+        authoritative per-key path against the same state object — the
+        device/host row split is counted, and sanitized parity vs
+        ARROYO_SESSION_STATE=legacy is asserted by the smoke gate."""
+        from ..obs import perf, profiler
+        from ..state.session_state import _count_merge
+
+        nkeys = len(kb) - 1
+        # per-interval key ordinal + per-key last event time (the KEYED
+        # snapshot time column, matching the legacy insert(max_t, ...))
+        key_maxt = ts[key_starts[1:] - 1]
+        counts = np.diff(kb)
+        itm = np.repeat(key_maxt, counts)
+        # keys with an overlong burst go straight to the per-event path:
+        # only it knows the event positions past the clamp
+        key_ord = np.repeat(np.arange(nkeys), counts)
+        bad = np.unique(key_ord[~span_ok])
+        good_iv = ~np.isin(key_ord, bad)
+        prof = profiler.active()
+        frame = (prof.begin(perf.active_operator_id() or self.name,
+                            "session_merge") if prof is not None else None)
+        try:
+            flagged = self.windows.merge_intervals(
+                ikh[good_iv], ist[good_iv], ien[good_iv], itm[good_iv])
+        finally:
+            if prof is not None:
+                prof.end(frame)
+        if len(bad) or len(flagged):
+            keys_arr = ikh[kb[:-1]]  # sorted ascending (lexsort by key)
+            fb = set(bad.tolist())
+            if len(flagged):
+                fb.update(np.searchsorted(keys_arr, flagged).tolist())
+            host_events = 0
+            for i in sorted(fb):
+                lo, hi = key_starts[i], key_starts[i + 1]
+                host_events += int(hi - lo)
+                self._merge_key(int(keys_arr[i]), ts[lo:hi], ctx)
+            _count_merge(0, host_events)
+        # exact no-fire bound straight off the runs (cheap: P partition
+        # minima), replacing the legacy conservative tracking
+        self._min_end = self.windows.min_end()
 
     def _merge_key_intervals(self, kh: int, ists: List[int],
                              iens: List[int], max_t: int,
@@ -656,6 +715,17 @@ class SessionWindowOperator(Operator):
         skips the scan entirely while nothing can fire (many dormant
         keys, slowly advancing watermark)."""
         if self._min_end is not None and watermark < self._min_end:
+            return
+        if self._device_state:
+            # mask-compress every closed session out of the runs in one
+            # vector pass per partition — no key iteration
+            fk, fs, fe, removed = self.windows.expire(watermark)
+            self._pending_fires.extend(
+                zip((int(k) for k in fk.tolist()), fs.tolist(),
+                    fe.tolist()))
+            for kh in removed:
+                ctx.state.note_delete("v", kh)
+            self._min_end = self.windows.min_end()
             return
         expired_keys = []
         min_end = None
@@ -758,11 +828,14 @@ class SessionWindowOperator(Operator):
             self._collect_expired(watermark, ctx)
             await self._flush_fires(ctx)
         # evict data older than every live session start
-        live_starts = [s for _, sessions in self.windows.items()
-                       for (s, _) in sessions]
-        horizon = min(live_starts) if live_starts else watermark
-        self.buffer.evict_before(min(horizon, watermark - MAX_SESSION_SIZE_MICROS
-                                     if not live_starts else horizon))
+        if self._device_state:
+            ls = self.windows.min_live_start()
+        else:
+            live_starts = [s for _, sessions in self.windows.items()
+                           for (s, _) in sessions]
+            ls = min(live_starts) if live_starts else None
+        self.buffer.evict_before(
+            ls if ls is not None else watermark - MAX_SESSION_SIZE_MICROS)
         await ctx.broadcast(Message.wm(Watermark.event_time(watermark)))
 
 

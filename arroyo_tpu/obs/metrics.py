@@ -317,6 +317,23 @@ def join_gather_counter(path: str, job_id: str = "") -> Counter:
         job_id)
 
 
+SESSION_DEVICE_MERGE = "arroyo_worker_session_device_merge_rows"
+SESSION_HOST_MERGE = "arroyo_worker_session_host_merge_rows"
+
+
+def session_merge_counter(path: str, job_id: str = "") -> Counter:
+    """Session-interval rows merged per path: ``device`` = through the
+    vectorized all-keys union dispatch (state/session_state.py),
+    ``host`` = the per-key python merge (the clamp fallback, span
+    overflows, and the whole stream under ARROYO_SESSION_STATE=legacy).
+    config5-shape jobs riding host is THE slow-path signature — the
+    triage runbook (docs/operations.md) keys off this split."""
+    name = SESSION_DEVICE_MERGE if path == "device" else SESSION_HOST_MERGE
+    return _plain_counter(
+        name, f"session interval rows merged via the {path} path",
+        job_id)
+
+
 FACTOR_SHARED_PANES = "arroyo_factor_shared_panes"
 FACTOR_DERIVED_WINDOWS = "arroyo_factor_derived_windows"
 _factor_shared: Optional[Gauge] = None

@@ -103,7 +103,7 @@ __all__ = [
 WORK_PHASES = ("source_decode", "proc", "dispatch", "device_execute",
                "shuffle_prep", "coalesce_merge", "watermark", "checkpoint",
                "emit_encode", "frame_encode", "frame_decode", "reshard",
-               "shuffle_collective", "gather")
+               "shuffle_collective", "gather", "session_merge")
 WAIT_PHASES = ("queue_wait", "coalesce_wait", "send_wait", "net_flush")
 
 

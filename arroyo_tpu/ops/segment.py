@@ -132,7 +132,15 @@ def segment_aggregate(
     # the buffered window path — matching the reference's two-phase
     # exclusion of non-mergeable aggregates, operators.rs:165-167)
     distinct_results: Dict[str, np.ndarray] = {}
+    host_valid_counts: Dict[str, np.ndarray] = {}
     device_aggs = []
+    # channel layout accumulators (device_aggs append theirs below;
+    # UDAF plans append theirs inside the dispatch loop)
+    from ..formats import coerce_float
+
+    kinds: List[str] = []
+    rows: List[np.ndarray] = []
+    udaf_specs: List[Tuple[AggSpec, "UdafPlan", Dict[str, int]]] = []
 
     def _host_segments(column: np.ndarray):
         """(values-in-order, per-row validity, per-segment row groups) —
@@ -146,26 +154,57 @@ def segment_aggregate(
                    else np.asarray(ok))  # arroyolint: disable=host-sync -- host-segment fallback path: UDAF/string/object columns cannot ride the f64 device channels; these are host numpy arrays
         return v, ok_rows, np.split(np.arange(n), seg_start[1:])
 
+    from ..obs import perf as _perf
+
     for a in aggs:
         if a.kind == AggKind.UDAF:
-            # user aggregate: per-segment host call over non-null values
-            # (non-mergeable — only reachable via buffered window paths,
-            # like the reference's wasm UDFs, operators/mod.rs:347-494)
-            if (a.fn is np.median
-                    and np.asarray(agg_inputs[a.column]).dtype.kind in "if"):  # arroyolint: disable=host-sync -- host-segment fallback path: UDAF/string/object columns cannot ride the f64 device channels; these are host numpy arrays
+            from .udaf import channel_rows, udaf_channels_enabled, udaf_plan
+
+            col_raw = np.asarray(agg_inputs[a.column])  # arroyolint: disable=host-sync -- aggregate inputs on this generic path are host numpy columns (device-channel rows never reach it)
+            if (a.fn is np.median and col_raw.dtype.kind in "if"  # arroyolint: disable=host-sync -- host-segment fallback path: UDAF/string/object columns cannot ride the f64 device channels; these are host numpy arrays
+                    and udaf_channels_enabled()):
                 # vectorized across ALL segments: one in-segment sort,
                 # then middle-element picks — NaNs sort last inside each
                 # segment, so the non-null count bounds the true middle
+                # (order statistics don't decompose into channels; this
+                # exact path counts on the vectorized side of the split)
+                _perf.count("udaf_channel_rows", n)
                 distinct_results[a.output] = _segmented_median(
                     np.asarray(agg_inputs[a.column][order],  # arroyolint: disable=host-sync -- host-segment fallback path: UDAF/string/object columns cannot ride the f64 device channels; these are host numpy arrays
                                dtype=np.float64), kh, uniq, seg_start)
                 continue
+            # numeric UDAF expressible over mergeable partials: compile
+            # onto channels (ops/udaf.py probe algebra) — object/string
+            # columns stay on the counted sticky host fallback
+            plan = (udaf_plan(a.fn) if col_raw.dtype.kind in "ifbu"
+                    else None)
+            if plan is not None:
+                raw = coerce_float(col_raw[order], np.float64)
+                ok = ~np.isnan(raw)
+                chmap: Dict[str, int] = {}
+                for ch in plan.channels:
+                    kind, rowv = channel_rows(ch, raw, ok)
+                    chmap[ch] = len(kinds)
+                    kinds.append(kind)
+                    rows.append(rowv)
+                udaf_specs.append((a, plan, chmap))
+                _perf.count("udaf_channel_rows", n)
+                continue
+            # per-segment host call over non-null values (non-mergeable —
+            # only reachable via buffered window paths, like the
+            # reference's wasm UDFs, operators/mod.rs:347-494)
+            _perf.count("udaf_host_rows", n)
             v, ok_rows, groups = _host_segments(agg_inputs[a.column])
             out = []
-            for g in groups:
+            cnt = np.zeros(n_seg, dtype=np.int64)
+            for j, g in enumerate(groups):
                 gv = v[g[ok_rows[g]]]
+                cnt[j] = len(gv)
                 out.append(a.fn(gv) if len(gv) else np.nan)
             distinct_results[a.output] = np.asarray(out)  # arroyolint: disable=host-sync -- host-segment fallback path: UDAF/string/object columns cannot ride the f64 device channels; these are host numpy arrays
+            # same valid_counts contract as the compiled-channel path:
+            # the knob must not change the result SHAPE, only the route
+            host_valid_counts[a.output] = cnt
         elif (a.kind in (AggKind.MIN, AggKind.MAX)
               and np.asarray(agg_inputs[a.column]).dtype == object):  # arroyolint: disable=host-sync -- host-segment fallback path: UDAF/string/object columns cannot ride the f64 device channels; these are host numpy arrays
             # string MIN/MAX (lexicographic, NULLs skipped): object
@@ -205,10 +244,6 @@ def segment_aggregate(
     # Channel layout: one kernel channel per agg, plus a hidden additive
     # validity-count channel per column-reading agg so nulls are skipped
     # (same scheme as ops/keyed_bins.py)
-    from ..formats import coerce_float
-
-    kinds: List[str] = []
-    rows: List[np.ndarray] = []
     specs: List[Tuple[AggSpec, int, Optional[int]]] = []
     for a in device_aggs:
         if a.column is None:  # COUNT(*) — all rows
@@ -259,7 +294,17 @@ def segment_aggregate(
                                     jnp.asarray(sid_p), jnp.asarray(valid))
         outs = np.asarray(outs)[:, :n_seg]  # arroyolint: disable=host-sync -- host-segment fallback path: UDAF/string/object columns cannot ride the f64 device channels; these are host numpy arrays
     out_cols = dict(distinct_results)
-    valid_counts: Dict[str, np.ndarray] = {}
+    valid_counts: Dict[str, np.ndarray] = dict(host_valid_counts)
+    for a, plan, chmap in udaf_specs:
+        parts = {ch: np.asarray(outs[i], dtype=np.float64)  # arroyolint: disable=host-sync -- outs was pulled above; these are host slices of the already-read kernel result
+                 for ch, i in chmap.items()}
+        nnz = parts["nnz"]
+        with np.errstate(all="ignore"):
+            col = plan.combine(parts)
+        # all-null segments emit NaN — exactly what the host loop's
+        # "empty gv" branch produces
+        out_cols[a.output] = np.where(nnz > 0, col, np.nan)
+        valid_counts[a.output] = nnz.astype(np.int64)
     for a, ci, vi in specs:
         col = outs[ci]
         if vi is not None:

@@ -1032,6 +1032,18 @@ class MeshKeyedBinState:
             NamedSharding(self.mesh, P("keys", None)))
 
 
+def place_session_partition(p: int):
+    """Mesh device owning session-state partition ``p``.  Session runs
+    spread over the same ``("keys",)`` axis the window state shards on
+    (round-robin, the join-ring placement policy): hot partitions of a
+    sessionized job never funnel through one chip while a mesh windowed
+    aggregate holds the others.  None when the mesh is off — staged
+    planes then live on the default device."""
+    from .shuffle import partition_device
+
+    return partition_device(p)
+
+
 def make_bin_state(aggs: Tuple[AggSpec, ...], slide_micros: int,
                    width_micros: int, capacity: int = 0):
     """State factory for BinAggOperator: mesh-sharded when more than one

@@ -1065,6 +1065,8 @@ class Planner:
         post_fixups: Dict[str, Tuple[str, str]] = {}  # out -> (sum_col, cnt_col)
         int_outputs: List[str] = []
         str_outputs: List[str] = []
+        str_inputs: List[str] = []  # __ain* cols carrying object rows
+        udaf_subs: Dict[str, Expr] = {}  # __agg ref -> partial-combine AST
         needs_generic = isinstance(window, SessionWindow)
         for j, fc in enumerate(collector.aggs):
             out = f"__agg{j}"
@@ -1082,11 +1084,28 @@ class Planner:
                     raise SqlPlanError(
                         f"UDAF {fc.name}() takes exactly one column "
                         f"argument, got {len(fc.args)}")
+                sub = self._compile_udaf_partials(fc, arg, j, out, window,
+                                                  schema, pre_compiled,
+                                                  aggs)
+                if sub is not None:
+                    # decomposable numeric UDAF on a binned window:
+                    # hidden mergeable partial aggregates + an arithmetic
+                    # combine in the post-projection — the buffered
+                    # generic path (and its per-segment host loop) never
+                    # materializes
+                    udaf_subs[out] = sub
+                    continue
                 needs_generic = True  # buffered path only (not mergeable)
                 col = f"__ain{j}"
                 pre_compiled.append((col, compile_scalar(arg, schema)))
                 aggs.append(AggSpec(AggKind.UDAF, col, out,
                                     fn=UDAFS[fc.name]))
+                if self._infer_kind(arg, schema) == "s":
+                    # a string-fed UDAF ships the object column to the
+                    # buffered window; declare it so shardcheck's
+                    # sticky-route model (and the session-host-aggregate
+                    # finding) sees the host pin instead of a false "f"
+                    str_inputs.append(col)
                 continue
             if fc.distinct:
                 needs_generic = True
@@ -1129,6 +1148,20 @@ class Planner:
             pre_compiled.append((col, self._mask_fill(c, fill)))
             aggs.append(AggSpec(kind, col, out))
 
+        if udaf_subs:
+            # rewrite references to compiled-away UDAF outputs into their
+            # partial-combine expressions (post-projection AND HAVING see
+            # the mid-schema, where only the partial columns exist)
+            def sub_udaf(e: Expr) -> Expr:
+                if (isinstance(e, ColumnRef) and e.qualifier is None
+                        and e.name in udaf_subs):
+                    return udaf_subs[e.name]
+                return map_children(e, sub_udaf)
+
+            post_items = [(name, sub_udaf(e)) for name, e in post_items]
+            if having_rewritten is not None:
+                having_rewritten = sub_udaf(having_rewritten)
+
         pre_fn = _wrap_record(pre_compiled, [])
         pre_host = any(c.needs_host for _, c in pre_compiled)
         pname = f"agg_input_{self._next_id()}"
@@ -1150,8 +1183,9 @@ class Planner:
         pre_kinds = dict(key_kinds)
         for col, _c in pre_compiled:
             pre_kinds.setdefault(
-                col, "s" if any(a.column == col and a.output in str_outputs
-                                for a in aggs) else "f")
+                col, "s" if col in str_inputs
+                or any(a.column == col and a.output in str_outputs
+                       for a in aggs) else "f")
         stream = (planned.stream.udf(pre_fn, name=pname, sql=pre_tok,
                                      output_schema=pre_kinds)
                   if pre_host
@@ -1373,6 +1407,103 @@ class Planner:
             return map_children(x, walk)
 
         return repr(walk(e))
+
+    def _compile_udaf_partials(self, fc: FunctionCall, arg: Expr, j: int,
+                               out: str, window, schema: Schema,
+                               pre_compiled: List[Tuple[str, Compiled]],
+                               aggs: List[AggSpec]) -> Optional[Expr]:
+        """UDAF -> bin-agg channels at PLAN time: when the registered fn
+        probes as a member of the mergeable-partial algebra
+        (ops/udaf.py), emit hidden SUM/MIN/MAX partial aggregates over
+        (masked) input columns and return the arithmetic combine AST
+        that replaces the UDAF's output reference — so the query plans
+        onto the binned tumbling/sliding aggregator (KeyedBinState /
+        mesh channels) instead of the buffered generic window.  Returns
+        None to keep the buffered UDAF path (session windows buffer
+        rows anyway, and their segment reduce compiles the same plan at
+        fire time; non-decomposable fns stay host).
+
+        All-null windows: the N/N guard (NaN when the non-null count is
+        zero, 1 otherwise) reproduces the host loop's NaN for every
+        combine that is not already self-guarding through a division by
+        N.  ``ARROYO_UDAF_COMPILE=off`` disables the rewrite."""
+        import os
+
+        from ..ops.udaf import udaf_plan
+
+        if os.environ.get("ARROYO_UDAF_COMPILE", "on").lower() in (
+                "off", "0", "false", "no"):
+            return None
+        if not isinstance(window, (TumblingWindow, SlidingWindow)):
+            return None
+        from .functions import UDAFS
+
+        plan = udaf_plan(UDAFS[fc.name])
+        if plan is None:
+            return None
+        c = compile_scalar(arg, schema)
+        refs: Dict[str, ColumnRef] = {}
+
+        def channel(ch: str) -> ColumnRef:
+            if ch in refs:
+                return refs[ch]
+            col = f"__ain{j}_{ch}"
+            pout = f"{out}_{ch}"
+            if ch == "nnz":
+                pre_compiled.append((col, self._mask_indicator(c)))
+                aggs.append(AggSpec(AggKind.SUM, col, pout))
+            elif ch == "sum":
+                pre_compiled.append((col, self._mask_fill(c, 0.0)))
+                aggs.append(AggSpec(AggKind.SUM, col, pout))
+            elif ch == "sumsq":
+                sq = compile_scalar(BinaryOp("*", arg, arg), schema)
+                pre_compiled.append((col, self._mask_fill(sq, 0.0)))
+                aggs.append(AggSpec(AggKind.SUM, col, pout))
+            elif ch == "min":
+                pre_compiled.append((col, self._mask_fill(c, float("inf"))))
+                aggs.append(AggSpec(AggKind.MIN, col, pout))
+            else:  # max
+                pre_compiled.append((col,
+                                     self._mask_fill(c, float("-inf"))))
+                aggs.append(AggSpec(AggKind.MAX, col, pout))
+            refs[ch] = ColumnRef(pout)
+            return refs[ch]
+
+        N = channel("nnz")
+        guard = BinaryOp("/", N, N)  # NaN when nnz == 0, else 1
+
+        def centered(denom: Expr) -> Expr:
+            # single-pass variance: (Σx² - (Σx)²/n) / denom, cancellation
+            # residue clipped via abs (it only appears when var ≈ 0)
+            s, sq = channel("sum"), channel("sumsq")
+            num = BinaryOp("-", sq, BinaryOp("/", BinaryOp("*", s, s), N))
+            return FunctionCall("abs", [BinaryOp("/", num, denom)])
+
+        name = plan.name
+        if name == "count":
+            return BinaryOp("*", N, guard)
+        if name == "sum":
+            return BinaryOp("*", channel("sum"), guard)
+        if name == "mean":
+            return BinaryOp("/", channel("sum"), N)
+        if name == "min":
+            return BinaryOp("*", channel("min"), guard)
+        if name == "max":
+            return BinaryOp("*", channel("max"), guard)
+        if name == "ptp":
+            return BinaryOp("*", BinaryOp("-", channel("max"),
+                                          channel("min")), guard)
+        if name == "var_pop":
+            return centered(N)
+        if name == "var_samp":
+            return centered(BinaryOp("-", N, Literal(1, "int")))
+        if name == "std_pop":
+            return FunctionCall("sqrt", [centered(N)])
+        if name == "std_samp":
+            return FunctionCall("sqrt",
+                                [centered(BinaryOp("-", N,
+                                                   Literal(1, "int")))])
+        return None
 
     @staticmethod
     def _mask_indicator(c: Compiled) -> Compiled:

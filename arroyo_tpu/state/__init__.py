@@ -9,6 +9,8 @@ Import surface:
   sides' incrementally sorted, hot/cold-partitioned state (lazy:
   ``join_state`` pulls in the obs layer, which must not load while
   ``engine.operator`` is still importing ``state.tables``)
+* :class:`~arroyo_tpu.state.session_state.SessionRunState` — session
+  operators' partitioned interval runs (lazy for the same reason)
 """
 
 from .tables import (  # noqa: F401
@@ -31,6 +33,12 @@ _LAZY = {
     "join_partitions": ("arroyo_tpu.state.join_state", "join_partitions"),
     "partitioned_join_enabled": ("arroyo_tpu.state.join_state",
                                  "partitioned_join_enabled"),
+    "SessionRunState": ("arroyo_tpu.state.session_state",
+                        "SessionRunState"),
+    "session_state_enabled": ("arroyo_tpu.state.session_state",
+                              "session_state_enabled"),
+    "aggregate_session_registry": ("arroyo_tpu.state.session_state",
+                                   "aggregate_session_registry"),
 }
 
 

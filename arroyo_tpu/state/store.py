@@ -137,6 +137,34 @@ class StateStore:
         self._maybe_restore(name, table)
         return table
 
+    def get_session_state(self, name: str, desc: str = "") -> KeyedState:
+        """Session-window state: partition-adaptive sorted interval runs
+        (state/session_state.py) unless ARROYO_SESSION_STATE=legacy.
+        Both layouts checkpoint as the same KEYED ``[(time, key,
+        sessions)]`` entries, so epochs restore across layout changes
+        and rescale's key-range entry filter applies unchanged."""
+        from .session_state import SessionRunState, session_state_enabled
+
+        if not session_state_enabled():
+            return self.get_keyed_state(name, desc)
+        existing = self.tables.get(name)
+        if existing is not None:
+            if type(existing) is KeyedState:
+                # Operator.open() pre-registered (and possibly restored
+                # into) the dict layout before on_start could choose:
+                # upgrade in place, carrying the restored entries
+                table = SessionRunState()
+                table.restore(existing.snapshot())
+                self.tables[name] = table
+                return table
+            return existing
+        descriptor = TableDescriptor(name, TableType.KEYED, desc)
+        self.descriptors[name] = descriptor
+        table = SessionRunState()
+        self.tables[name] = table
+        self._maybe_restore(name, table)
+        return table
+
     def note_delete(self, table: str, key: Any) -> None:
         """Record a key tombstone for the next checkpoint (DataOperation::DeleteKey)."""
         self._pending_deletes.setdefault(table, []).append(key)

@@ -246,7 +246,14 @@ class GlobalKeyedState:
 
 class KeyedState:
     """kv with timestamp (keyed_map.rs); deletes produce tombstones so that
-    compaction/restore preserves removal."""
+    compaction/restore preserves removal.
+
+    Interchange contract: ``snapshot()`` / ``restore()`` speak
+    ``[(time, key, value)]`` entry lists — the canonical KEYED table
+    form every backend persists and filters by key range on rescale.
+    Alternate layouts serving the same table (the session-run state in
+    state/session_state.py) MUST round-trip this exact form so epochs
+    written under one layout restore under the other."""
 
     def __init__(self) -> None:
         self._data: Dict[Any, Tuple[int, Any]] = {}
@@ -277,6 +284,9 @@ class KeyedState:
 
     def __len__(self) -> int:
         return len(self._data)
+
+    def n_keys(self) -> int:
+        return len(self._data)  # table-size gauges count KEYS
 
 
 # ---------------------------------------------------------------------------

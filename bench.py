@@ -315,6 +315,12 @@ JOIN_STATE_COUNTERS = (
     "join_device_gather_rows", "join_host_gather_rows",
 )
 
+SESSION_COUNTERS = (
+    "session_merge_dispatches", "session_merge_device_dispatches",
+    "session_device_merge_rows", "session_host_merge_rows",
+    "udaf_channel_rows", "udaf_host_rows",
+)
+
 
 def _gather_share(stats: dict) -> dict:
     """Device-gather share of materialized join rows (PR 15's payload
@@ -1034,6 +1040,30 @@ def _config5_produce(broker_name: str, n: int, t0_micros: int,
              "ts": int(ts[j]) * 1000}).encode(), partition=0)
 
 
+def _session_stats(before: dict, n_events: int) -> dict:
+    """Session-state counter deltas since ``before`` + the last state
+    registry snapshot.  ``state_bounded`` asserts live session rows
+    track the ACTIVE key horizon (64 keys/burst block, a handful of
+    open sessions each), not the stream length — the contract the
+    expire mask-compression exists to keep."""
+    from arroyo_tpu.obs import perf
+    from arroyo_tpu.state.session_state import aggregate_session_registry
+
+    out = {k: perf.counter(k) - before[k] for k in SESSION_COUNTERS}
+    total_merge = out["session_device_merge_rows"] + \
+        out["session_host_merge_rows"]
+    out["device_merge_share"] = round(
+        out["session_device_merge_rows"] / total_merge, 4) \
+        if total_merge else None
+    reg = aggregate_session_registry(
+        perf.get_note("session_state_registry"))
+    if reg:
+        out["state"] = reg
+        out["state_bounded"] = reg["rows"] < 4096 and \
+            reg["rows"] < max(n_events // 8, 1024)
+    return out
+
+
 def run_config5() -> dict:
     """BASELINE.md config #5: session-window aggregation with a UDAF
     (median) over the Kafka source with 1s periodic checkpointing ON.
@@ -1041,16 +1071,10 @@ def run_config5() -> dict:
     a separate rate-limited run where event time == scheduled produce
     wall time."""
     import tempfile
-    import threading
 
     import numpy as np
 
-    from arroyo_tpu.connectors.kafka import InMemoryKafkaBroker
-    from arroyo_tpu.connectors.memory import (
-        clear_sink,
-        sink_arrivals,
-        sink_output,
-    )
+    from arroyo_tpu.connectors.memory import clear_sink, sink_output
     from arroyo_tpu.engine.engine import LocalRunner
     from arroyo_tpu.sql import SchemaProvider, plan_sql
 
@@ -1081,10 +1105,18 @@ def run_config5() -> dict:
         assert n_out > 0, "config5 produced no sessions"
         return dt, n_out
 
-    _config5_produce("bench5", min(n, 20_000), 0, 10)
+    # full-size warmup: a truncated topic under-warms — the end-of-run
+    # flush aggregates every closed session in ONE segment dispatch, so
+    # its padded-bucket shape scales with n and a smaller warmup leaves
+    # that compile INSIDE the timed run (profiled at ~12% of wall)
+    _config5_produce("bench5", n, 0, 10)
     clear_sink("results")
     LocalRunner(prog).run()
     _config5_produce("bench5", n, 0, 10)
+    from arroyo_tpu.obs import perf
+
+    before = {k: perf.counter(k) for k in SESSION_COUNTERS}
+    perf.note("session_state_registry", {})
     dt, n_out = timed_run()
     result = {
         "metric": "baseline5_session_udaf_kafka_events_per_sec",
@@ -1092,6 +1124,11 @@ def run_config5() -> dict:
         "unit": "events/sec",
         "sessions_emitted": n_out,
         "checkpoint_interval_secs": 1.0,
+        # session-state shape of the timed run: merge dispatches + the
+        # device/host row split the PR 19 state layout exists to move,
+        # plus the hot-partition/staging snapshot and the bounded-state
+        # verdict (state/session_state.py)
+        "sessions": _session_stats(before, n),
     }
 
     # latency: produce in real time at a fixed rate; event time equals the
@@ -1108,6 +1145,37 @@ def run_config5() -> dict:
     _config5_produce("bench5", 4_000, 0, 10)
     clear_sink("results")
     LocalRunner(lat_prog).run()
+    lat = _config5_latency(lat_prog, rate, n_lat,
+                           checkpoint_url=f"file://{ckpt}")
+    if lat:
+        result["latency_p50_ms"] = lat["p50_ms"]
+        result["latency_p99_ms"] = lat["p99_ms"]
+        result["latency_rate_events_per_sec"] = lat["rate_events_per_sec"]
+        # grouped view for the driver artifact, same shape as the q5
+        # headline's latency object (flat keys stay for continuity)
+        result["latency"] = lat
+    return result
+
+
+def _config5_latency(lat_prog, rate: float, n_lat: int,
+                     checkpoint_url=None):
+    """Rate-limited real-time latency run over the config5 topic with an
+    already-warmed program: event time == scheduled produce wall time,
+    so a session row's computable moment is wall_base + (window_end +
+    lateness - t0) / 1e6.  Returns {p50_ms, p99_ms,
+    rate_events_per_sec} or None when no steady-state samples landed."""
+    import threading
+
+    import numpy as np
+
+    from arroyo_tpu.connectors.kafka import InMemoryKafkaBroker
+    from arroyo_tpu.connectors.memory import (
+        clear_sink,
+        sink_arrivals,
+        sink_output,
+    )
+    from arroyo_tpu.engine.engine import LocalRunner
+
     InMemoryKafkaBroker.reset("bench5")
     broker = InMemoryKafkaBroker.get("bench5")
     broker.create_topic("sess", partitions=1)
@@ -1138,8 +1206,12 @@ def run_config5() -> dict:
     th = threading.Thread(target=producer, daemon=True)
     clear_sink("results")
     th.start()
-    LocalRunner(lat_prog, checkpoint_url=f"file://{ckpt}").run(
-        checkpoint_interval_secs=1.0)
+    runner = (LocalRunner(lat_prog, checkpoint_url=checkpoint_url)
+              if checkpoint_url else LocalRunner(lat_prog))
+    if checkpoint_url:
+        runner.run(checkpoint_interval_secs=1.0)
+    else:
+        runner.run()
     th.join()
     outs = sink_output("results")
     arrivals = sink_arrivals("results")
@@ -1152,17 +1224,122 @@ def run_config5() -> dict:
         wend = np.asarray(b.columns["window_end"], dtype=np.int64)
         computable = wall_base + (wend + lateness - t0_micros) / 1e6
         samples.extend(np.maximum(arr - computable, 0.0).tolist())
-    if samples:
-        s = np.asarray(samples)
-        result["latency_p50_ms"] = round(float(np.percentile(s, 50)) * 1e3, 1)
-        result["latency_p99_ms"] = round(float(np.percentile(s, 99)) * 1e3, 1)
-        result["latency_rate_events_per_sec"] = int(rate)
-        # grouped view for the driver artifact, same shape as the q5
-        # headline's latency object (flat keys stay for continuity)
-        result["latency"] = {"p50_ms": result["latency_p50_ms"],
-                             "p99_ms": result["latency_p99_ms"],
-                             "rate_events_per_sec": int(rate)}
-    return result
+    if not samples:
+        return None
+    s = np.asarray(samples)
+    return {"p50_ms": round(float(np.percentile(s, 50)) * 1e3, 1),
+            "p99_ms": round(float(np.percentile(s, 99)) * 1e3, 1),
+            "rate_events_per_sec": int(rate)}
+
+
+def run_sessions_family() -> dict:
+    """The ``sessions`` family: the config5 shape swept over the PR 19
+    knob matrix — session state {device sorted-runs, legacy per-key
+    dicts} x UDAF execution {vectorized channels, per-segment host
+    loop} — so the artifact shows WHERE the config5 speedup comes from
+    and that both axes produce identical rows.
+
+    Each combo records events/s, the session-merge dispatch counts and
+    device/host row split, the hot-partition/spill snapshot, and the
+    bounded-state verdict; the two session-state modes additionally
+    carry a short rate-limited latency block.  Before each timed run a
+    small SANITIZED run cross-checks row parity: every combo must hash
+    to the same sorted row digest."""
+    import hashlib
+
+    import numpy as np
+
+    from arroyo_tpu.connectors.memory import clear_sink, sink_output
+    from arroyo_tpu.engine.engine import LocalRunner
+    from arroyo_tpu.obs import perf
+    from arroyo_tpu.sql import SchemaProvider, plan_sql
+
+    n = int(os.environ.get("BENCH_SESS_EVENTS", 120_000))
+    p = SchemaProvider()
+    p.register_udaf("median", np.median)
+    prog = plan_sql(CONFIG5_SQL.format(b=4096, n=n), p,
+                    parallelism=bench_parallelism())
+    lat_rate = float(os.environ.get("BENCH_SESS_LAT_RATE", 30_000))
+    lat_secs = float(os.environ.get("BENCH_SESS_LAT_SECS", 2))
+    n_lat = int(lat_rate * lat_secs)
+    lat_prog = plan_sql(CONFIG5_SQL.format(b=512, n=n_lat), p)
+
+    knobs = ("ARROYO_SESSION_STATE", "ARROYO_UDAF_CHANNELS",
+             "ARROYO_SANITIZE")
+    saved = {k: os.environ.get(k) for k in knobs}
+
+    def digest_rows():
+        outs = sink_output("results")
+        rows = []
+        for b in outs:
+            names = sorted(b.columns)
+            for i in range(len(b)):
+                rows.append(tuple(
+                    round(float(b.columns[c][i]), 6) for c in names))
+        return hashlib.sha256(repr(sorted(rows)).encode()).hexdigest()[:16]
+
+    family: dict = {"events": n}
+    digests = {}
+    try:
+        for state in ("device", "legacy"):
+            for chan in ("on", "off"):
+                combo = f"{state}_{'channels' if chan == 'on' else 'host'}"
+                os.environ["ARROYO_SESSION_STATE"] = state
+                os.environ["ARROYO_UDAF_CHANNELS"] = chan
+                # parity probe: small run with the runtime sanitizer
+                # armed; doubles as the per-combo warmup
+                os.environ["ARROYO_SANITIZE"] = "1"
+                _config5_produce("bench5", 6_000, 0, 10)
+                clear_sink("results")
+                LocalRunner(prog).run()
+                digests[combo] = digest_rows()
+                os.environ["ARROYO_SANITIZE"] = "0"
+                _config5_produce("bench5", n, 0, 10)
+                clear_sink("results")
+                before = {k: perf.counter(k) for k in SESSION_COUNTERS}
+                perf.note("session_state_registry", {})
+                t0 = time.perf_counter()
+                LocalRunner(prog).run()
+                dt = time.perf_counter() - t0
+                n_out = sum(len(b) for b in sink_output("results"))
+                assert n_out > 0, f"sessions family {combo}: no output"
+                entry = {
+                    "events_per_sec": round(n / dt, 1),
+                    "sessions_emitted": n_out,
+                    "sessions": _session_stats(before, n),
+                }
+                if chan == "on":
+                    lat = _config5_latency(lat_prog, lat_rate, n_lat)
+                    if lat:
+                        entry["latency"] = lat
+                family[combo] = entry
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    family["parity_ok"] = len(set(digests.values())) == 1
+    family["row_digests"] = digests
+    dev = family.get("device_channels", {}).get("events_per_sec", 0)
+    leg = family.get("legacy_host", {}).get("events_per_sec", 0)
+    if leg:
+        family["speedup_vs_legacy_host"] = round(dev / leg, 2)
+    return family
+
+
+def emit_sessions_family():
+    """Sessions family: returned for embedding in the headline line."""
+    if os.environ.get("BENCH_SESSIONS", "1") in ("0", "false", "no"):
+        return None
+    try:
+        sf = run_sessions_family()
+    except Exception as e:  # the headline must still print
+        print(f"sessions bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return {"error": f"{type(e).__name__}: {e}"[:300]}
+    print(json.dumps({"sessions_family": sf}), file=sys.stderr)
+    return sf
 
 
 # -- kernel-level accelerator microbench ------------------------------------
@@ -2009,7 +2186,7 @@ def main_child() -> None:
                        BENCH_QUERY=name, BENCH_LAT_SECS="0",
                        BENCH_CONFIG5="0", BENCH_JOIN_STRESS="0",
                        BENCH_MESH_SWEEP="0", BENCH_FACTOR="0",
-                       BENCH_LATENCY="0")
+                       BENCH_LATENCY="0", BENCH_SESSIONS="0")
             try:
                 r = subprocess.run(
                     [sys.executable, os.path.abspath(__file__)], env=env,
@@ -2033,6 +2210,9 @@ def main_child() -> None:
         c5 = emit_config5(backend)
         if c5 is not None:
             headline_result["config5"] = c5
+        sf = emit_sessions_family()
+        if sf is not None:
+            headline_result["sessions_family"] = sf
         js = emit_join_stress()
         if js is not None:
             headline_result["join_stress"] = js
@@ -2056,6 +2236,9 @@ def main_child() -> None:
         c5 = emit_config5(backend)
         if c5 is not None:
             result["config5"] = c5
+        sf = emit_sessions_family()
+        if sf is not None:
+            result["sessions_family"] = sf
         js = emit_join_stress()
         if js is not None:
             result["join_stress"] = js

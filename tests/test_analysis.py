@@ -387,6 +387,7 @@ def test_fingerprints_stable_across_line_drift(tmp_path):
     assert fp(f1) == fp(f2)
 
 
+@pytest.mark.slow
 def test_cli_repo_is_green():
     """Acceptance: `python -m arroyo_tpu.analysis` exits 0 on the repo
     (zero unwaived findings against the checked-in baseline)."""

@@ -276,6 +276,7 @@ def test_connection_profiles_and_schema_test(api_env):
     _run(loop, go())
 
 
+@pytest.mark.slow
 def test_checkpoint_details_endpoint(api_env, tmp_path):
     """Per-operator checkpoint detail lists the parquet files an epoch
     wrote (get_checkpoint_details analog)."""
@@ -316,6 +317,7 @@ GROUP BY 1, tumble(interval '1 second')"""})
     _run(loop, go())
 
 
+@pytest.mark.slow
 def test_rest_rescale_running_pipeline(api_env):
     """PATCH /v1/pipelines/{id} with a new parallelism on a RUNNING job
     drives the controller's live rescale (checkpoint-stop, re-shard,
@@ -433,6 +435,7 @@ def test_rest_metrics_history_persists(api_env):
     _run(loop, scenario())
 
 
+@pytest.mark.slow
 def test_generated_client_black_box_lifecycle(api_env):
     """Spec-validated, runtime-GENERATED client (api/client.py) drives a
     full pipeline lifecycle — every call goes through an operation the
@@ -621,6 +624,7 @@ def test_cli_run_executes_sql(tmp_path):
     assert [row["counter"] for row in rows] == [0, 2, 4]
 
 
+@pytest.mark.slow
 def test_black_box_api_process(tmp_path):
     """Deploy-grade smoke: boot the real `api` role as an OS process
     (python -m arroyo_tpu api — controller + REST in one), drive a

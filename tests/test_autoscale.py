@@ -698,6 +698,7 @@ def test_prune_checkpoints_to_retention(tmp_path, monkeypatch):
                     "checkpoint-0000005"]
 
 
+@pytest.mark.slow
 def test_cluster_checkpoints_pruned_live(tmp_path, monkeypatch):
     """End-to-end: periodic checkpoints on a real cluster leave at most
     ``checkpoint_retention`` completed epochs in storage."""
@@ -770,6 +771,7 @@ def test_cluster_checkpoints_pruned_live(tmp_path, monkeypatch):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_autoscaler_live_rescale_e2e(tmp_path, monkeypatch):
     """An impulse load ramp drives the autoscaler through the REAL
     controller: the policy sees the job's rollups, actuates a live

@@ -417,6 +417,7 @@ def _q5_rows(path):
     return sorted((r["auction"], r["num"]) for r in rows)
 
 
+@pytest.mark.slow
 def test_q5_unchained_checkpoint_restores_chained_with_rescale(
         tmp_path, monkeypatch):
     """The headline round-trip: checkpoint a q5 plan UN-chained, restore

@@ -169,6 +169,7 @@ def test_cluster_pipeline(tmp_path, n_workers):
     assert sum(r["cnt"] for r in rows) == 2000
 
 
+@pytest.mark.slow
 def test_cluster_checkpoint_and_stop(tmp_path):
     """Periodic checkpoints complete at the job level; graceful stop with
     checkpoint reaches STOPPED; restart restores and finishes the stream."""
@@ -239,6 +240,7 @@ def test_cluster_checkpoint_and_stop(tmp_path):
     assert sum(r["cnt"] for r in rows) == 60_000
 
 
+@pytest.mark.slow
 def test_live_rescale_exactly_once(tmp_path):
     """Elastic rescale on a RUNNING cluster: checkpoint-stop, bump
     parallelism 2 -> 3 (state re-sharded by key range), resume, finish —

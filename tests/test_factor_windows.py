@@ -245,6 +245,7 @@ def _rows_of(path):
                       for line in f)
 
 
+@pytest.mark.slow
 def test_checkpoint_interchange_with_rescale(tmp_path, monkeypatch):
     """factored -> unfactored -> factored epoch interchange, with a
     2 -> 3 rescale applied at the final (factored) restore.  The factor

@@ -244,6 +244,7 @@ def test_hi_pad_collision_keeps_partition_host(monkeypatch):
     assert len(bsel) == 1 and rows.key_hash[0] == bad
 
 
+@pytest.mark.slow
 def test_payload_probe_batch_parity_and_counters(monkeypatch):
     """The fused device gather must emit bit-identical rows (every
     dtype kind the planes transport: f8/f4/i8/i4/u8/bool) to the host
@@ -396,7 +397,8 @@ def _run_join_sql(sql=JOIN_SQL, cols=("auction", "price", "reserve")):
 
 @pytest.mark.parametrize("device,probe,payload", [
     ("off", "search", "off"), ("on", "search", "off"),
-    ("on", "merged", "auto"), ("on", "search", "auto")])
+    pytest.param("on", "merged", "auto", marks=pytest.mark.slow),
+    ("on", "search", "auto")])
 def test_partitioned_vs_legacy_identical_rows(monkeypatch, device, probe,
                                               payload):
     """The sanitized parity matrix: partitioned and legacy join state

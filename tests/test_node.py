@@ -4,6 +4,8 @@ processes, reap them, and report WorkerFinished."""
 
 import asyncio
 
+import pytest
+
 from arroyo_tpu import Stream
 from arroyo_tpu.controller.controller import ControllerServer
 from arroyo_tpu.controller.scheduler import NodeScheduler
@@ -12,6 +14,7 @@ from arroyo_tpu.graph.logical import AggKind, AggSpec
 from arroyo_tpu.node import NodeServer
 
 
+@pytest.mark.slow
 def test_node_daemon_cluster(tmp_path):
     out_path = tmp_path / "out.jsonl"
 

@@ -102,6 +102,7 @@ def test_init_logging_sets_excepthook(monkeypatch):
         sys.excepthook = old
 
 
+@pytest.mark.slow
 def test_admin_profile_capture():
     """POST /debug/profile captures a jax profiler (Perfetto) trace — the
     pyroscope continuous-profiling analog."""

@@ -495,7 +495,8 @@ def _mesh_rt_rows(path):
 
 
 @pytest.mark.parametrize("first,second", [
-    ("2", "4"), ("4", "off"), ("off", "2")])
+    pytest.param("2", "4", marks=pytest.mark.slow), ("4", "off"),
+    pytest.param("off", "2", marks=pytest.mark.slow)])
 def test_mesh_checkpoint_interchange_engine_roundtrip(
         tmp_path, monkeypatch, first, second):
     """Mesh-state checkpoint interchange through the REAL engine

@@ -152,6 +152,7 @@ def test_worker_kill_mid_run_recovers_exactly_once(tmp_path, monkeypatch):
     assert sum(r["cnt"] for r in rows) == N  # exactly-once across the kill
 
 
+@pytest.mark.slow
 def test_mesh_sharded_state_inside_cluster_worker(tmp_path, monkeypatch):
     """A real TPU pod is one worker x many chips: run the mesh-sharded
     BinAgg state INSIDE a process-cluster worker (ARROYO_MESH=8 over the
@@ -245,6 +246,7 @@ def test_mesh_sharded_state_inside_cluster_worker(tmp_path, monkeypatch):
         f"no 8-shard mesh checkpoint found (saw {shards_seen})")
 
 
+@pytest.mark.slow
 def test_controller_crash_resumes_job_from_durable_store(tmp_path, monkeypatch):
     """Durable controller (states/mod.rs:577-628 analog): submit a
     checkpointing job, CRASH the controller (no graceful stop — workers
@@ -387,6 +389,7 @@ def test_expired_ttl_job_settles_on_controller_restart(tmp_path):
     asyncio.run(two(jid))
 
 
+@pytest.mark.slow
 def test_live_ttl_survives_controller_restart(tmp_path):
     """A ttl job restarted BEFORE its deadline resumes — and the new
     controller's supervisor still stops it when the deadline passes."""

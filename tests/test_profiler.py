@@ -51,6 +51,7 @@ def _run_pipeline():
     return dt
 
 
+@pytest.mark.slow
 def test_phase_accounting_sums_to_wall():
     """The work phases must account for (nearly) all of the run's wall
     time on a tiny pipeline — the invariant that keeps every future

@@ -1,0 +1,365 @@
+"""Device-resident session window state (state/session_state.py, PR 19).
+
+The correctness spine the ISSUE names:
+
+- sanitized device-vs-legacy parity: identical rows out of the session
+  operator under both state layouts, on fuzzed multi-batch streams;
+- the max-session clamp falls back per key to the authoritative host
+  merge — bit-for-bit with legacy (the union-span>MAX condition is
+  EXACTLY the legacy clamp condition, ops/session.py docstring);
+- state stays bounded under session churn (expire mask-compresses rows
+  out; nothing leaks);
+- checkpoint interchange: both layouts snapshot as the same KEYED
+  ``[(time, key, sessions)]`` entries, so epochs restore legacy->device
+  and device->legacy, and rescale's key-range entry filter applies
+  (2 -> 3 split emulated at the table level + a full engine
+  crash/restore flip in both directions);
+- the vectorized interval-union kernel agrees with a brute-force
+  oracle on fuzzed inputs.
+"""
+
+import asyncio
+import json
+import os
+
+import numpy as np
+import pytest
+
+from arroyo_tpu import AggKind, AggSpec, Batch, SessionWindow, Stream
+from arroyo_tpu.connectors.memory import clear_sink, sink_output
+from arroyo_tpu.engine.engine import Engine, LocalRunner
+from arroyo_tpu.obs import perf
+from arroyo_tpu.state.session_state import SessionRunState
+from arroyo_tpu.state.tables import KeyedState
+from arroyo_tpu.types import StopMode
+
+MS = 1_000
+SEC = 1_000_000
+
+AGGS = [AggSpec(AggKind.COUNT, None, "cnt"),
+        AggSpec(AggKind.SUM, "v", "total"),
+        AggSpec(AggKind.MIN, "v", "lo"),
+        AggSpec(AggKind.MAX, "v", "hi"),
+        AggSpec(AggKind.AVG, "v", "mean")]
+
+
+def _run_sessions(batches, mode, gap=300 * MS, aggs=AGGS, sink="ss_out"):
+    """Run the session pipeline with ARROYO_SESSION_STATE=mode; the
+    sanitizer is armed by conftest for every run."""
+    prev = os.environ.get("ARROYO_SESSION_STATE")
+    os.environ["ARROYO_SESSION_STATE"] = mode
+    try:
+        clear_sink(sink)
+        prog = (Stream.source("memory", {"batches": batches})
+                .watermark(max_lateness_micros=0)
+                .key_by("k")
+                .window(SessionWindow(gap), aggs)
+                .sink("memory", {"name": sink}))
+        LocalRunner(prog).run()
+        outs = sink_output(sink)
+        return Batch.concat(outs) if outs else None
+    finally:
+        if prev is None:
+            os.environ.pop("ARROYO_SESSION_STATE", None)
+        else:
+            os.environ["ARROYO_SESSION_STATE"] = prev
+
+
+def _rows(out):
+    if out is None:
+        return []
+    names = sorted(out.columns)
+    return sorted(
+        tuple(round(float(out.columns[c][i]), 9) for c in names)
+        for i in range(len(out)))
+
+
+def _session_batches(rng, n_batches=4, n=1200, n_keys=40, span=4 * SEC):
+    """Bursty per-key event times so sessions both merge and close."""
+    batches = []
+    t0 = 0
+    for _ in range(n_batches):
+        ts = np.sort(rng.integers(t0, t0 + span, n)).astype(np.int64)
+        batches.append(Batch(ts, {
+            "k": rng.integers(0, n_keys, n).astype(np.int64),
+            "v": rng.integers(1, 100, n).astype(np.int64)}))
+        t0 += span + rng.integers(0, SEC)
+    return batches
+
+
+def test_device_vs_legacy_parity_fuzz(rng):
+    """The acceptance spine: identical rows out of the session operator
+    under device sorted-run state vs the legacy per-key dict path, with
+    the sanitizer armed, on a fuzzed multi-batch stream."""
+    batches = _session_batches(rng)
+    dev = _run_sessions(batches, "device")
+    leg = _run_sessions(batches, "legacy")
+    assert dev is not None and leg is not None
+    assert _rows(dev) == _rows(leg)
+    assert len(dev) > 50  # non-vacuous: real session churn happened
+
+
+def test_device_parity_single_key_dense(rng):
+    """One hot key with dense timestamps: maximal interval-merge work
+    per dispatch (every batch touches the same resident run)."""
+    batches = []
+    t0 = 0
+    for _ in range(3):
+        ts = np.sort(rng.integers(t0, t0 + 2 * SEC, 500)).astype(np.int64)
+        batches.append(Batch(ts, {"k": np.zeros(500, np.int64),
+                                  "v": np.ones(500, np.int64)}))
+        t0 += 3 * SEC  # gap > session gap: prior session closes
+    dev = _run_sessions(batches, "device")
+    leg = _run_sessions(batches, "legacy")
+    assert _rows(dev) == _rows(leg)
+    assert len(dev) >= 3
+
+
+def test_clamp_fallback_parity_and_counted(rng):
+    """Events chaining past MAX_SESSION_SIZE route through the per-key
+    host fallback (the union-span>MAX flag) and must match legacy
+    bit-for-bit; the fallback is COUNTED (session_host_merge_rows), so
+    a config5 triage can see sessions riding host."""
+    from arroyo_tpu.engine.operators_window import MAX_SESSION_SIZE_MICROS
+
+    MAX = MAX_SESSION_SIZE_MICROS
+    ts1 = np.arange(0, MAX - 5 * SEC + 1, 9 * SEC, dtype=np.int64)
+    ts2 = np.array([MAX - 1, MAX + 2], dtype=np.int64)
+    batches = [
+        Batch(ts1, {"k": np.full(len(ts1), 7, np.int64),
+                    "v": np.ones(len(ts1), np.int64)}),
+        Batch(ts2, {"k": np.full(2, 7, np.int64),
+                    "v": np.ones(2, np.int64)})]
+    before = perf.counter("session_host_merge_rows")
+    dev = _run_sessions(batches, "device", gap=10 * SEC)
+    host_rows = perf.counter("session_host_merge_rows") - before
+    leg = _run_sessions(batches, "legacy", gap=10 * SEC)
+    assert _rows(dev) == _rows(leg)
+    assert host_rows > 0, \
+        "clamp chain must exercise the counted host fallback"
+
+
+# ---------------------------------------------------------------------------
+# table-level: union oracle, bounded churn, snapshot interchange
+# ---------------------------------------------------------------------------
+
+
+def _oracle_merge(sessions, st, en):
+    """Brute-force insert [st, en) into a sorted interval list, merging
+    on touch-or-overlap (the union kernel's st <= prev_en rule)."""
+    sessions = sorted(sessions + [(st, en)])
+    out = [sessions[0]]
+    for s, e in sessions[1:]:
+        ps, pe = out[-1]
+        if s <= pe:
+            out[-1] = (ps, max(pe, e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def test_merge_intervals_matches_oracle_fuzz(rng):
+    state = SessionRunState(n_partitions=8, max_span=1 << 62)
+    oracle = {}
+    gap = 50
+    for _ in range(30):
+        nk = int(rng.integers(1, 12))
+        keys = rng.choice(
+            np.arange(1, 25, dtype=np.uint64), nk, replace=False)
+        ikh, ist, ien, itm = [], [], [], []
+        for k in np.sort(keys):
+            for _ in range(int(rng.integers(1, 5))):
+                t = int(rng.integers(0, 10_000))
+                ikh.append(k)
+                ist.append(t)
+                ien.append(t + gap)
+                itm.append(t)
+        order = np.lexsort((np.array(ist), np.array(ikh, dtype=np.uint64)))
+        ikh = np.array(ikh, dtype=np.uint64)[order]
+        ist = np.array(ist, dtype=np.int64)[order]
+        ien = np.array(ien, dtype=np.int64)[order]
+        itm = np.array(itm, dtype=np.int64)[order]
+        flagged = state.merge_intervals(ikh, ist, ien, itm)
+        assert len(flagged) == 0
+        for k, s, e in zip(ikh.tolist(), ist.tolist(), ien.tolist()):
+            oracle[k] = _oracle_merge(oracle.get(k, []), s, e)
+    for k, expect in oracle.items():
+        assert state.get(np.uint64(k)) == expect, k
+    assert state.n_keys() == len(oracle)
+
+
+def test_expire_fires_and_stays_bounded(rng):
+    """Session churn: repeated merge + expire cycles mask-compress rows
+    out; fired sessions match the oracle and the table drains to empty
+    (the state_bounded contract)."""
+    state = SessionRunState(n_partitions=4, max_span=1 << 62)
+    n_fired = 0
+    live = {}
+    t0 = 0
+    for _round in range(12):
+        keys = np.sort(rng.choice(
+            np.arange(1, 30, dtype=np.uint64), 8, replace=False))
+        st = np.array([t0 + int(rng.integers(0, 50)) for _ in keys],
+                      dtype=np.int64)
+        ikh = keys
+        ien = st + 40
+        state.merge_intervals(ikh, st, ien, st.copy())
+        for k, s, e in zip(ikh.tolist(), st.tolist(), ien.tolist()):
+            live[k] = _oracle_merge(live.get(k, []), s, e)
+        t0 += 200  # next round starts past every open end
+        fk, fs, fe, removed = state.expire(t0)
+        got = sorted(zip(fk.tolist(), fs.tolist(), fe.tolist()))
+        expect = sorted((k, s, e) for k, ivs in live.items()
+                        for s, e in ivs if e <= t0)
+        assert got == expect
+        for k in list(live):
+            live[k] = [iv for iv in live[k] if iv[1] > t0]
+            if not live[k]:
+                del live[k]
+                assert k in [int(r) for r in removed]
+        n_fired += len(got)
+    assert not live
+    assert len(state) == 0 and state.stats()["rows"] == 0
+    assert n_fired >= 12 * 8  # every inserted session fired exactly once
+
+
+def test_snapshot_interchange_both_directions(rng):
+    """Both layouts emit the same KEYED [(time, key, sessions)] entry
+    form: device snapshot restores into the legacy dict table and back,
+    preserving every key's sessions and timestamps."""
+    state = SessionRunState(n_partitions=8, max_span=1 << 62)
+    for k in range(1, 20):
+        kh = np.uint64(k * 1031)
+        n = int(rng.integers(1, 4))
+        sts = np.sort(rng.choice(
+            np.arange(0, 50, dtype=np.int64) * 100, n, replace=False))
+        state.merge_intervals(
+            np.full(n, kh, dtype=np.uint64), sts.astype(np.int64),
+            (sts + 60).astype(np.int64),
+            np.full(n, int(sts.max()), np.int64))
+    snap = state.snapshot()
+
+    legacy = KeyedState()
+    legacy.restore(snap)
+    assert legacy.n_keys() == state.n_keys()
+    for t, k, v in snap:
+        assert legacy.get(k) == state.get(k)
+        assert legacy.get_time(k) == state.get_time(k)
+
+    back = SessionRunState(n_partitions=2, max_span=1 << 62)
+    back.restore(legacy.snapshot())
+    assert back.n_keys() == state.n_keys()
+    for _t, k, _v in snap:
+        assert back.get(k) == state.get(k)
+        assert back.get_time(k) == state.get_time(k)
+
+
+def test_rescale_entry_filter_2_to_3(rng):
+    """Rescale restores each subtask from a key-range FILTER of the
+    snapshot entries (state/backend.py _deserialize_rows): emulate the
+    2 -> 3 split at the table level — three disjoint filtered restores
+    must partition the key set exactly, with no key owned twice."""
+    state = SessionRunState(n_partitions=8, max_span=1 << 62)
+    keys = rng.choice(np.arange(1, 1 << 20, dtype=np.uint64), 64,
+                      replace=False)
+    for kh in keys:
+        t = int(rng.integers(0, 1000))
+        state.merge_intervals(
+            np.array([kh], dtype=np.uint64),
+            np.array([t], dtype=np.int64),
+            np.array([t + 10], dtype=np.int64),
+            np.array([t], dtype=np.int64))
+    snap = state.snapshot()
+    hi = 1 << 20
+    cuts = [0, hi // 3, 2 * hi // 3, hi]
+    shards = []
+    for i in range(3):
+        part = SessionRunState(n_partitions=4, max_span=1 << 62)
+        part.restore([(t, k, v) for (t, k, v) in snap
+                      if cuts[i] <= int(k) < cuts[i + 1]])
+        shards.append(part)
+    owned = [set(int(k) for k, _v in s.items()) for s in shards]
+    assert not (owned[0] & owned[1]) and not (owned[1] & owned[2]) \
+        and not (owned[0] & owned[2])
+    assert owned[0] | owned[1] | owned[2] == set(int(k) for k in keys)
+    for s in shards:
+        for k, sessions in s.items():
+            assert sessions == state.get(np.uint64(k))
+
+
+# ---------------------------------------------------------------------------
+# full engine: checkpoint under one layout, restore under the other
+# ---------------------------------------------------------------------------
+
+
+def _session_restore_flip(tmp_path, first_mode, second_mode):
+    url = f"file://{tmp_path}/ckpt"
+    out_path = f"{tmp_path}/out.jsonl"
+    job = f"session-flip-{first_mode}-{second_mode}"
+    total = 2000
+
+    def build():
+        return (Stream.source("impulse", {
+                    "event_rate": 30_000.0, "message_count": total,
+                    "event_time_interval_micros": 1000, "batch_size": 100})
+                .watermark(max_lateness_micros=0)
+                .map(lambda c: {"counter": c["counter"],
+                                "bucket": c["counter"] % 7}, name="b")
+                .key_by("bucket")
+                .window(SessionWindow(20 * MS),
+                        [AggSpec(AggKind.COUNT, None, "cnt"),
+                         AggSpec(AggKind.SUM, "counter", "sum_c")])
+                .sink("single_file", {"path": out_path}))
+
+    async def run_with_crash():
+        eng = Engine.for_local(build(), job, checkpoint_url=url)
+        running = eng.start()
+        await asyncio.sleep(0.04)
+        await running.checkpoint(1)
+        assert await running.wait_for_checkpoint(1)
+        await running.stop(StopMode.IMMEDIATE)
+        try:
+            await running.join()
+        except RuntimeError:
+            pass
+
+    async def run_restored():
+        eng = Engine.for_local(build(), job, checkpoint_url=url,
+                               restore_epoch=1)
+        running = eng.start()
+        await running.join()
+
+    prev = os.environ.get("ARROYO_SESSION_STATE")
+    try:
+        os.environ["ARROYO_SESSION_STATE"] = first_mode
+        asyncio.run(run_with_crash())
+        os.environ["ARROYO_SESSION_STATE"] = second_mode
+        asyncio.run(run_restored())
+    finally:
+        if prev is None:
+            os.environ.pop("ARROYO_SESSION_STATE", None)
+        else:
+            os.environ["ARROYO_SESSION_STATE"] = prev
+
+    rows = [json.loads(l) for l in open(out_path)]
+    # exactly-once across the layout flip: every event counted once
+    assert sum(r["cnt"] for r in rows) == total
+    assert sum(r["sum_c"] for r in rows) == total * (total - 1) // 2
+    seen = set()
+    for r in rows:
+        key = (r["bucket"], r["window_start"])
+        assert key not in seen, f"duplicate session emission {key}"
+        seen.add(key)
+
+
+def test_checkpoint_device_then_restore_legacy(tmp_path):
+    """Open sessions checkpointed by the sorted-run layout restore into
+    the legacy dict layout exactly-once (rollback interchange)."""
+    _session_restore_flip(tmp_path, "device", "legacy")
+
+
+def test_checkpoint_legacy_then_restore_device(tmp_path):
+    """Legacy-epoch checkpoints upgrade in place into the sorted-run
+    layout on restore (forward interchange: the get_session_state
+    in-place upgrade path)."""
+    _session_restore_flip(tmp_path, "legacy", "device")

@@ -696,3 +696,79 @@ def test_recompile_hazard_repo_layers_clean():
             findings = recompile_hazard.check(
                 ast.parse(src), src.splitlines(), path)
             assert not findings, [f.render() for f in findings]
+
+
+# ---------------------------------------------------------------------------
+# session run state (PR 19): plan-time placement + the sticky host fire
+# ---------------------------------------------------------------------------
+
+
+def _session_udaf_plan(agg_arg: str):
+    """config5-shape session plan with a UDAF over ``agg_arg`` (a
+    string column for 'name', numeric for 'v')."""
+    import numpy as np
+
+    from arroyo_tpu import Batch
+    from arroyo_tpu.sql import SchemaProvider, plan_sql, unregister_udfs
+
+    unregister_udfs()
+    sec = 1_000_000
+    p = SchemaProvider()
+    rng = np.random.default_rng(7)
+    n = 32
+    ts = np.sort(rng.integers(0, 3 * sec, n)).astype(np.int64)
+    p.add_memory_table("events", {"k": "i", "v": "f", "name": "s"}, [
+        Batch(ts, {"k": rng.integers(0, 4, n).astype(np.int64),
+                   "v": rng.random(n).astype(np.float64),
+                   "name": np.array(["u"] * n, dtype=object)})])
+    p.register_udaf("agg_fn", lambda vals: 0.0)
+    return plan_sql(
+        "CREATE TABLE out WITH (connector='memory', name='results'); "
+        f"INSERT INTO out SELECT k, agg_fn({agg_arg}) as a, count(*) as c "
+        "FROM events GROUP BY k, session(interval '1 second')", p)
+
+
+def test_session_string_udaf_warns_host_aggregate(monkeypatch):
+    """A string column feeding a session-window UDAF behind device
+    session runs is the designed sticky host fallback: interval merges
+    ride the device union kernel but every fire replays the per-segment
+    host loop.  shardcheck surfaces it as the session analog of
+    payload-host-gather; under ARROYO_SESSION_STATE=legacy everything
+    is host by design and the finding is suppressed.  A numeric UDAF
+    arg stays clean (it either compiles to channels or host-loops over
+    f64 rows that pack fine)."""
+    from arroyo_tpu.sql import unregister_udfs
+
+    monkeypatch.delenv("ARROYO_SESSION_STATE", raising=False)
+    try:
+        prog = _session_udaf_plan("name")
+        rep = analyze(prog, nk=8)
+        assert not rep.errors(), [d.render() for d in rep.errors()]
+        assert not rep.predicted_reshards
+        warns = [d for d in rep.diagnostics
+                 if d.code == "session-host-aggregate"]
+        assert warns and "'__ain0'" in warns[0].message, \
+            [d.render() for d in rep.diagnostics]
+
+        monkeypatch.setenv("ARROYO_SESSION_STATE", "legacy")
+        assert not [d for d in analyze(prog, nk=8).diagnostics
+                    if d.code == "session-host-aggregate"], \
+            "legacy session state is all-host by design: nothing to flag"
+        monkeypatch.delenv("ARROYO_SESSION_STATE")
+
+        clean = analyze(_session_udaf_plan("v"), nk=8)
+        assert not [d for d in clean.diagnostics
+                    if d.code == "session-host-aggregate"], \
+            [d.render() for d in clean.diagnostics]
+    finally:
+        unregister_udfs()
+
+
+def test_sessions_sweep_shape_registered():
+    """The repo-level plan sweep carries a config5-shape session
+    window: the device session-run placement must prove out at zero
+    errors / zero predicted reshards just like the hop and join shapes
+    (test_sweep_plans_clean_at_both_parallelisms iterates the dict, so
+    this only pins that the shape is actually IN the sweep)."""
+    assert "sessions" in _SWEEP_SQL
+    assert "session(INTERVAL" in _SWEEP_SQL["sessions"]

@@ -294,6 +294,7 @@ def test_compaction_preserves_batch_and_global_tables(backend):
     assert rb is not None and list(rb.columns["s"]) == ["a", "b", "c"]
 
 
+@pytest.mark.slow
 def test_controller_compaction_cycle(tmp_path):
     """LocalRunner-style engine + manual compaction via the backend matches
     the controller path: checkpoint N epochs, compact one, restore from it."""

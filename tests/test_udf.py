@@ -198,3 +198,196 @@ def test_udaf_distinct_and_arity_rejected():
     with pytest.raises(SqlPlanError, match="exactly one column"):
         plan_sql(base + "SELECT k, median(v, k) FROM events "
                  "GROUP BY k, tumble(interval '1 second')", p)
+
+
+# ---------------------------------------------------------------------------
+# vectorized UDAF channels (ops/udaf.py, PR 19): numeric UDAFs compile
+# onto mergeable sum/nnz/min/max/sumsq partials instead of the
+# per-segment host loop
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def _fresh_verdicts():
+    from arroyo_tpu.ops import udaf
+
+    saved = dict(udaf._verdicts)
+    udaf._verdicts.clear()
+    yield
+    udaf._verdicts.clear()
+    udaf._verdicts.update(saved)
+
+
+@pytest.mark.parametrize("fn,expect", [
+    (np.sum, "sum"),
+    (np.mean, "mean"),
+    (np.min, "min"),
+    (np.max, "max"),
+    (np.ptp, "ptp"),
+    (np.var, "var_pop"),
+    (np.std, "std_pop"),
+    (lambda v: np.var(v, ddof=1), "var_samp"),
+    (lambda v: np.std(v, ddof=1), "std_samp"),
+    (len, "count"),
+    (lambda v: float(v.sum() / len(v)), "mean"),
+    (np.median, None),
+    (lambda v: float(np.percentile(v, 90)), None),
+    (lambda v: "not a number", None),
+])
+def test_udaf_probe_classification(_fresh_verdicts, fn, expect):
+    """Behavioral probing against the partial algebra: extensional
+    equality on the dyadic probe vectors decides the plan, so np.mean
+    and a hand-rolled mean both compile; order statistics and
+    non-numeric returns stay on the host loop."""
+    from arroyo_tpu.ops.udaf import udaf_plan
+
+    plan = udaf_plan(fn)
+    if expect is None:
+        assert plan is None
+    else:
+        assert plan is not None and plan.name == expect
+        assert "nnz" in plan.channels
+
+
+def test_udaf_verdict_sticky_and_knob(_fresh_verdicts, monkeypatch):
+    from arroyo_tpu.ops import udaf
+
+    calls = []
+
+    def counting_mean(v):
+        calls.append(1)
+        return np.mean(v)
+
+    assert udaf.udaf_plan(counting_mean).name == "mean"
+    probes = len(calls)
+    assert udaf.udaf_plan(counting_mean).name == "mean"
+    assert len(calls) == probes, "verdict must be sticky per fn object"
+
+    monkeypatch.setenv("ARROYO_UDAF_CHANNELS", "off")
+    udaf._verdicts.clear()
+    assert udaf.udaf_plan(np.mean) is None, \
+        "channels off: every UDAF takes the counted host loop"
+
+
+def test_segment_udaf_channel_matches_host_loop(rng, _fresh_verdicts,
+                                                monkeypatch):
+    """segment_aggregate parity: the channel path and the per-segment
+    host loop agree to float tolerance on fuzzed segments with nulls,
+    and all-null segments emit NaN on both."""
+    from arroyo_tpu.graph.logical import AggKind, AggSpec as LAggSpec
+    from arroyo_tpu.ops.segment import segment_aggregate
+
+    n = 4000
+    kh = rng.integers(0, 60, n).astype(np.uint64)
+    ts = np.sort(rng.integers(0, 10 * SEC, n)).astype(np.int64)
+    vals = rng.random(n) * 100 - 50
+    vals[rng.random(n) < 0.1] = np.nan
+    vals[kh == 7] = np.nan  # one all-null key
+    fns = [np.mean, np.var, lambda v: np.std(v, ddof=1), np.sum]
+    aggs = tuple(
+        LAggSpec(AggKind.UDAF, "v", f"o{i}", fn=fn)
+        for i, fn in enumerate(fns))
+
+    uniq_c, cols_c, _t, _n, vc_c = segment_aggregate(
+        kh, ts, {"v": vals}, aggs)
+    monkeypatch.setenv("ARROYO_UDAF_CHANNELS", "off")
+    uniq_h, cols_h, _t, _n, vc_h = segment_aggregate(
+        kh, ts, {"v": vals}, aggs)
+
+    np.testing.assert_array_equal(uniq_c, uniq_h)
+    for i in range(len(fns)):
+        np.testing.assert_allclose(
+            cols_c[f"o{i}"], cols_h[f"o{i}"], rtol=1e-9, atol=1e-9,
+            equal_nan=True)
+        np.testing.assert_array_equal(vc_c[f"o{i}"], vc_h[f"o{i}"])
+    i7 = np.searchsorted(uniq_c, 7)
+    assert np.isnan(cols_c["o0"][i7]), "all-null segment must emit NaN"
+
+
+def test_udaf_channel_counters_split(rng, _fresh_verdicts):
+    """The sticky fallback is COUNTED: channel-compiled rows on
+    udaf_channel_rows, host-loop rows on udaf_host_rows."""
+    from arroyo_tpu.graph.logical import AggKind, AggSpec as LAggSpec
+    from arroyo_tpu.obs import perf
+    from arroyo_tpu.ops.segment import segment_aggregate
+
+    n = 512
+    kh = rng.integers(0, 8, n).astype(np.uint64)
+    ts = np.sort(rng.integers(0, SEC, n)).astype(np.int64)
+    vals = rng.random(n)
+    c0 = perf.counter("udaf_channel_rows")
+    h0 = perf.counter("udaf_host_rows")
+    segment_aggregate(kh, ts, {"v": vals}, (
+        LAggSpec(AggKind.UDAF, "v", "m", fn=np.mean),
+        LAggSpec(AggKind.UDAF, "v", "p", fn=lambda v: float(
+            np.percentile(v, 90)))))
+    assert perf.counter("udaf_channel_rows") - c0 == n
+    assert perf.counter("udaf_host_rows") - h0 == n
+
+
+def test_planner_compiles_udaf_to_binned_partials(_fresh_verdicts):
+    """A decomposable numeric UDAF on a tumbling window plans onto the
+    BINNED aggregator (hidden partial aggs + arithmetic combine) — the
+    buffered generic window operator never materializes — and the
+    output matches a per-window numpy oracle."""
+    from arroyo_tpu.graph.logical import OpKind
+
+    p = SchemaProvider()
+    p.register_udaf("my_var", np.var)
+    p.register_udaf("my_mean", lambda v: v.mean())
+    events_table(p)
+    sql = ("CREATE TABLE out WITH (connector='memory', name='results');"
+           "INSERT INTO out SELECT k, my_var(v) as vv, my_mean(v) as mv, "
+           "count(*) as cnt FROM events "
+           "GROUP BY k, tumble(interval '1 second')")
+    prog = plan_sql(sql, p)
+    kinds = [prog.node(op).operator.kind for op in prog.graph.nodes]
+    assert OpKind.TUMBLING_WINDOW_AGGREGATOR in kinds
+    assert OpKind.WINDOW not in kinds, \
+        "decomposable UDAFs must not force the buffered generic path"
+
+    clear_sink("results")
+    LocalRunner(prog).run()
+    out = Batch.concat(sink_output("results"))
+    src = events_table(SchemaProvider()).get("events").config["batches"][0]
+    groups = {}
+    for t, k, v in zip(src.timestamp.tolist(), src.columns["k"].tolist(),
+                       src.columns["v"].tolist()):
+        groups.setdefault((k, (t // SEC + 1) * SEC), []).append(v)
+    assert len(out) == len(groups)
+    for i in range(len(out)):
+        key = (int(out.columns["k"][i]), int(out.columns["window_end"][i]))
+        vals = np.asarray(groups[key])
+        assert out.columns["cnt"][i] == len(vals)
+        assert out.columns["vv"][i] == pytest.approx(np.var(vals),
+                                                     rel=1e-8)
+        assert out.columns["mv"][i] == pytest.approx(np.mean(vals),
+                                                     rel=1e-9)
+
+
+def test_planner_udaf_compile_knob_forces_generic(_fresh_verdicts,
+                                                  monkeypatch):
+    """ARROYO_UDAF_COMPILE=off pins the pre-PR buffered plan shape (the
+    A/B axis) — and the generic path still computes the same numbers."""
+    from arroyo_tpu.graph.logical import OpKind
+
+    monkeypatch.setenv("ARROYO_UDAF_COMPILE", "off")
+    p = SchemaProvider()
+    p.register_udaf("my_var", np.var)
+    events_table(p)
+    sql = ("CREATE TABLE out WITH (connector='memory', name='results');"
+           "INSERT INTO out SELECT k, my_var(v) as vv FROM events "
+           "GROUP BY k, tumble(interval '1 second')")
+    prog = plan_sql(sql, p)
+    kinds = [prog.node(op).operator.kind for op in prog.graph.nodes]
+    assert OpKind.WINDOW in kinds
+    out = run_sql(sql, p)
+    src = events_table(SchemaProvider()).get("events").config["batches"][0]
+    groups = {}
+    for t, k, v in zip(src.timestamp.tolist(), src.columns["k"].tolist(),
+                       src.columns["v"].tolist()):
+        groups.setdefault((k, (t // SEC + 1) * SEC), []).append(v)
+    for i in range(len(out)):
+        key = (int(out.columns["k"][i]), int(out.columns["window_end"][i]))
+        assert out.columns["vv"][i] == pytest.approx(
+            np.var(np.asarray(groups[key])), rel=1e-8)

@@ -48,7 +48,14 @@
 #      critical path to a named stage, and round-trip the SLO verdict
 #      through REST GET/PUT /v1/jobs/{id}/slo + GET .../latency;
 #  11. tests/test_obs.py + tests/test_profiler.py +
-#      tests/test_latency.py — the observability contract suites.
+#      tests/test_latency.py — the observability contract suites;
+#  12. session run state: the SAME tiny sessionized Nexmark query
+#      (session-gap window, count + avg) under ARROYO_SESSION_STATE=
+#      device vs =legacy, sanitizer armed, must emit IDENTICAL rows —
+#      the shared-checkpoint contract behind the device-resident
+#      interval runs — with the session_device_merge_rows counter
+#      proving the device union kernel actually merged when armed and
+#      stayed silent under legacy.
 #
 # Budget: the whole gate stays under ~90s.
 #
@@ -232,6 +239,77 @@ if dev_off != 0:
 print(f"smoke: join-state equivalence ok ({len(rows_on)} rows, "
       f"device-payload == host-gather == legacy; {dev_on} rows via "
       "device planes when armed)")
+PY
+
+python - <<'PY'
+# session-state equivalence gate: the SAME tiny sessionized Nexmark
+# query must produce IDENTICAL rows with the device-resident interval
+# runs (ARROYO_SESSION_STATE=device, default) and the legacy per-key
+# host dict (=legacy), sanitizer armed — the same-rows contract that
+# lets both layouts share checkpoints — with session_device_merge_rows
+# proving the vectorized union kernel merged when armed and never ran
+# under legacy
+import os
+import sys
+
+os.environ["ARROYO_SANITIZE"] = "1"
+
+from arroyo_tpu.connectors.memory import clear_sink, sink_output
+from arroyo_tpu.engine.engine import LocalRunner
+from arroyo_tpu.obs import perf
+from arroyo_tpu.sql import plan_sql
+
+SQL = """
+CREATE TABLE nexmark WITH (
+  connector = 'nexmark', event_rate = '1000000', num_events = '20000',
+  rate_limited = 'false', batch_size = '2048',
+  base_time_micros = '1700000000000000'
+);
+SELECT bid.auction as auction,
+       session(INTERVAL '1' SECOND) as window,
+       count(*) AS num,
+       avg(bid.price) AS mean_price
+FROM nexmark WHERE bid is not null GROUP BY 1, 2
+"""
+
+
+def run(mode: str):
+    os.environ["ARROYO_SESSION_STATE"] = mode
+    clear_sink("results")
+    d0 = perf.counter("session_device_merge_rows")
+    runner = LocalRunner(plan_sql(SQL))
+    runner.run()
+    san = runner.engine.sanitizer
+    if san is None or san.violations:
+        sys.exit(f"smoke: session gate sanitizer problem (mode={mode}, "
+                 f"violations={getattr(san, 'violations', None)})")
+    dev_rows = perf.counter("session_device_merge_rows") - d0
+    return dev_rows, sorted(
+        (int(a), int(w), int(n), round(float(m), 6))
+        for b in sink_output("results")
+        for a, w, n, m in zip(b.columns["auction"], b.columns["window_end"],
+                              b.columns["num"], b.columns["mean_price"]))
+
+
+dev_on, rows_dev = run("device")
+dev_off, rows_legacy = run("legacy")
+for k in ("ARROYO_SESSION_STATE", "ARROYO_SANITIZE"):
+    os.environ.pop(k, None)
+if not rows_dev:
+    sys.exit("smoke: sessionized nexmark produced no output")
+if rows_dev != rows_legacy:
+    sys.exit(f"smoke: device session state diverges from legacy "
+             f"({len(rows_dev)} vs {len(rows_legacy)} rows)")
+if dev_on <= 0:
+    sys.exit("smoke: armed run never merged through the device union "
+             "kernel (session_device_merge_rows == 0 — the interval "
+             "runs did not engage)")
+if dev_off != 0:
+    sys.exit(f"smoke: legacy run still pushed {dev_off} rows through "
+             "the device merge (the knob does not disarm the runs)")
+print(f"smoke: session-state equivalence ok ({len(rows_dev)} rows, "
+      f"device == legacy; {dev_on} interval rows through the union "
+      "kernel when armed)")
 PY
 
 python - <<'PY'
